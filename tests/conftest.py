@@ -1,0 +1,20 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (the driver separately dry-runs the
+multi-chip path; real TPU hardware is only used by bench.py), mirroring the
+reference's strategy of running multi-node tests in one JVM
+(SURVEY.md §4: ClusteringRule runs 3 real brokers in-process).
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_log_dir(tmp_path):
+    return str(tmp_path / "log")

@@ -1,0 +1,245 @@
+"""BPMN model / builder / XML / YAML tests (reference: bpmn-model tests)."""
+
+import pytest
+
+from zeebe_tpu.models.bpmn.builder import Bpmn
+from zeebe_tpu.models.bpmn.model import (
+    ElementType,
+    ExclusiveGateway,
+    ServiceTask,
+)
+from zeebe_tpu.models.bpmn.validation import validate_model
+from zeebe_tpu.models.bpmn.xml import read_model, write_model
+from zeebe_tpu.models.bpmn.yaml_front import read_yaml_workflow
+
+
+def order_process():
+    return (
+        Bpmn.create_process("order-process")
+        .start_event("start")
+        .service_task("collect-money", type="payment-service")
+        .end_event("end")
+        .done()
+    )
+
+
+class TestBuilder:
+    def test_linear_process(self):
+        model = order_process()
+        task = model.element("collect-money")
+        assert isinstance(task, ServiceTask)
+        assert task.task_definition.type == "payment-service"
+        assert len(task.incoming) == 1
+        assert len(task.outgoing) == 1
+        assert task.incoming[0].source_id == "start"
+        assert task.outgoing[0].target_id == "end"
+
+    def test_exclusive_gateway_branches(self):
+        b = Bpmn.create_process("p").start_event("start").exclusive_gateway("split")
+        b.branch("$.orderValue >= 100").service_task(
+            "ship-insured", type="ship"
+        ).end_event("end1")
+        b.branch(default=True).service_task("ship-plain", type="ship").end_event("end2")
+        model = b.done()
+
+        gw = model.element("split")
+        assert isinstance(gw, ExclusiveGateway)
+        assert len(gw.outgoing) == 2
+        conditions = {f.target_id: f.condition_expression for f in gw.outgoing}
+        assert conditions["ship-insured"] == "$.orderValue >= 100"
+        assert conditions["ship-plain"] is None
+        assert gw.default_flow_id == [
+            f.id for f in gw.outgoing if f.target_id == "ship-plain"
+        ][0]
+
+    def test_parallel_gateway_fork_join(self):
+        b = Bpmn.create_process("p").start_event().parallel_gateway("fork")
+        branch1 = b.branch().service_task("a", type="ta")
+        branch2 = b.branch().service_task("b", type="tb")
+        branch1.parallel_gateway("join")
+        branch2.connect_to("join")
+        b.move_to("join").end_event("end")
+        model = b.done()
+        join = model.element("join")
+        assert len(join.incoming) == 2
+        assert len(join.outgoing) == 1
+
+    def test_subprocess(self):
+        b = Bpmn.create_process("p").start_event("s")
+        sub = b.sub_process("sub")
+        sub.start_event("sub-start").service_task("inner", type="t").end_event("sub-end")
+        sub.embedded_done().end_event("outer-end")
+        model = b.done()
+        inner = model.element("inner")
+        assert inner.scope_id == "sub"
+        assert model.element("sub").scope_id == "p"
+        assert model.element("outer-end").incoming[0].source_id == "sub"
+
+    def test_duplicate_id_rejected(self):
+        with pytest.raises(ValueError):
+            Bpmn.create_process("p").start_event("x").end_event("x").done()
+
+
+class TestXml:
+    def test_round_trip(self):
+        model = order_process()
+        xml_bytes = write_model(model)
+        parsed = read_model(xml_bytes)
+        assert parsed.processes[0].id == "order-process"
+        task = parsed.element("collect-money")
+        assert isinstance(task, ServiceTask)
+        assert task.task_definition.type == "payment-service"
+        assert task.incoming[0].source_id == "start"
+
+    def test_round_trip_gateway_conditions(self):
+        b = Bpmn.create_process("p").start_event("start").exclusive_gateway("split")
+        b.branch("$.x < 5").end_event("small")
+        b.branch(default=True).end_event("big")
+        xml_bytes = write_model(b.done())
+        parsed = read_model(xml_bytes)
+        gw = parsed.element("split")
+        conds = {f.target_id: f.condition_expression for f in gw.outgoing}
+        assert conds["small"] == "$.x < 5"
+        assert gw.default_flow_id is not None
+
+    def test_round_trip_message_catch(self):
+        model = (
+            Bpmn.create_process("p")
+            .start_event()
+            .message_catch_event(
+                "wait", message_name="order-paid", correlation_key="$.orderId"
+            )
+            .end_event()
+            .done()
+        )
+        parsed = read_model(write_model(model))
+        catch = parsed.element("wait")
+        assert catch.message.name == "order-paid"
+        assert catch.message.correlation_key == "$.orderId"
+
+    def test_round_trip_subprocess_and_io(self):
+        b = Bpmn.create_process("p").start_event("s")
+        b.service_task(
+            "t",
+            type="x",
+            headers={"k": "v"},
+            inputs=[("$.a", "$.b")],
+            outputs=[("$.c", "$.d")],
+        )
+        sub = b.sub_process("sub")
+        sub.start_event("ss").end_event("se")
+        sub.embedded_done().end_event("e")
+        parsed = read_model(write_model(b.done()))
+        t = parsed.element("t")
+        assert t.task_headers == {"k": "v"}
+        assert [(m.source, m.target) for m in t.input_mappings] == [("$.a", "$.b")]
+        assert [(m.source, m.target) for m in t.output_mappings] == [("$.c", "$.d")]
+        assert parsed.element("ss").scope_id == "sub"
+
+    def test_round_trip_timer(self):
+        model = (
+            Bpmn.create_process("p")
+            .start_event()
+            .timer_catch_event("wait", duration_ms=5000)
+            .end_event()
+            .done()
+        )
+        parsed = read_model(write_model(model))
+        assert parsed.element("wait").timer_duration_ms == 5000
+
+
+class TestYaml:
+    def test_simple_workflow(self):
+        # mirror of reference simple-workflow.yaml
+        model = read_yaml_workflow(
+            """
+name: yaml-workflow
+tasks:
+  - id: task1
+    type: foo
+  - id: task2
+    type: bar
+"""
+        )
+        t1, t2 = model.element("task1"), model.element("task2")
+        assert t1.task_definition.type == "foo"
+        assert t1.outgoing[0].target_id == "task2"
+        assert t2.outgoing[0].target_id.startswith("end")
+
+    def test_switch_cases(self):
+        model = read_yaml_workflow(
+            """
+name: flow
+tasks:
+  - id: decide
+    type: t
+    switch:
+      - case: $.x > 10
+        goto: big
+      - default: small
+  - id: big
+    type: t
+    end: true
+  - id: small
+    type: t
+"""
+        )
+        gw = model.element("split-decide")
+        assert isinstance(gw, ExclusiveGateway)
+        targets = {f.target_id for f in gw.outgoing}
+        assert targets == {"big", "small"}
+        assert gw.default_flow_id is not None
+
+    def test_headers_and_mappings(self):
+        model = read_yaml_workflow(
+            """
+name: w
+tasks:
+  - id: t
+    type: x
+    retries: 5
+    headers: {a: b}
+    inputs:
+      - source: $.in
+        target: $.v
+    outputs:
+      - source: $.v
+        target: $.out
+"""
+        )
+        t = model.element("t")
+        assert t.task_definition.retries == 5
+        assert t.task_headers == {"a": "b"}
+        assert t.input_mappings[0].source == "$.in"
+
+
+class TestValidation:
+    def test_valid_model(self):
+        assert validate_model(order_process()) == []
+
+    def test_missing_task_type(self):
+        model = (
+            Bpmn.create_process("p").start_event().service_task("t").end_event().done()
+        )
+        errors = validate_model(model)
+        assert any("task type" in str(e) for e in errors)
+
+    def test_missing_start_event(self):
+        b = Bpmn.create_process("p")
+        b.service_task("t", type="x")
+        errors = validate_model(b.done())
+        assert any("start event" in str(e) for e in errors)
+
+    def test_gateway_flow_without_condition(self):
+        b = Bpmn.create_process("p").start_event().exclusive_gateway("gw")
+        b.branch("$.x == 1").end_event("e1")
+        b.branch().end_event("e2")  # no condition, not default
+        errors = validate_model(b.done())
+        assert any("condition" in str(e) for e in errors)
+
+    def test_bad_condition_expression(self):
+        b = Bpmn.create_process("p").start_event().exclusive_gateway("gw")
+        b.branch("$.x === 1").end_event("e1")
+        b.branch(default=True).end_event("e2")
+        errors = validate_model(b.done())
+        assert any("gw" in str(e) or "expected" in str(e).lower() for e in errors)

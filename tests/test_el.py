@@ -1,0 +1,159 @@
+"""Condition language tests.
+
+Reference parity: json-el test suite (JsonConditionParserTest /
+JsonConditionInterpreterTest semantics).
+"""
+
+import pytest
+
+from zeebe_tpu.models.el import (
+    Comparison,
+    ConditionEvalError,
+    ConditionParseError,
+    Conjunction,
+    Disjunction,
+    JsonPathLiteral,
+    Literal,
+    evaluate_condition,
+    parse_condition,
+)
+from zeebe_tpu.models.el.ast import query_json_path
+
+
+class TestParser:
+    def test_simple_comparison(self):
+        cond = parse_condition("$.foo == 'bar'")
+        assert cond == Comparison("==", JsonPathLiteral("$.foo"), Literal("bar"))
+
+    def test_all_operators(self):
+        for op in ("==", "!=", "<", "<=", ">", ">="):
+            cond = parse_condition(f"$.x {op} 3")
+            assert cond.op == op
+
+    def test_number_literals(self):
+        assert parse_condition("1 == 1.5").right == Literal(1.5)
+        assert parse_condition("$.x == -2").right == Literal(-2)
+        assert parse_condition("$.x == 2.0e3").right == Literal(2000.0)
+        # reference grammar: exponent floats require a decimal point
+        with pytest.raises(ConditionParseError):
+            parse_condition("$.x == 2e3")
+
+    def test_bool_null_literals(self):
+        assert parse_condition("$.x == true").right == Literal(True)
+        assert parse_condition("$.x == false").right == Literal(False)
+        assert parse_condition("$.x == null").right == Literal(None)
+
+    def test_double_and_single_quoted_strings(self):
+        assert parse_condition('$.x == "a b"').right == Literal("a b")
+        assert parse_condition("$.x == 'a b'").right == Literal("a b")
+
+    def test_conjunction_disjunction_precedence(self):
+        # a || b && c parses as a || (b && c)
+        cond = parse_condition("$.a == 1 || $.b == 2 && $.c == 3")
+        assert isinstance(cond, Disjunction)
+        assert isinstance(cond.right, Conjunction)
+
+    def test_parentheses(self):
+        cond = parse_condition("($.a == 1 || $.b == 2) && $.c == 3")
+        assert isinstance(cond, Conjunction)
+        assert isinstance(cond.left, Disjunction)
+
+    def test_ordering_rejects_string_literal(self):
+        with pytest.raises(ConditionParseError):
+            parse_condition("$.x < 'foo'")
+
+    def test_ordering_rejects_bool(self):
+        with pytest.raises(ConditionParseError):
+            parse_condition("$.x >= true")
+
+    def test_rejects_garbage(self):
+        with pytest.raises(ConditionParseError):
+            parse_condition("foo == 21")
+        with pytest.raises(ConditionParseError):
+            parse_condition("$.x == 1 extra")
+        with pytest.raises(ConditionParseError):
+            parse_condition("$.x ==")
+
+
+class TestJsonPath:
+    def test_top_level(self):
+        assert query_json_path({"a": 1}, "$.a") == (True, 1)
+
+    def test_nested(self):
+        assert query_json_path({"a": {"b": 2}}, "$.a.b") == (True, 2)
+
+    def test_array_index(self):
+        assert query_json_path({"a": [10, 20]}, "$.a[1]") == (True, 20)
+
+    def test_bracket_name(self):
+        assert query_json_path({"a b": 3}, "$['a b']") == (True, 3)
+
+    def test_root(self):
+        assert query_json_path({"a": 1}, "$") == (True, {"a": 1})
+
+    def test_missing(self):
+        assert query_json_path({"a": 1}, "$.b") == (False, None)
+
+
+class TestInterpreter:
+    def test_equality(self):
+        assert evaluate_condition(parse_condition("$.x == 1"), {"x": 1})
+        assert not evaluate_condition(parse_condition("$.x == 1"), {"x": 2})
+        assert evaluate_condition(parse_condition("$.x != 1"), {"x": 2})
+
+    def test_string_equality(self):
+        assert evaluate_condition(parse_condition("$.x == 'foo'"), {"x": "foo"})
+
+    def test_bool_equality(self):
+        assert evaluate_condition(parse_condition("$.paid == true"), {"paid": True})
+
+    def test_null(self):
+        assert evaluate_condition(parse_condition("$.x == null"), {"x": None})
+        assert not evaluate_condition(parse_condition("$.x == null"), {"x": 1})
+        assert evaluate_condition(parse_condition("$.x != null"), {"x": 1})
+
+    def test_ordering(self):
+        assert evaluate_condition(parse_condition("$.x < 10"), {"x": 5})
+        assert evaluate_condition(parse_condition("$.x >= 10"), {"x": 10})
+        assert not evaluate_condition(parse_condition("$.x > 10"), {"x": 10})
+
+    def test_int_float_widening(self):
+        # Reference ensureSameType widens INTEGER to FLOAT
+        assert evaluate_condition(parse_condition("$.x == 1.0"), {"x": 1})
+        assert evaluate_condition(parse_condition("$.x < 2.5"), {"x": 2})
+
+    def test_type_mismatch_raises(self):
+        with pytest.raises(ConditionEvalError):
+            evaluate_condition(parse_condition("$.x == 'foo'"), {"x": 1})
+        with pytest.raises(ConditionEvalError):
+            evaluate_condition(parse_condition("$.x > 1"), {"x": "foo"})
+
+    def test_missing_path_raises(self):
+        # Reference: "JSON path '...' has no result."
+        with pytest.raises(ConditionEvalError):
+            evaluate_condition(parse_condition("$.missing == 1"), {"x": 1})
+
+    def test_conjunction_disjunction(self):
+        payload = {"a": 1, "b": 2}
+        assert evaluate_condition(
+            parse_condition("$.a == 1 && $.b == 2"), payload
+        )
+        assert evaluate_condition(
+            parse_condition("$.a == 9 || $.b == 2"), payload
+        )
+        assert not evaluate_condition(
+            parse_condition("$.a == 9 && $.b == 2"), payload
+        )
+
+    def test_path_to_path_comparison(self):
+        assert evaluate_condition(parse_condition("$.a == $.b"), {"a": 3, "b": 3})
+
+    def test_or_short_circuits_before_error(self):
+        # reference: || evaluates left first; only raises if needed
+        assert evaluate_condition(
+            parse_condition("$.a == 1 || $.missing == 1"), {"a": 1}
+        )
+        with pytest.raises(ConditionEvalError):
+            evaluate_condition(
+                parse_condition("$.missing == 1 || $.a == 1"), {"a": 1}
+            )

@@ -1,0 +1,97 @@
+"""Log storage + log stream tests (reference: logstreams module tests)."""
+
+from zeebe_tpu.log import LogStream, LogStreamReader, SegmentedLogStorage
+from zeebe_tpu.protocol import RecordType, ValueType, WorkflowInstanceIntent
+from zeebe_tpu.protocol.metadata import RecordMetadata
+from zeebe_tpu.protocol.records import Record, WorkflowInstanceRecord
+
+
+def wi_record(key=1, activity="start", intent=WorkflowInstanceIntent.ELEMENT_READY):
+    return Record(
+        key=key,
+        metadata=RecordMetadata(
+            record_type=RecordType.EVENT,
+            value_type=ValueType.WORKFLOW_INSTANCE,
+            intent=int(intent),
+        ),
+        value=WorkflowInstanceRecord(activity_id=activity, workflow_instance_key=key),
+    )
+
+
+def test_append_assigns_dense_positions(tmp_log_dir):
+    log = LogStream(SegmentedLogStorage(tmp_log_dir))
+    log.append([wi_record(), wi_record()])
+    last = log.append([wi_record()])
+    assert last == 2
+    assert log.next_position == 3
+    assert log.commit_position == 2
+
+
+def test_reader_iterates_in_order(tmp_log_dir):
+    log = LogStream(SegmentedLogStorage(tmp_log_dir))
+    for i in range(10):
+        log.append([wi_record(key=i, activity=f"a{i}")])
+    records = list(log.reader(0))
+    assert [r.position for r in records] == list(range(10))
+    assert [r.value.activity_id for r in records] == [f"a{i}" for i in range(10)]
+
+
+def test_reader_seek(tmp_log_dir):
+    log = LogStream(SegmentedLogStorage(tmp_log_dir))
+    for i in range(10):
+        log.append([wi_record(key=i)])
+    reader = log.reader(7)
+    assert [r.position for r in reader] == [7, 8, 9]
+
+
+def test_recovery_after_reopen(tmp_log_dir):
+    log = LogStream(SegmentedLogStorage(tmp_log_dir))
+    for i in range(5):
+        log.append([wi_record(key=i)])
+    log.flush()
+    log.storage.close()
+
+    reopened = LogStream(SegmentedLogStorage(tmp_log_dir))
+    assert reopened.next_position == 5
+    assert reopened.commit_position == 4
+    assert [r.position for r in reopened.reader(0)] == list(range(5))
+    # appends continue from the recovered position
+    assert reopened.append([wi_record(key=99)]) == 5
+
+
+def test_segment_rolling(tmp_log_dir):
+    log = LogStream(SegmentedLogStorage(tmp_log_dir, segment_size=1024))
+    for i in range(50):
+        log.append([wi_record(key=i, activity="activity-with-a-longer-name")])
+    assert len(log.storage._segments) > 1
+    assert [r.position for r in log.reader(0)] == list(range(50))
+
+
+def test_truncate(tmp_log_dir):
+    log = LogStream(SegmentedLogStorage(tmp_log_dir))
+    for i in range(10):
+        log.append([wi_record(key=i)])
+    log.truncate(6)
+    assert [r.position for r in log.reader(0)] == list(range(6))
+    assert log.next_position == 6
+    # positions are re-assigned after truncation
+    assert log.append([wi_record(key=100)]) == 6
+
+
+def test_commit_listener(tmp_log_dir):
+    log = LogStream(SegmentedLogStorage(tmp_log_dir))
+    seen = []
+    log.on_commit(seen.append)
+    log.append([wi_record()], commit=False)
+    assert seen == []
+    log.set_commit_position(0)
+    assert seen == [0]
+
+
+def test_read_committed_stops_at_commit_position(tmp_log_dir):
+    log = LogStream(SegmentedLogStorage(tmp_log_dir))
+    log.append([wi_record(key=1)], commit=True)
+    log.append([wi_record(key=2)], commit=False)
+    reader = LogStreamReader(log, 0)
+    records = reader.read_committed()
+    assert [r.position for r in records] == [0]

@@ -1,0 +1,81 @@
+"""msgpack codec tests (reference: msgpack-core spec tests)."""
+
+import pytest
+
+from zeebe_tpu.protocol import msgpack
+
+
+ROUND_TRIP_CASES = [
+    None,
+    True,
+    False,
+    0,
+    1,
+    127,
+    128,
+    255,
+    256,
+    65535,
+    65536,
+    2**31 - 1,
+    2**32,
+    2**63 - 1,
+    -1,
+    -32,
+    -33,
+    -128,
+    -129,
+    -32768,
+    -32769,
+    -(2**31),
+    -(2**63),
+    1.5,
+    -2.75,
+    "",
+    "hello",
+    "x" * 31,
+    "x" * 32,
+    "x" * 300,
+    "ünïcödé ⚙",
+    b"",
+    b"\x00\x01\x02",
+    b"y" * 300,
+    [],
+    [1, 2, 3],
+    list(range(20)),
+    {},
+    {"a": 1},
+    {"k" + str(i): i for i in range(20)},
+    {"nested": {"a": [1, {"b": None}], "c": "d"}},
+]
+
+
+@pytest.mark.parametrize("value", ROUND_TRIP_CASES, ids=lambda v: repr(v)[:40])
+def test_round_trip(value):
+    assert msgpack.unpack(msgpack.pack(value)) == value
+
+
+def test_empty_document_constant():
+    assert msgpack.unpack(msgpack.EMPTY_DOCUMENT) == {}
+
+
+def test_canonical_sorts_keys():
+    a = msgpack.canonical({"b": 1, "a": 2})
+    b = msgpack.canonical({"a": 2, "b": 1})
+    assert a == b
+
+
+def test_canonical_distinguishes_values():
+    assert msgpack.canonical({"a": 1}) != msgpack.canonical({"a": 2})
+
+
+def test_unpack_rejects_trailing_bytes():
+    with pytest.raises(ValueError):
+        msgpack.unpack(msgpack.pack(1) + b"\x01")
+
+
+def test_unpack_from_offset():
+    data = msgpack.pack("ab") + msgpack.pack([1])
+    v1, o = msgpack.unpack_from(data, 0)
+    v2, o2 = msgpack.unpack_from(data, o)
+    assert v1 == "ab" and v2 == [1] and o2 == len(data)

@@ -1,0 +1,124 @@
+"""Multi-partition behaviors: job routing, credits, subscription cleanup.
+
+These cover the cross-partition seams the reference exercises in
+qa/integration-tests (ClusteringRule): instances sharded over partitions,
+jobs completed on the right partition, message subscriptions closed after
+correlation.
+"""
+
+import pytest
+
+from zeebe_tpu.gateway import JobWorker, ZeebeClient
+from zeebe_tpu.models.bpmn.builder import Bpmn
+from zeebe_tpu.protocol.enums import ValueType
+from zeebe_tpu.protocol.intents import MessageSubscriptionIntent, WorkflowInstanceIntent as WI
+from zeebe_tpu.runtime import Broker, ControlledClock
+
+
+@pytest.fixture
+def broker(tmp_path):
+    b = Broker(num_partitions=4, data_dir=str(tmp_path / "mp"), clock=ControlledClock())
+    yield b
+    b.close()
+
+
+@pytest.fixture
+def client(broker):
+    return ZeebeClient(broker)
+
+
+def order_model():
+    return (
+        Bpmn.create_process("order")
+        .start_event()
+        .service_task("work", type="t")
+        .end_event()
+        .done()
+    )
+
+
+def test_jobs_complete_on_their_own_partition(broker, client):
+    """Job keys collide across partitions (each partition has its own strided
+    generator); completion must route to the partition that pushed the job."""
+    client.deploy_model(order_model())
+    worker = JobWorker(broker, "t", lambda ctx: {"done": ctx.partition_id})
+    # one instance on every partition → same job key on each partition
+    for pid in range(4):
+        client.create_instance("order", {"p": pid}, partition_id=pid)
+    broker.run_until_idle()
+    assert len(worker.handled) == 4
+    # every instance completed on its own partition
+    for pid in range(4):
+        completed = [
+            r
+            for r in broker.records(pid)
+            if r.metadata.value_type == ValueType.WORKFLOW_INSTANCE
+            and r.metadata.intent == WI.ELEMENT_COMPLETED
+            and r.value.activity_id == "order"
+        ]
+        assert len(completed) == 1, f"partition {pid} did not complete"
+        assert completed[0].value.payload["done"] == pid
+        assert broker.partitions[pid].engine.jobs == {}
+
+
+def test_credits_do_not_inflate_across_partitions(broker, client):
+    client.deploy_model(order_model())
+    worker = JobWorker(broker, "t", lambda ctx: None, credits=8)
+    for pid in range(4):
+        for _ in range(3):
+            client.create_instance("order", partition_id=pid)
+    broker.run_until_idle()
+    assert len(worker.handled) == 12
+    # every partition's credit counter returned exactly to its initial value
+    for partition in broker.partitions:
+        subs = [
+            s
+            for s in partition.engine.job_subscriptions
+            if s.subscriber_key == worker.subscriber_key
+        ]
+        assert len(subs) == 1
+        assert subs[0].credits == worker.initial_credits
+
+
+def test_message_subscription_closed_after_correlation(broker, client):
+    model = (
+        Bpmn.create_process("msg")
+        .start_event()
+        .message_catch_event("wait", message_name="m", correlation_key="$.cid")
+        .end_event()
+        .done()
+    )
+    client.deploy_model(model)
+    client.create_instance("msg", {"cid": "abc"}, partition_id=1)
+    broker.run_until_idle()
+    msg_pid = broker.partition_for_correlation_key("abc")
+    assert len(broker.partitions[msg_pid].engine.message_subscriptions) == 1
+    client.publish_message("m", "abc", {"got": 1})
+    broker.run_until_idle()
+    # instance completed AND the subscription store is clean again
+    assert broker.partitions[msg_pid].engine.message_subscriptions == []
+    closed = [
+        r
+        for r in broker.records(msg_pid)
+        if r.metadata.value_type == ValueType.MESSAGE_SUBSCRIPTION
+        and r.metadata.intent == MessageSubscriptionIntent.CLOSED
+    ]
+    assert len(closed) == 1
+
+
+def test_terminated_catch_event_closes_subscription(broker, client):
+    model = (
+        Bpmn.create_process("msg2")
+        .start_event()
+        .message_catch_event("wait", message_name="m2", correlation_key="$.cid")
+        .end_event()
+        .done()
+    )
+    client.deploy_model(model)
+    instance = client.create_instance("msg2", {"cid": "xyz"}, partition_id=2)
+    broker.run_until_idle()
+    msg_pid = broker.partition_for_correlation_key("xyz")
+    assert len(broker.partitions[msg_pid].engine.message_subscriptions) == 1
+    client.cancel_instance(instance.workflow_instance_key, partition_id=2)
+    broker.run_until_idle()
+    assert broker.partitions[msg_pid].engine.message_subscriptions == []

@@ -1,0 +1,592 @@
+"""End-to-end tests of the host reference engine through the broker runtime.
+
+Reference parity: these mirror the reference's broker-core stream-processor
+tests (StreamProcessorRule + EmbeddedBrokerRule asserts on the record
+stream) — the event log IS the observable behavior.
+"""
+
+import pytest
+
+from zeebe_tpu.gateway import JobWorker, ZeebeClient, ClientException
+from zeebe_tpu.models.bpmn.builder import Bpmn
+from zeebe_tpu.protocol.enums import ErrorType, RecordType, ValueType
+from zeebe_tpu.protocol.intents import (
+    IncidentIntent,
+    JobIntent,
+    MessageIntent,
+    TimerIntent,
+    WorkflowInstanceIntent as WI,
+)
+from zeebe_tpu.runtime import Broker, ControlledClock
+
+
+@pytest.fixture
+def clock():
+    return ControlledClock(start_ms=1_000_000)
+
+
+@pytest.fixture
+def broker(tmp_path, clock):
+    b = Broker(num_partitions=1, data_dir=str(tmp_path / "data"), clock=clock)
+    yield b
+    b.close()
+
+
+@pytest.fixture
+def client(broker):
+    return ZeebeClient(broker)
+
+
+def order_process_model():
+    return (
+        Bpmn.create_process("order-process")
+        .start_event("start")
+        .service_task("collect-money", type="payment-service")
+        .end_event("end")
+        .done()
+    )
+
+
+def wi_intents(broker, partition=0):
+    return [
+        (WI(r.metadata.intent).name, r.value.activity_id)
+        for r in broker.records(partition)
+        if r.metadata.value_type == ValueType.WORKFLOW_INSTANCE
+        and r.metadata.record_type == RecordType.EVENT
+    ]
+
+
+class TestHappyPath:
+    def test_deploy_and_complete_instance(self, broker, client):
+        client.deploy_model(order_process_model())
+        worker = JobWorker(broker, "payment-service", lambda ctx: {"paid": True})
+        instance = client.create_instance("order-process", {"orderId": 31243})
+        broker.run_until_idle()
+
+        assert instance.workflow_instance_key > 0
+        assert instance.version == 1
+        assert len(worker.handled) == 1
+        job = worker.handled[0].value
+        assert job.type == "payment-service"
+        assert job.payload == {"orderId": 31243}
+        assert job.headers.bpmn_process_id == "order-process"
+        assert job.headers.activity_id == "collect-money"
+        assert job.worker == "default-worker"
+
+        # the canonical element lifecycle (reference internal-processing docs)
+        assert wi_intents(broker) == [
+            ("CREATED", "order-process"),
+            ("ELEMENT_READY", "order-process"),
+            ("ELEMENT_ACTIVATED", "order-process"),
+            ("START_EVENT_OCCURRED", "start"),
+            ("SEQUENCE_FLOW_TAKEN", "flow-start-collect-money-0"),
+            ("ELEMENT_READY", "collect-money"),
+            ("ELEMENT_ACTIVATED", "collect-money"),
+            ("ELEMENT_COMPLETING", "collect-money"),
+            ("ELEMENT_COMPLETED", "collect-money"),
+            ("SEQUENCE_FLOW_TAKEN", "flow-collect-money-end-1"),
+            ("END_EVENT_OCCURRED", "end"),
+            ("ELEMENT_COMPLETING", "order-process"),
+            ("ELEMENT_COMPLETED", "order-process"),
+        ]
+        # payload carried through job completion
+        completed = [
+            r
+            for r in broker.records()
+            if r.metadata.value_type == ValueType.WORKFLOW_INSTANCE
+            and r.metadata.intent == WI.ELEMENT_COMPLETED
+            and r.value.activity_id == "order-process"
+        ]
+        assert completed[0].value.payload == {"orderId": 31243, "paid": True}
+        # element instance cleaned up
+        assert broker.partitions[0].engine.element_instances.instances == {}
+
+    def test_create_by_workflow_key_and_version(self, broker, client):
+        client.deploy_model(order_process_model())
+        client.deploy_model(order_process_model())  # version 2
+        latest = client.create_instance("order-process")
+        assert latest.version == 2
+        v1 = client.create_instance("order-process", version=1)
+        assert v1.version == 1
+        by_key = client.create_instance(workflow_key=v1.workflow_key)
+        assert by_key.version == 1
+
+    def test_create_unknown_workflow_rejected(self, broker, client):
+        with pytest.raises(ClientException, match="not deployed"):
+            client.create_instance("missing-process")
+
+    def test_yaml_deploy_and_run(self, broker, client):
+        client.deploy_yaml(
+            """
+name: yaml-flow
+tasks:
+  - id: task1
+    type: foo
+  - id: task2
+    type: bar
+"""
+        )
+        done = []
+        JobWorker(broker, "foo", lambda ctx: done.append("foo"))
+        JobWorker(broker, "bar", lambda ctx: done.append("bar"))
+        client.create_instance("yaml-flow")
+        broker.run_until_idle()
+        assert done == ["foo", "bar"]
+        final = wi_intents(broker)[-1]
+        assert final == ("ELEMENT_COMPLETED", "yaml-flow")
+
+
+class TestExclusiveGateway:
+    def gateway_model(self):
+        b = Bpmn.create_process("flow").start_event("start").exclusive_gateway("split")
+        b.branch("$.orderValue >= 100").service_task("insured", type="insured-t").end_event("e1")
+        b.branch(default=True).service_task("plain", type="plain-t").end_event("e2")
+        return b.done()
+
+    def test_condition_routes_true_branch(self, broker, client):
+        client.deploy_model(self.gateway_model())
+        taken = []
+        JobWorker(broker, "insured-t", lambda ctx: taken.append("insured"))
+        JobWorker(broker, "plain-t", lambda ctx: taken.append("plain"))
+        client.create_instance("flow", {"orderValue": 150})
+        broker.run_until_idle()
+        assert taken == ["insured"]
+
+    def test_default_flow(self, broker, client):
+        client.deploy_model(self.gateway_model())
+        taken = []
+        JobWorker(broker, "insured-t", lambda ctx: taken.append("insured"))
+        JobWorker(broker, "plain-t", lambda ctx: taken.append("plain"))
+        client.create_instance("flow", {"orderValue": 10})
+        broker.run_until_idle()
+        assert taken == ["plain"]
+
+    def test_condition_error_raises_incident(self, broker, client):
+        client.deploy_model(self.gateway_model())
+        client.create_instance("flow", {})  # $.orderValue missing
+        broker.run_until_idle()
+        incidents = [
+            r
+            for r in broker.records()
+            if r.metadata.value_type == ValueType.INCIDENT
+            and r.metadata.intent == IncidentIntent.CREATED
+        ]
+        assert len(incidents) == 1
+        assert incidents[0].value.error_type == ErrorType.CONDITION_ERROR
+        assert incidents[0].value.activity_id == "split"
+
+    def test_incident_resolution_via_payload_update(self, broker, client):
+        client.deploy_model(self.gateway_model())
+        taken = []
+        JobWorker(broker, "plain-t", lambda ctx: taken.append("plain"))
+        JobWorker(broker, "insured-t", lambda ctx: taken.append("insured"))
+        instance = client.create_instance("flow", {})
+        broker.run_until_idle()
+        incident = [
+            r
+            for r in broker.records()
+            if r.metadata.value_type == ValueType.INCIDENT
+            and r.metadata.intent == IncidentIntent.CREATED
+        ][0]
+        # resolve: update payload at the failed token → RESOLVE → re-run split
+        client.update_payload(
+            instance.workflow_instance_key,
+            {"orderValue": 500},
+            activity_instance_key=incident.value.activity_instance_key,
+        )
+        broker.run_until_idle()
+        assert taken == ["insured"]
+        resolved = [
+            r
+            for r in broker.records()
+            if r.metadata.value_type == ValueType.INCIDENT
+            and r.metadata.intent == IncidentIntent.RESOLVED
+        ]
+        assert len(resolved) == 1
+        assert resolved[0].key == incident.key
+        assert wi_intents(broker)[-1] == ("ELEMENT_COMPLETED", "flow")
+
+
+class TestParallelGateway:
+    def fork_join_model(self):
+        b = Bpmn.create_process("par").start_event().parallel_gateway("fork")
+        branch1 = b.branch().service_task("a", type="ta")
+        branch2 = b.branch().service_task("b", type="tb")
+        branch1.parallel_gateway("join")
+        branch2.connect_to("join")
+        b.move_to("join").end_event("end")
+        return b.done()
+
+    def test_fork_join_completes(self, broker, client):
+        client.deploy_model(self.fork_join_model())
+        ran = []
+        JobWorker(broker, "ta", lambda ctx: ran.append("a") or {"a": 1})
+        JobWorker(broker, "tb", lambda ctx: ran.append("b") or {"b": 2})
+        client.create_instance("par", {"init": True})
+        broker.run_until_idle()
+        assert sorted(ran) == ["a", "b"]
+        intents = wi_intents(broker)
+        assert intents[-1] == ("ELEMENT_COMPLETED", "par")
+        # join activation happened exactly once
+        assert sum(1 for name, aid in intents if name == "GATEWAY_ACTIVATED" and aid == "join") == 1
+        # both branch payloads merged at the join
+        completed = [
+            r
+            for r in broker.records()
+            if r.metadata.intent == WI.ELEMENT_COMPLETED
+            and r.metadata.value_type == ValueType.WORKFLOW_INSTANCE
+            and r.value.activity_id == "par"
+        ][0]
+        assert completed.value.payload == {"init": True, "a": 1, "b": 2}
+        assert broker.partitions[0].engine.element_instances.instances == {}
+
+    def test_fork_without_join_completes_on_last_token(self, broker, client):
+        b = Bpmn.create_process("par2").start_event().parallel_gateway("fork")
+        b.branch().service_task("a", type="ta").end_event("e1")
+        b.branch().service_task("b", type="tb").end_event("e2")
+        client.deploy_model(b.done())
+        JobWorker(broker, "ta", lambda ctx: None)
+        JobWorker(broker, "tb", lambda ctx: None)
+        client.create_instance("par2")
+        broker.run_until_idle()
+        intents = wi_intents(broker)
+        # process completes exactly once, after both tokens consumed
+        assert [x for x in intents if x[0] == "ELEMENT_COMPLETED" and x[1] == "par2"] == [
+            ("ELEMENT_COMPLETED", "par2")
+        ]
+
+
+class TestCancel:
+    def test_cancel_running_instance_cancels_job(self, broker, client, clock):
+        client.deploy_model(order_process_model())
+        # no worker: job stays CREATED... but must exist to cancel
+        instance = client.create_instance("order-process")
+        broker.run_until_idle()
+        response = client.cancel_instance(instance.workflow_instance_key)
+        broker.run_until_idle()
+        assert response.metadata.intent == WI.CANCELING
+        intents = wi_intents(broker)
+        assert ("ELEMENT_TERMINATING", "order-process") in intents
+        assert ("ELEMENT_TERMINATED", "collect-money") in intents
+        assert ("ELEMENT_TERMINATED", "order-process") in intents
+        job_canceled = [
+            r
+            for r in broker.records()
+            if r.metadata.value_type == ValueType.JOB
+            and r.metadata.intent == JobIntent.CANCELED
+        ]
+        assert len(job_canceled) == 1
+        assert broker.partitions[0].engine.jobs == {}
+        assert broker.partitions[0].engine.element_instances.instances == {}
+
+    def test_cancel_finished_instance_rejected(self, broker, client):
+        client.deploy_model(order_process_model())
+        JobWorker(broker, "payment-service", lambda ctx: None)
+        instance = client.create_instance("order-process")
+        broker.run_until_idle()
+        with pytest.raises(ClientException, match="not running"):
+            client.cancel_instance(instance.workflow_instance_key)
+
+
+class TestJobLifecycle:
+    def test_fail_and_retry(self, broker, client):
+        client.deploy_model(order_process_model())
+        attempts = []
+
+        def handler(ctx):
+            attempts.append(ctx.job.retries)
+            if len(attempts) == 1:
+                ctx.fail(retries=ctx.job.retries - 1)
+
+        JobWorker(broker, "payment-service", handler)
+        client.create_instance("order-process")
+        broker.run_until_idle()
+        # first attempt failed with retries left → re-activated
+        assert attempts == [3, 2]
+        assert wi_intents(broker)[-1] == ("ELEMENT_COMPLETED", "order-process")
+
+    def test_fail_without_retries_raises_incident_then_update_retries_resolves(
+        self, broker, client
+    ):
+        client.deploy_model(order_process_model())
+        attempts = []
+
+        def handler(ctx):
+            attempts.append(1)
+            if len(attempts) == 1:
+                ctx.fail(retries=0)
+
+        JobWorker(broker, "payment-service", handler)
+        client.create_instance("order-process")
+        broker.run_until_idle()
+        incidents = [
+            r
+            for r in broker.records()
+            if r.metadata.value_type == ValueType.INCIDENT
+            and r.metadata.intent == IncidentIntent.CREATED
+        ]
+        assert len(incidents) == 1
+        assert incidents[0].value.error_type == ErrorType.JOB_NO_RETRIES
+        job_key = incidents[0].value.job_key
+
+        client.update_job_retries(job_key, retries=1)
+        broker.run_until_idle()
+        assert len(attempts) == 2
+        assert wi_intents(broker)[-1] == ("ELEMENT_COMPLETED", "order-process")
+        resolved = [
+            r
+            for r in broker.records()
+            if r.metadata.value_type == ValueType.INCIDENT
+            and r.metadata.intent == IncidentIntent.RESOLVED
+        ]
+        assert len(resolved) == 1
+
+    def test_job_timeout_reactivates(self, broker, client, clock):
+        client.deploy_model(order_process_model())
+        seen = []
+
+        def slow_handler(ctx):
+            seen.append(ctx.key)
+            if len(seen) == 1:
+                ctx.finished = True  # simulate a worker that never completes
+
+        JobWorker(broker, "payment-service", slow_handler, timeout_ms=5_000)
+        client.create_instance("order-process")
+        broker.run_until_idle()
+        assert len(seen) == 1
+        clock.advance(10_000)
+        broker.tick()
+        broker.run_until_idle()
+        # re-pushed after TIMED_OUT
+        assert len(seen) == 2
+        timed_out = [
+            r
+            for r in broker.records()
+            if r.metadata.value_type == ValueType.JOB
+            and r.metadata.intent == JobIntent.TIMED_OUT
+        ]
+        assert len(timed_out) == 1
+
+    def test_standalone_job(self, broker, client):
+        created = client.create_job("standalone", {"x": 1})
+        done = []
+        worker = JobWorker(broker, "standalone", lambda ctx: done.append(ctx.payload))
+        # job created before worker existed: no push yet — create another
+        second = client.create_job("standalone", {"x": 2})
+        broker.run_until_idle()
+        assert done == [{"x": 2}]
+
+
+class TestPayloadMappings:
+    def test_input_output_mappings(self, broker, client):
+        model = (
+            Bpmn.create_process("map")
+            .start_event()
+            .service_task(
+                "work",
+                type="t",
+                inputs=[("$.order.total", "$.price")],
+                outputs=[("$.paid", "$.order.paid")],
+            )
+            .end_event()
+            .done()
+        )
+        client.deploy_model(model)
+        seen = []
+
+        def handler(ctx):
+            seen.append(dict(ctx.payload))
+            return {"paid": True}
+
+        JobWorker(broker, "t", handler)
+        client.create_instance("map", {"order": {"total": 42}})
+        broker.run_until_idle()
+        # input mapping narrowed the job payload
+        assert seen == [{"price": 42}]
+        completed = [
+            r
+            for r in broker.records()
+            if r.metadata.intent == WI.ELEMENT_COMPLETED
+            and r.metadata.value_type == ValueType.WORKFLOW_INSTANCE
+            and r.value.activity_id == "map"
+        ][0]
+        assert completed.value.payload == {"order": {"total": 42, "paid": True}}
+
+    def test_input_mapping_error_raises_incident(self, broker, client):
+        model = (
+            Bpmn.create_process("map2")
+            .start_event()
+            .service_task("work", type="t", inputs=[("$.missing", "$.x")])
+            .end_event()
+            .done()
+        )
+        client.deploy_model(model)
+        client.create_instance("map2", {})
+        broker.run_until_idle()
+        incidents = [
+            r
+            for r in broker.records()
+            if r.metadata.value_type == ValueType.INCIDENT
+            and r.metadata.intent == IncidentIntent.CREATED
+        ]
+        assert len(incidents) == 1
+        assert incidents[0].value.error_type == ErrorType.IO_MAPPING_ERROR
+
+
+class TestMessages:
+    def catch_model(self):
+        return (
+            Bpmn.create_process("msg-flow")
+            .start_event()
+            .message_catch_event(
+                "wait", message_name="order-paid", correlation_key="$.orderId"
+            )
+            .end_event()
+            .done()
+        )
+
+    def test_subscription_then_publish_correlates(self, broker, client):
+        client.deploy_model(self.catch_model())
+        client.create_instance("msg-flow", {"orderId": "order-123"})
+        broker.run_until_idle()
+        client.publish_message("order-paid", "order-123", {"amount": 100})
+        broker.run_until_idle()
+        assert wi_intents(broker)[-1] == ("ELEMENT_COMPLETED", "msg-flow")
+        completed = [
+            r
+            for r in broker.records()
+            if r.metadata.intent == WI.ELEMENT_COMPLETED
+            and r.metadata.value_type == ValueType.WORKFLOW_INSTANCE
+            and r.value.activity_id == "msg-flow"
+        ][0]
+        # message payload merges into the scope payload (output mapping merge)
+        assert completed.value.payload == {"orderId": "order-123", "amount": 100}
+
+    def test_publish_before_subscription_with_ttl_correlates(self, broker, client, clock):
+        client.deploy_model(self.catch_model())
+        client.publish_message(
+            "order-paid", "order-9", {"ok": 1}, time_to_live_ms=60_000
+        )
+        broker.run_until_idle()
+        client.create_instance("msg-flow", {"orderId": "order-9"})
+        broker.run_until_idle()
+        assert wi_intents(broker)[-1] == ("ELEMENT_COMPLETED", "msg-flow")
+
+    def test_publish_without_ttl_is_deleted_immediately(self, broker, client):
+        client.deploy_model(self.catch_model())
+        client.publish_message("order-paid", "order-9", {"ok": 1})
+        broker.run_until_idle()
+        client.create_instance("msg-flow", {"orderId": "order-9"})
+        broker.run_until_idle()
+        # message was not buffered → instance still waiting
+        intents = wi_intents(broker)
+        assert ("ELEMENT_ACTIVATED", "wait") in intents
+        assert intents[-1] != ("ELEMENT_COMPLETED", "msg-flow")
+
+    def test_message_ttl_expiry(self, broker, client, clock):
+        client.deploy_model(self.catch_model())
+        client.publish_message("order-paid", "o1", {}, time_to_live_ms=1_000)
+        broker.run_until_idle()
+        clock.advance(5_000)
+        broker.tick()
+        broker.run_until_idle()
+        deleted = [
+            r
+            for r in broker.records()
+            if r.metadata.value_type == ValueType.MESSAGE
+            and r.metadata.intent == MessageIntent.DELETED
+        ]
+        assert len(deleted) == 1
+        # late instance does not correlate
+        client.create_instance("msg-flow", {"orderId": "o1"})
+        broker.run_until_idle()
+        assert wi_intents(broker)[-1] != ("ELEMENT_COMPLETED", "msg-flow")
+
+    def test_duplicate_message_id_rejected(self, broker, client):
+        client.deploy_model(self.catch_model())
+        client.publish_message("order-paid", "o1", {}, time_to_live_ms=60_000, message_id="m1")
+        with pytest.raises(ClientException, match="already published"):
+            client.publish_message(
+                "order-paid", "o1", {}, time_to_live_ms=60_000, message_id="m1"
+            )
+
+    def test_multi_partition_correlation(self, tmp_path, clock):
+        broker = Broker(num_partitions=4, data_dir=str(tmp_path / "mp"), clock=clock)
+        client = ZeebeClient(broker)
+        client.deploy_model(self.catch_model())
+        instance = client.create_instance(
+            "msg-flow", {"orderId": "corr-xyz"}, partition_id=2
+        )
+        broker.run_until_idle()
+        client.publish_message("order-paid", "corr-xyz", {"done": 1})
+        broker.run_until_idle()
+        assert wi_intents(broker, 2)[-1] == ("ELEMENT_COMPLETED", "msg-flow")
+        broker.close()
+
+
+class TestTimers:
+    def test_timer_catch_event_fires(self, broker, client, clock):
+        model = (
+            Bpmn.create_process("timed")
+            .start_event()
+            .timer_catch_event("wait", duration_ms=10_000)
+            .end_event()
+            .done()
+        )
+        client.deploy_model(model)
+        client.create_instance("timed")
+        broker.run_until_idle()
+        intents = wi_intents(broker)
+        assert ("ELEMENT_ACTIVATED", "wait") in intents
+        assert intents[-1] != ("ELEMENT_COMPLETED", "timed")
+        clock.advance(11_000)
+        broker.tick()
+        broker.run_until_idle()
+        assert wi_intents(broker)[-1] == ("ELEMENT_COMPLETED", "timed")
+        triggered = [
+            r
+            for r in broker.records()
+            if r.metadata.value_type == ValueType.TIMER
+            and r.metadata.intent == TimerIntent.TRIGGERED
+        ]
+        assert len(triggered) == 1
+
+
+class TestSubProcess:
+    def test_subprocess_completes(self, broker, client):
+        b = Bpmn.create_process("outer").start_event("s")
+        sub = b.sub_process("sub")
+        sub.start_event("ss").service_task("inner", type="t").end_event("se")
+        sub.embedded_done().end_event("e")
+        client.deploy_model(b.done())
+        JobWorker(broker, "t", lambda ctx: {"done": 1})
+        client.create_instance("outer", {"in": 1})
+        broker.run_until_idle()
+        intents = wi_intents(broker)
+        assert ("ELEMENT_READY", "sub") in intents
+        assert ("ELEMENT_ACTIVATED", "sub") in intents
+        assert ("START_EVENT_OCCURRED", "ss") in intents
+        assert ("ELEMENT_COMPLETED", "sub") in intents
+        assert intents[-1] == ("ELEMENT_COMPLETED", "outer")
+        completed = [
+            r
+            for r in broker.records()
+            if r.metadata.intent == WI.ELEMENT_COMPLETED
+            and r.metadata.value_type == ValueType.WORKFLOW_INSTANCE
+            and r.value.activity_id == "outer"
+        ][0]
+        assert completed.value.payload == {"in": 1, "done": 1}
+
+
+class TestUpdatePayload:
+    def test_update_payload(self, broker, client):
+        client.deploy_model(order_process_model())
+        instance = client.create_instance("order-process", {"a": 1})
+        broker.run_until_idle()
+        response = client.update_payload(instance.workflow_instance_key, {"a": 2})
+        assert response.metadata.intent == WI.PAYLOAD_UPDATED
+        assert response.value.payload == {"a": 2}
+
+    def test_update_payload_unknown_instance_rejected(self, broker, client):
+        with pytest.raises(ClientException, match="not running"):
+            client.update_payload(99999, {})

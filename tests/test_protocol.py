@@ -1,0 +1,122 @@
+"""Record value + frame codec tests (reference: protocol SBE round trips)."""
+
+from zeebe_tpu.protocol import (
+    JobIntent,
+    RecordType,
+    RejectionType,
+    ValueType,
+    WorkflowInstanceIntent,
+)
+from zeebe_tpu.protocol.codec import decode_record, encode_record
+from zeebe_tpu.protocol.metadata import RecordMetadata
+from zeebe_tpu.protocol.records import (
+    JobHeaders,
+    JobRecord,
+    Record,
+    WorkflowInstanceRecord,
+)
+
+
+def make_record():
+    return Record(
+        position=42,
+        source_record_position=41,
+        key=7,
+        timestamp=123456789,
+        producer_id=3,
+        raft_term=2,
+        metadata=RecordMetadata(
+            record_type=RecordType.EVENT,
+            value_type=ValueType.WORKFLOW_INSTANCE,
+            intent=int(WorkflowInstanceIntent.ELEMENT_ACTIVATED),
+            request_id=99,
+            request_stream_id=5,
+        ),
+        value=WorkflowInstanceRecord(
+            bpmn_process_id="order-process",
+            version=1,
+            workflow_key=11,
+            workflow_instance_key=7,
+            activity_id="collect-money",
+            payload={"orderId": 31243, "orderValue": 99.5},
+            scope_instance_key=7,
+        ),
+    )
+
+
+def test_frame_round_trip():
+    record = make_record()
+    frame = encode_record(record)
+    assert len(frame) % 8 == 0
+    decoded, consumed = decode_record(frame)
+    assert consumed == len(frame)
+    assert decoded.position == 42
+    assert decoded.key == 7
+    assert decoded.metadata.record_type == RecordType.EVENT
+    assert decoded.metadata.value_type == ValueType.WORKFLOW_INSTANCE
+    assert decoded.metadata.intent == WorkflowInstanceIntent.ELEMENT_ACTIVATED
+    assert decoded.metadata.request_id == 99
+    assert decoded.value.bpmn_process_id == "order-process"
+    assert decoded.value.payload == {"orderId": 31243, "orderValue": 99.5}
+
+
+def test_rejection_frame():
+    record = make_record()
+    record.metadata.record_type = RecordType.COMMAND_REJECTION
+    record.metadata.rejection_type = RejectionType.NOT_APPLICABLE
+    record.metadata.rejection_reason = "Workflow instance is not running"
+    decoded, _ = decode_record(encode_record(record))
+    assert decoded.metadata.rejection_type == RejectionType.NOT_APPLICABLE
+    assert decoded.metadata.rejection_reason == "Workflow instance is not running"
+
+
+def test_job_record_document_keys_match_reference():
+    job = JobRecord(
+        type="payment-service",
+        retries=3,
+        payload={"total": 100},
+        headers=JobHeaders(
+            workflow_instance_key=7,
+            bpmn_process_id="order-process",
+            activity_id="collect-money",
+            activity_instance_key=9,
+        ),
+        custom_headers={"method": "VISA"},
+    )
+    doc = job.to_document()
+    # keys must match reference JobRecord.java / JobHeaders.java property names
+    assert set(doc.keys()) == {
+        "deadline",
+        "worker",
+        "retries",
+        "type",
+        "headers",
+        "customHeaders",
+        "payload",
+    }
+    assert doc["headers"]["workflowInstanceKey"] == 7
+    assert doc["headers"]["bpmnProcessId"] == "order-process"
+    round_tripped = JobRecord.decode(job.encode())
+    assert round_tripped == job
+
+
+def test_workflow_instance_record_keys_match_reference():
+    doc = make_record().value.to_document()
+    assert set(doc.keys()) == {
+        "bpmnProcessId",
+        "version",
+        "workflowKey",
+        "workflowInstanceKey",
+        "activityId",
+        "payload",
+        "scopeInstanceKey",
+    }
+
+
+def test_multiple_frames_in_buffer():
+    r1, r2 = make_record(), make_record()
+    r2.position = 43
+    buf = encode_record(r1) + encode_record(r2)
+    d1, o = decode_record(buf, 0)
+    d2, o2 = decode_record(buf, o)
+    assert d1.position == 42 and d2.position == 43 and o2 == len(buf)

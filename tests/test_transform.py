@@ -1,0 +1,149 @@
+"""Transform tests: BPMN model → executable workflow with step bindings.
+
+Reference parity: the transform handlers' bindLifecycle tables
+(broker-core/.../workflow/model/transformation/handler/*.java).
+"""
+
+from zeebe_tpu.models.bpmn.builder import Bpmn
+from zeebe_tpu.models.bpmn.model import ElementType
+from zeebe_tpu.models.transform import BpmnStep, transform_model
+from zeebe_tpu.protocol.intents import WorkflowInstanceIntent as WI
+
+
+def transform_one(model):
+    workflows = transform_model(model)
+    assert len(workflows) == 1
+    return workflows[0]
+
+
+def order_process_workflow():
+    return transform_one(
+        Bpmn.create_process("order-process")
+        .start_event("start")
+        .service_task("collect-money", type="payment-service")
+        .end_event("end")
+        .done()
+    )
+
+
+class TestStepBindings:
+    def test_process_bindings(self):
+        wf = order_process_workflow()
+        root = wf.root
+        assert root.element_type == ElementType.PROCESS
+        assert root.get_step(WI.ELEMENT_READY) == BpmnStep.APPLY_INPUT_MAPPING
+        assert root.get_step(WI.ELEMENT_ACTIVATED) == BpmnStep.TRIGGER_START_EVENT
+        assert root.get_step(WI.ELEMENT_COMPLETING) == BpmnStep.COMPLETE_PROCESS
+        assert (
+            root.get_step(WI.ELEMENT_TERMINATING)
+            == BpmnStep.TERMINATE_CONTAINED_INSTANCES
+        )
+
+    def test_service_task_bindings(self):
+        wf = order_process_workflow()
+        task = wf.element_by_id("collect-money")
+        assert task.get_step(WI.ELEMENT_READY) == BpmnStep.APPLY_INPUT_MAPPING
+        assert task.get_step(WI.ELEMENT_ACTIVATED) == BpmnStep.CREATE_JOB
+        assert task.get_step(WI.ELEMENT_COMPLETING) == BpmnStep.APPLY_OUTPUT_MAPPING
+        assert task.get_step(WI.ELEMENT_COMPLETED) == BpmnStep.TAKE_SEQUENCE_FLOW
+        assert task.get_step(WI.ELEMENT_TERMINATING) == BpmnStep.TERMINATE_JOB_TASK
+        assert task.get_step(WI.ELEMENT_TERMINATED) == BpmnStep.PROPAGATE_TERMINATION
+        assert task.job_type == "payment-service"
+
+    def test_start_end_event_bindings(self):
+        wf = order_process_workflow()
+        start = wf.element_by_id("start")
+        end = wf.element_by_id("end")
+        assert start.get_step(WI.START_EVENT_OCCURRED) == BpmnStep.TAKE_SEQUENCE_FLOW
+        assert end.get_step(WI.END_EVENT_OCCURRED) == BpmnStep.CONSUME_TOKEN
+        assert wf.root.start_event is start
+
+    def test_sequence_flow_bindings(self):
+        wf = order_process_workflow()
+        start = wf.element_by_id("start")
+        to_task = start.outgoing[0]
+        assert to_task.get_step(WI.SEQUENCE_FLOW_TAKEN) == BpmnStep.START_STATEFUL_ELEMENT
+        task = wf.element_by_id("collect-money")
+        to_end = task.outgoing[0]
+        assert to_end.get_step(WI.SEQUENCE_FLOW_TAKEN) == BpmnStep.TRIGGER_END_EVENT
+
+    def test_exclusive_gateway_with_conditions(self):
+        b = Bpmn.create_process("p").start_event().exclusive_gateway("split")
+        b.branch("$.x > 1").end_event("e1")
+        b.branch(default=True).end_event("e2")
+        wf = transform_one(b.done())
+        gw = wf.element_by_id("split")
+        assert gw.get_step(WI.GATEWAY_ACTIVATED) == BpmnStep.EXCLUSIVE_SPLIT
+        assert gw.default_flow is not None
+        assert len(gw.outgoing_with_condition) == 1
+        # flow into a gateway binds ACTIVATE_GATEWAY
+        into_gw = gw.incoming[0]
+        assert into_gw.get_step(WI.SEQUENCE_FLOW_TAKEN) == BpmnStep.ACTIVATE_GATEWAY
+
+    def test_exclusive_gateway_without_conditions_takes_flow(self):
+        b = Bpmn.create_process("p").start_event().exclusive_gateway("gw")
+        b.branch().end_event("e")
+        wf = transform_one(b.done())
+        gw = wf.element_by_id("gw")
+        assert gw.get_step(WI.GATEWAY_ACTIVATED) == BpmnStep.TAKE_SEQUENCE_FLOW
+
+    def test_parallel_gateway_fork_join(self):
+        b = Bpmn.create_process("p").start_event().parallel_gateway("fork")
+        branch1 = b.branch().service_task("a", type="t")
+        branch2 = b.branch().service_task("c", type="t")
+        branch1.parallel_gateway("join")
+        branch2.connect_to("join")
+        b.move_to("join").end_event("end")
+        wf = transform_one(b.done())
+        fork = wf.element_by_id("fork")
+        join = wf.element_by_id("join")
+        assert fork.get_step(WI.GATEWAY_ACTIVATED) == BpmnStep.PARALLEL_SPLIT
+        # flows into the join bind PARALLEL_MERGE
+        for flow in join.incoming:
+            assert flow.get_step(WI.SEQUENCE_FLOW_TAKEN) == BpmnStep.PARALLEL_MERGE
+        # join itself activates normally once merged
+        assert join.get_step(WI.GATEWAY_ACTIVATED) == BpmnStep.TAKE_SEQUENCE_FLOW
+
+    def test_subprocess_bindings(self):
+        b = Bpmn.create_process("p").start_event("s")
+        sub = b.sub_process("sub")
+        sub.start_event("ss").end_event("se")
+        sub.embedded_done().end_event("e")
+        wf = transform_one(b.done())
+        sub_el = wf.element_by_id("sub")
+        assert sub_el.get_step(WI.ELEMENT_ACTIVATED) == BpmnStep.TRIGGER_START_EVENT
+        assert sub_el.get_step(WI.ELEMENT_READY) == BpmnStep.APPLY_INPUT_MAPPING
+        assert sub_el.start_event is wf.element_by_id("ss")
+        assert wf.element_by_id("ss").scope_id == "sub"
+
+    def test_message_catch_bindings(self):
+        wf = transform_one(
+            Bpmn.create_process("p")
+            .start_event()
+            .message_catch_event("wait", message_name="m", correlation_key="$.k")
+            .end_event()
+            .done()
+        )
+        catch = wf.element_by_id("wait")
+        assert (
+            catch.get_step(WI.ELEMENT_ACTIVATED)
+            == BpmnStep.SUBSCRIBE_TO_INTERMEDIATE_MESSAGE
+        )
+        assert catch.message_name == "m"
+        assert catch.correlation_key_path == "$.k"
+
+    def test_timer_catch_bindings(self):
+        wf = transform_one(
+            Bpmn.create_process("p")
+            .start_event()
+            .timer_catch_event("wait", duration_ms=1000)
+            .end_event()
+            .done()
+        )
+        catch = wf.element_by_id("wait")
+        assert catch.get_step(WI.ELEMENT_ACTIVATED) == BpmnStep.CREATE_TIMER
+
+    def test_element_indices_dense(self):
+        wf = order_process_workflow()
+        assert [e.index for e in wf.elements] == list(range(len(wf.elements)))
+        assert wf.root.index == 0

@@ -1,0 +1,34 @@
+"""zeebe_tpu — a TPU-native workflow-orchestration framework.
+
+A brand-new implementation of the capabilities of the reference system
+(Zeebe tech-preview, a distributed event-sourced BPMN engine; see
+/root/reference) designed idiomatically for TPUs: workflow instances are
+stepped as batched SIMD state transitions by jitted JAX kernels over
+struct-of-arrays state resident in HBM, with the workflow graph compiled
+to tensors, instances sharded data-parallel across a `jax.sharding.Mesh`,
+and an append-only record log on the host for durability and replay parity.
+
+Layer map (mirrors SURVEY.md §1 of the reference analysis):
+
+- ``zeebe_tpu.protocol``  — record model: intents, value types, msgpack
+  codec, typed record values, fixed-layout binary frame codec.
+- ``zeebe_tpu.log``       — append-only segmented log stream with commit
+  positions, readers, snapshots (reference: ``logstreams/``).
+- ``zeebe_tpu.models``    — BPMN model + builder + XML/YAML front-ends,
+  condition expression language, transform to executable graphs and
+  compiled tensors (reference: ``bpmn-model/``, ``json-el/``,
+  ``broker-core/.../workflow/model``).
+- ``zeebe_tpu.engine``    — the stream processors: a host reference
+  interpreter (exact per-record semantics, the correctness oracle) and
+  the batched TPU engine (reference: ``broker-core/.../workflow/processor``,
+  ``logstreams/.../processor``).
+- ``zeebe_tpu.ops``       — kernels: masked compaction, ring buffers,
+  predicate bytecode eval, segment ops.
+- ``zeebe_tpu.parallel``  — mesh sharding, cross-partition correlation
+  collectives (reference: partitions + subscription transport).
+- ``zeebe_tpu.runtime``   — broker assembly, partitions, config, clock.
+- ``zeebe_tpu.gateway``   — client API and job workers (reference:
+  ``gateway/``, ``clients/``).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,11 @@
+"""Stream-processing engines.
+
+Two implementations with one contract (event-replay parity):
+
+- ``zeebe_tpu.engine.interpreter`` — the host reference interpreter: exact
+  per-record semantics mirroring the reference broker's stream processors.
+  It is the correctness oracle in tests and the recovery/replay fallback.
+- ``zeebe_tpu.engine.kernel`` + ``zeebe_tpu.engine.processor`` — the TPU
+  engine: batched SIMD state transitions over struct-of-arrays state by a
+  jitted step kernel, host loop coupling device sweeps to the log.
+"""

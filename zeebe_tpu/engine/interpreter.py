@@ -1,0 +1,1579 @@
+"""Host reference engine: exact per-record stream-processing semantics.
+
+This is the correctness oracle for the TPU engine (event-replay parity) and
+the recovery fallback. It mirrors, processor by processor, the reference
+broker's per-partition stream processors:
+
+- workflow instance processor + BpmnStepProcessor
+  (``broker-core/.../workflow/processor/WorkflowInstanceStreamProcessor.java``,
+  ``BpmnStepProcessor.java`` + the 16 step handlers),
+- job processor + activate-job push processor
+  (``broker-core/.../job/processor/JobInstanceStreamProcessor.java``,
+  ``ActivateJobStreamProcessor.java``),
+- incident processor (``broker-core/.../incident/processor/IncidentStreamProcessor.java``),
+- message processors (``broker-core/.../subscription/message/processor/``),
+- deployment processor (``broker-core/.../system/workflow/repository/processor/``).
+
+Determinism contract (deviation by design, documented): the reference runs
+these processors as independent actors whose interleaving is scheduler
+dependent; here each committed record is routed through the sub-processors
+in one fixed registration order, which yields a canonical serializable
+interleaving. Cross-processor per-entity record order is preserved.
+
+TPU-native extensions beyond the reference engine (per BASELINE.json):
+parallel-gateway fork/join with scope token accounting, timer catch events,
+receive tasks, and message-subscription close on termination.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from zeebe_tpu.engine import keyspace
+from zeebe_tpu.engine.mappings import MappingError, extract, merge
+from zeebe_tpu.models.bpmn.model import ElementType, OutputBehavior
+from zeebe_tpu.models.el.ast import query_json_path
+from zeebe_tpu.models.el.interpreter import ConditionEvalError, evaluate_condition
+from zeebe_tpu.models.transform.executable import (
+    ExecutableFlowElement,
+    ExecutableWorkflow,
+)
+from zeebe_tpu.models.transform.steps import BpmnStep
+from zeebe_tpu.protocol.enums import ErrorType, RecordType, RejectionType, ValueType
+from zeebe_tpu.protocol.intents import (
+    IncidentIntent,
+    JobIntent,
+    MessageIntent,
+    MessageSubscriptionIntent,
+    TimerIntent,
+    WorkflowInstanceIntent as WI,
+    WorkflowInstanceSubscriptionIntent,
+    is_final_state,
+    is_initial_state,
+    can_terminate,
+)
+from zeebe_tpu.protocol.metadata import RecordMetadata
+from zeebe_tpu.protocol.records import (
+    IncidentRecord,
+    JobHeaders,
+    JobRecord,
+    MessageRecord,
+    MessageSubscriptionRecord,
+    Record,
+    TimerRecord,
+    WorkflowInstanceRecord,
+    WorkflowInstanceSubscriptionRecord,
+)
+
+
+# ---------------------------------------------------------------------------
+# state
+# ---------------------------------------------------------------------------
+
+
+class ElementInstance:
+    """Reference: broker-core/.../workflow/index/ElementInstance.java, plus
+    ``active_tokens`` (TPU-native scope token counter for parallel flows)."""
+
+    __slots__ = (
+        "key", "parent", "state", "value", "children", "job_key",
+        "active_tokens", "join_arrivals",
+    )
+
+    def __init__(self, key: int, parent: Optional["ElementInstance"]):
+        self.key = key
+        self.parent = parent
+        self.state: Optional[WI] = None
+        self.value: Optional[WorkflowInstanceRecord] = None
+        self.children: List["ElementInstance"] = []
+        self.job_key = -1
+        self.active_tokens = 0
+        # parallel-join arrival payloads: gateway element idx → {flow idx → payload}
+        self.join_arrivals: Dict[int, Dict[int, dict]] = {}
+        if parent is not None:
+            parent.children.append(self)
+
+    def destroy(self):
+        if self.parent is not None and self in self.parent.children:
+            self.parent.children.remove(self)
+
+    def can_terminate(self) -> bool:
+        return can_terminate(self.state)
+
+
+class ElementInstanceIndex:
+    """Reference: broker-core/.../workflow/index/ElementInstanceIndex.java."""
+
+    def __init__(self):
+        self.instances: Dict[int, ElementInstance] = {}
+
+    def new_instance(
+        self,
+        key: int,
+        value: WorkflowInstanceRecord,
+        state: WI,
+        parent: Optional[ElementInstance] = None,
+    ) -> ElementInstance:
+        instance = ElementInstance(key, parent)
+        instance.state = state
+        instance.value = value.copy()
+        self.instances[key] = instance
+        return instance
+
+    def get(self, key: int) -> Optional[ElementInstance]:
+        return self.instances.get(key)
+
+    def remove(self, key: int) -> None:
+        instance = self.instances.pop(key, None)
+        if instance is not None:
+            instance.destroy()
+
+
+@dataclasses.dataclass
+class JobState:
+    """Reference: JobInstanceStateController short states in RocksDB."""
+
+    state: int  # JobIntent value of the last state event
+    record: JobRecord
+    deadline: int = -1
+
+
+@dataclasses.dataclass
+class JobSubscription:
+    """Reference: broker-core/.../job/processor/JobSubscription.java."""
+
+    subscriber_key: int
+    job_type: str
+    worker: str
+    timeout: int
+    credits: int
+
+
+@dataclasses.dataclass
+class StoredMessage:
+    key: int
+    name: str
+    correlation_key: str
+    time_to_live: int
+    payload: Dict[str, Any]
+    message_id: str
+    deadline: int
+
+
+@dataclasses.dataclass
+class StoredSubscription:
+    message_name: str
+    correlation_key: str
+    workflow_instance_partition_id: int
+    workflow_instance_key: int
+    activity_instance_key: int
+
+
+@dataclasses.dataclass
+class IncidentState:
+    state: int  # CREATED / RESOLVING / DELETING (int of IncidentIntent-ish)
+    incident_event_position: int
+    failure_event_position: int
+
+
+INCIDENT_CREATED = 1
+INCIDENT_RESOLVING = 2
+INCIDENT_DELETING = 3
+
+
+@dataclasses.dataclass
+class TimerState:
+    due_date: int
+    activity_instance_key: int
+    record: TimerRecord
+
+
+class WorkflowRepository:
+    """Deployed workflow store (reference: WorkflowRepositoryIndex on the
+    system partition + per-partition WorkflowCache; here fetches are
+    synchronous in-process, so one shared repository serves all partitions)."""
+
+    def __init__(self):
+        self.by_key: Dict[int, ExecutableWorkflow] = {}
+        self.versions: Dict[str, List[ExecutableWorkflow]] = {}
+
+    def put(self, workflow: ExecutableWorkflow) -> None:
+        self.by_key[workflow.key] = workflow
+        self.versions.setdefault(workflow.id, []).append(workflow)
+
+    def next_version(self, process_id: str) -> int:
+        return len(self.versions.get(process_id, [])) + 1
+
+    def latest(self, process_id: str) -> Optional[ExecutableWorkflow]:
+        versions = self.versions.get(process_id)
+        return versions[-1] if versions else None
+
+    def by_id_and_version(self, process_id: str, version: int) -> Optional[ExecutableWorkflow]:
+        for wf in self.versions.get(process_id, []):
+            if wf.version == version:
+                return wf
+        return None
+
+
+# ---------------------------------------------------------------------------
+# processing result plumbing
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ProcessingResult:
+    """Output of processing one committed record."""
+
+    written: List[Record] = dataclasses.field(default_factory=list)
+    responses: List[Record] = dataclasses.field(default_factory=list)
+    # cross-partition sends (reference: subscription transport messages):
+    # (target_partition_id, record-to-write-as-command)
+    sends: List[Tuple[int, Record]] = dataclasses.field(default_factory=list)
+    # job pushes to subscribers: (subscriber_key, record)
+    pushes: List[Tuple[int, Record]] = dataclasses.field(default_factory=list)
+
+
+def _record(
+    record_type: RecordType,
+    value,
+    intent: int,
+    key: int = -1,
+    source_position: int = -1,
+    metadata_extra: Optional[dict] = None,
+) -> Record:
+    md = RecordMetadata(
+        record_type=record_type,
+        value_type=value.VALUE_TYPE,
+        intent=int(intent),
+    )
+    if metadata_extra:
+        for k, v in metadata_extra.items():
+            setattr(md, k, v)
+    return Record(
+        key=key,
+        source_record_position=source_position,
+        metadata=md,
+        value=value,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+
+class PartitionEngine:
+    """Reference-semantics stream processor for one partition."""
+
+    def __init__(
+        self,
+        partition_id: int = 0,
+        num_partitions: int = 1,
+        repository: Optional[WorkflowRepository] = None,
+        clock: Optional[Callable[[], int]] = None,
+    ):
+        self.partition_id = partition_id
+        self.num_partitions = num_partitions
+        self.repository = repository if repository is not None else WorkflowRepository()
+        self.clock = clock or (lambda: 0)
+
+        # key generators (reference KeyGenerator.create*KeyGenerator)
+        self.wf_keys = keyspace.workflow_instance_keys()
+        self.job_keys = keyspace.job_keys()
+        self.incident_keys = keyspace.incident_keys()
+        self.deployment_keys = keyspace.deployment_keys()
+
+        # workflow state
+        self.element_instances = ElementInstanceIndex()
+
+        # job state
+        self.jobs: Dict[int, JobState] = {}
+        self.job_subscriptions: List[JobSubscription] = []
+        self._job_rr_cursor = 0
+
+        # incident state (reference IncidentStreamProcessor maps)
+        self.incidents: Dict[int, IncidentState] = {}
+        self.incident_by_activity: Dict[int, int] = {}
+        self.incident_by_failed_job: Dict[int, int] = {}
+        self.resolving_events: Dict[int, int] = {}  # failure-event position → incident key
+        self.incident_records: Dict[int, IncidentRecord] = {}
+
+        # message state (this partition acting as message partition)
+        self.messages: Dict[int, StoredMessage] = {}
+        self.message_subscriptions: List[StoredSubscription] = []
+
+        # timers (TPU-native)
+        self.timers: Dict[int, TimerState] = {}
+
+        # log access for position-based reads (reference TypedStreamReader)
+        self.records_by_position: Dict[int, Record] = {}
+
+        self.last_processed_position = -1
+
+    # -- partition routing (reference SubscriptionCommandSender:96-108) ----
+    def partition_for_correlation_key(self, correlation_key: str) -> int:
+        return _correlation_hash(correlation_key) % self.num_partitions
+
+    # ------------------------------------------------------------------
+    # main entry: process one committed record
+    # ------------------------------------------------------------------
+    def process(self, record: Record) -> ProcessingResult:
+        self.records_by_position[record.position] = record
+        out = ProcessingResult()
+        vt = record.metadata.value_type
+        rt = record.metadata.record_type
+        intent = record.metadata.intent
+
+        if vt == ValueType.DEPLOYMENT and rt == RecordType.COMMAND:
+            self._process_deployment(record, out)
+        elif vt == ValueType.WORKFLOW_INSTANCE:
+            self._process_workflow_instance(record, out)
+            self._incident_on_workflow_record(record, out)
+        elif vt == ValueType.JOB:
+            if rt == RecordType.COMMAND:
+                self._process_job_command(record, out)
+            else:
+                self._workflow_on_job_event(record, out)
+                self._activate_jobs_on_event(record, out)
+                self._incident_on_job_event(record, out)
+        elif vt == ValueType.INCIDENT:
+            self._process_incident(record, out)
+        elif vt == ValueType.MESSAGE and rt == RecordType.COMMAND:
+            self._process_message_command(record, out)
+        elif vt == ValueType.MESSAGE_SUBSCRIPTION and rt == RecordType.COMMAND:
+            self._process_message_subscription(record, out)
+        elif vt == ValueType.WORKFLOW_INSTANCE_SUBSCRIPTION and rt == RecordType.COMMAND:
+            self._process_wi_subscription(record, out)
+        elif vt == ValueType.TIMER and rt == RecordType.COMMAND:
+            self._process_timer(record, out)
+
+        self.last_processed_position = record.position
+        return out
+
+    # ------------------------------------------------------------------
+    # writers (reference TypedStreamWriter / ElementInstanceWriter)
+    # ------------------------------------------------------------------
+    def _write_new_wi_event(
+        self, out: ProcessingResult, source: Record, state: WI, value: WorkflowInstanceRecord
+    ) -> int:
+        """Reference ElementInstanceWriter.writeNewEvent."""
+        key = self.wf_keys.next_key()
+        out.written.append(
+            _record(RecordType.EVENT, value.copy(), state, key, source.position)
+        )
+        if is_initial_state(state):
+            scope_key = value.scope_instance_key
+            parent = self.element_instances.get(scope_key) if scope_key >= 0 else None
+            self.element_instances.new_instance(key, value, state, parent)
+        return key
+
+    def _write_wi_followup(
+        self, out: ProcessingResult, source: Record, key: int, state: WI,
+        value: WorkflowInstanceRecord, metadata_extra: Optional[dict] = None,
+    ) -> None:
+        """Reference ElementInstanceWriter.writeFollowUpEvent."""
+        out.written.append(
+            _record(RecordType.EVENT, value.copy(), state, key, source.position, metadata_extra)
+        )
+        if is_final_state(state):
+            self.element_instances.remove(key)
+        else:
+            instance = self.element_instances.get(key)
+            if instance is not None:
+                instance.state = state
+                instance.value = value.copy()
+
+    # ------------------------------------------------------------------
+    # deployment (reference DeploymentCreateEventProcessor)
+    # ------------------------------------------------------------------
+    def _process_deployment(self, record: Record, out: ProcessingResult) -> None:
+        from zeebe_tpu.models.bpmn.validation import validate_model
+        from zeebe_tpu.models.bpmn.xml import read_model
+        from zeebe_tpu.models.bpmn.yaml_front import read_yaml_workflow
+        from zeebe_tpu.models.transform.transformer import transform_model
+        from zeebe_tpu.protocol.intents import DeploymentIntent
+        from zeebe_tpu.protocol.records import DeployedWorkflowMeta
+
+        deployment = record.value
+        deployed: List[ExecutableWorkflow] = []
+        try:
+            for resource in deployment.resources:
+                data = resource.resource
+                if isinstance(data, str):
+                    data = data.encode("utf-8")
+                if resource.resource_type == "YAML_WORKFLOW":
+                    model = read_yaml_workflow(data.decode("utf-8"))
+                else:
+                    model = read_model(data)
+                errors = validate_model(model)
+                if errors:
+                    raise ValueError("; ".join(str(e) for e in errors))
+                deployed.extend(transform_model(model))
+        except Exception as e:  # malformed resource → rejection
+            out.written.append(
+                _record(
+                    RecordType.COMMAND_REJECTION,
+                    deployment,
+                    DeploymentIntent.CREATE,
+                    record.key,
+                    record.position,
+                    {
+                        "rejection_type": RejectionType.BAD_VALUE,
+                        "rejection_reason": str(e),
+                        "request_id": record.metadata.request_id,
+                        "request_stream_id": record.metadata.request_stream_id,
+                    },
+                )
+            )
+            out.responses.append(out.written[-1])
+            return
+
+        key = self.deployment_keys.next_key()
+        deployment.deployed_workflows = []
+        for wf in deployed:
+            wf.version = self.repository.next_version(wf.id)
+            wf.key = self.deployment_keys.next_key()
+            self.repository.put(wf)
+            deployment.deployed_workflows.append(
+                DeployedWorkflowMeta(
+                    bpmn_process_id=wf.id, version=wf.version, key=wf.key
+                )
+            )
+        created = _record(
+            RecordType.EVENT,
+            deployment,
+            DeploymentIntent.CREATED,
+            key,
+            record.position,
+            {
+                "request_id": record.metadata.request_id,
+                "request_stream_id": record.metadata.request_stream_id,
+            },
+        )
+        out.written.append(created)
+        out.responses.append(created)
+
+    # ------------------------------------------------------------------
+    # workflow instance records
+    # ------------------------------------------------------------------
+    def _process_workflow_instance(self, record: Record, out: ProcessingResult) -> None:
+        intent = WI(record.metadata.intent)
+        rt = record.metadata.record_type
+        if rt == RecordType.COMMAND:
+            if intent == WI.CREATE:
+                self._create_workflow_instance(record, out)
+            elif intent == WI.CANCEL:
+                self._cancel_workflow_instance(record, out)
+            elif intent == WI.UPDATE_PAYLOAD:
+                self._update_payload(record, out)
+            return
+        if rt != RecordType.EVENT:
+            return
+        if intent == WI.CREATED:
+            # reference WorkflowInstanceCreatedEventProcessor
+            self.element_instances.new_instance(record.key, record.value, WI.ELEMENT_READY)
+            out.responses.append(record)
+            return
+        if intent in (
+            WI.SEQUENCE_FLOW_TAKEN,
+            WI.ELEMENT_READY,
+            WI.ELEMENT_ACTIVATED,
+            WI.ELEMENT_COMPLETING,
+            WI.ELEMENT_COMPLETED,
+            WI.ELEMENT_TERMINATING,
+            WI.ELEMENT_TERMINATED,
+            WI.START_EVENT_OCCURRED,
+            WI.END_EVENT_OCCURRED,
+            WI.GATEWAY_ACTIVATED,
+        ):
+            self._bpmn_step(record, intent, out)
+
+    def _create_workflow_instance(self, command: Record, out: ProcessingResult) -> None:
+        """Reference CreateWorkflowInstanceEventProcessor (fetches are
+        synchronous here; key generated before lookup for replay parity)."""
+        value: WorkflowInstanceRecord = command.value.copy()
+        wf_instance_key = self.wf_keys.next_key()
+        value.workflow_instance_key = wf_instance_key
+
+        workflow = None
+        if value.workflow_key > 0:
+            workflow = self.repository.by_key.get(value.workflow_key)
+        elif value.version > 0:
+            workflow = self.repository.by_id_and_version(value.bpmn_process_id, value.version)
+        else:
+            workflow = self.repository.latest(value.bpmn_process_id)
+
+        md_extra = {
+            "request_id": command.metadata.request_id,
+            "request_stream_id": command.metadata.request_stream_id,
+        }
+        if workflow is None:
+            out.written.append(
+                _record(
+                    RecordType.COMMAND_REJECTION,
+                    value,
+                    WI.CREATE,
+                    command.key,
+                    command.position,
+                    {
+                        "rejection_type": RejectionType.BAD_VALUE,
+                        "rejection_reason": "Workflow is not deployed",
+                        **md_extra,
+                    },
+                )
+            )
+            out.responses.append(out.written[-1])
+            return
+
+        value.workflow_key = workflow.key
+        value.version = workflow.version
+        value.bpmn_process_id = workflow.id
+        value.activity_id = workflow.id
+        # batch: CREATED (with request metadata) + ELEMENT_READY
+        out.written.append(
+            _record(RecordType.EVENT, value.copy(), WI.CREATED, wf_instance_key,
+                    command.position, md_extra)
+        )
+        out.written.append(
+            _record(RecordType.EVENT, value.copy(), WI.ELEMENT_READY, wf_instance_key,
+                    command.position)
+        )
+        # index entry is created when the CREATED event is processed
+
+    def _cancel_workflow_instance(self, command: Record, out: ProcessingResult) -> None:
+        """Reference CancelWorkflowInstanceProcessor."""
+        instance = self.element_instances.get(command.key)
+        if instance is None or not instance.can_terminate():
+            rejection = _record(
+                RecordType.COMMAND_REJECTION,
+                command.value,
+                WI.CANCEL,
+                command.key,
+                command.position,
+                {
+                    "rejection_type": RejectionType.NOT_APPLICABLE,
+                    "rejection_reason": "Workflow instance is not running",
+                    "request_id": command.metadata.request_id,
+                    "request_stream_id": command.metadata.request_stream_id,
+                },
+            )
+            out.written.append(rejection)
+            out.responses.append(rejection)
+            return
+        value = instance.value.copy()
+        value.payload = {}
+        out.written.append(
+            _record(RecordType.EVENT, value.copy(), WI.CANCELING, command.key,
+                    command.position,
+                    {
+                        "request_id": command.metadata.request_id,
+                        "request_stream_id": command.metadata.request_stream_id,
+                    })
+        )
+        out.responses.append(out.written[-1])
+        out.written.append(
+            _record(RecordType.EVENT, value.copy(), WI.ELEMENT_TERMINATING, command.key,
+                    command.position)
+        )
+        instance.state = WI.ELEMENT_TERMINATING
+
+    def _update_payload(self, command: Record, out: ProcessingResult) -> None:
+        """Reference UpdatePayloadProcessor."""
+        value: WorkflowInstanceRecord = command.value
+        instance = self.element_instances.get(value.workflow_instance_key)
+        md_extra = {
+            "request_id": command.metadata.request_id,
+            "request_stream_id": command.metadata.request_stream_id,
+        }
+        if instance is None:
+            rejection = _record(
+                RecordType.COMMAND_REJECTION, value, WI.UPDATE_PAYLOAD,
+                command.key, command.position,
+                {
+                    "rejection_type": RejectionType.NOT_APPLICABLE,
+                    "rejection_reason": "Workflow instance is not running",
+                    **md_extra,
+                },
+            )
+            out.written.append(rejection)
+            out.responses.append(rejection)
+            return
+        instance.value.payload = dict(value.payload)
+        event = _record(
+            RecordType.EVENT, instance.value.copy(), WI.PAYLOAD_UPDATED,
+            command.key, command.position, md_extra,
+        )
+        out.written.append(event)
+        out.responses.append(event)
+
+    # ------------------------------------------------------------------
+    # BPMN step dispatch (reference BpmnStepProcessor)
+    # ------------------------------------------------------------------
+    def _bpmn_step(self, record: Record, intent: WI, out: ProcessingResult) -> None:
+        value: WorkflowInstanceRecord = record.value
+        workflow = self.repository.by_key.get(value.workflow_key)
+        if workflow is None:
+            return
+
+        element = workflow.element_by_id(value.activity_id)
+        if element is None:
+            return
+
+        instance = self.element_instances.get(record.key)
+        scope_instance = self.element_instances.get(value.scope_instance_key)
+
+        # reference shallProcessRecord: skip finished instances
+        if instance is None and scope_instance is None:
+            return
+        if not self._step_guard(intent, record, instance, scope_instance):
+            return
+
+        step = element.get_step(intent)
+        if step == BpmnStep.NONE:
+            return
+
+        handler = self._STEP_HANDLERS[step]
+        handler(self, record, element, workflow, instance, scope_instance, out)
+
+    def _step_guard(
+        self,
+        intent: WI,
+        record: Record,
+        instance: Optional[ElementInstance],
+        scope: Optional[ElementInstance],
+    ) -> bool:
+        """Reference BpmnStepProcessor stepGuards (BpmnStepProcessor.java:127-151)."""
+        if intent in (WI.ELEMENT_READY, WI.ELEMENT_ACTIVATED, WI.ELEMENT_COMPLETING):
+            return instance is not None and instance.state == intent
+        if intent == WI.ELEMENT_COMPLETED:
+            return scope is not None and scope.state == WI.ELEMENT_ACTIVATED
+        if intent == WI.ELEMENT_TERMINATING:
+            return True
+        if intent == WI.ELEMENT_TERMINATED:
+            return scope is not None and scope.state == WI.ELEMENT_TERMINATING
+        if intent in (
+            WI.END_EVENT_OCCURRED,
+            WI.GATEWAY_ACTIVATED,
+            WI.START_EVENT_OCCURRED,
+            WI.SEQUENCE_FLOW_TAKEN,
+        ):
+            return scope is not None and scope.state == WI.ELEMENT_ACTIVATED
+        return True
+
+    # -- step handlers ----------------------------------------------------
+    def _raise_incident(
+        self, record: Record, error_type: ErrorType, message: str, out: ProcessingResult
+    ) -> None:
+        """Reference BpmnStepContext.raiseIncident."""
+        value: WorkflowInstanceRecord = record.value
+        incident = IncidentRecord(
+            error_type=int(error_type),
+            error_message=message,
+            failure_event_position=record.position,
+            bpmn_process_id=value.bpmn_process_id,
+            workflow_instance_key=value.workflow_instance_key,
+            activity_id=value.activity_id,
+            activity_instance_key=record.key,
+            payload=dict(value.payload),
+        )
+        if record.metadata.incident_key < 0:
+            out.written.append(
+                _record(RecordType.COMMAND, incident, IncidentIntent.CREATE, -1, record.position)
+            )
+        else:
+            out.written.append(
+                _record(
+                    RecordType.EVENT, incident, IncidentIntent.RESOLVE_FAILED,
+                    record.metadata.incident_key, record.position,
+                )
+            )
+
+    def _h_take_sequence_flow(self, record, element, workflow, instance, scope, out):
+        # reference TakeSequenceFlowHandler: exactly one outgoing flow
+        flow = element.outgoing[0]
+        value = record.value.copy()
+        value.activity_id = flow.id
+        self._write_new_wi_event(out, record, WI.SEQUENCE_FLOW_TAKEN, value)
+
+    def _h_consume_token(self, record, element, workflow, instance, scope, out):
+        # reference ConsumeTokenHandler, extended with token counting for
+        # parallel flows: the scope completes when its last token is consumed
+        value: WorkflowInstanceRecord = record.value
+        scope_value = scope.value
+        scope_value.payload = dict(value.payload)
+        scope.active_tokens -= 1
+        if scope.active_tokens <= 0:
+            self._write_wi_followup(out, record, scope.key, WI.ELEMENT_COMPLETING, scope_value)
+
+    def _h_exclusive_split(self, record, element, workflow, instance, scope, out):
+        # reference ExclusiveSplitHandler
+        value: WorkflowInstanceRecord = record.value
+        try:
+            taken = None
+            for flow in element.outgoing_with_condition:
+                if evaluate_condition(flow.condition, value.payload):
+                    taken = flow
+                    break
+            if taken is None:
+                taken = element.default_flow
+            if taken is not None:
+                new_value = value.copy()
+                new_value.activity_id = taken.id
+                self._write_new_wi_event(out, record, WI.SEQUENCE_FLOW_TAKEN, new_value)
+            else:
+                self._raise_incident(
+                    record,
+                    ErrorType.CONDITION_ERROR,
+                    "All conditions evaluated to false and no default flow is set.",
+                    out,
+                )
+        except ConditionEvalError as e:
+            self._raise_incident(record, ErrorType.CONDITION_ERROR, str(e), out)
+
+    def _h_create_job(self, record, element, workflow, instance, scope, out):
+        # reference CreateJobHandler
+        value: WorkflowInstanceRecord = record.value
+        job = JobRecord(
+            type=element.job_type,
+            retries=element.job_retries,
+            payload=dict(value.payload),
+            custom_headers=dict(element.job_headers),
+            headers=JobHeaders(
+                bpmn_process_id=value.bpmn_process_id,
+                workflow_definition_version=value.version,
+                workflow_key=value.workflow_key,
+                workflow_instance_key=value.workflow_instance_key,
+                activity_id=element.id,
+                activity_instance_key=record.key,
+            ),
+        )
+        out.written.append(
+            _record(RecordType.COMMAND, job, JobIntent.CREATE, -1, record.position)
+        )
+
+    def _h_apply_input_mapping(self, record, element, workflow, instance, scope, out):
+        # reference InputMappingHandler
+        value = record.value.copy()
+        try:
+            if element.input_mappings:
+                value.payload = extract(value.payload, element.input_mappings)
+            self._write_wi_followup(out, record, record.key, WI.ELEMENT_ACTIVATED, value)
+        except MappingError as e:
+            self._raise_incident(record, ErrorType.IO_MAPPING_ERROR, str(e), out)
+
+    def _h_apply_output_mapping(self, record, element, workflow, instance, scope, out):
+        # reference OutputMappingHandler
+        value = record.value.copy()
+        scope_payload = dict(scope.value.payload) if scope is not None else {}
+        try:
+            if element.output_behavior == OutputBehavior.NONE:
+                value.payload = scope_payload
+            else:
+                if element.output_behavior == OutputBehavior.OVERWRITE:
+                    scope_payload = {}
+                value.payload = merge(value.payload, scope_payload, element.output_mappings)
+            self._write_wi_followup(out, record, record.key, WI.ELEMENT_COMPLETED, value)
+        except MappingError as e:
+            self._raise_incident(record, ErrorType.IO_MAPPING_ERROR, str(e), out)
+
+    def _h_activate_gateway(self, record, element, workflow, instance, scope, out):
+        # reference ActivateGatewayHandler
+        value = record.value.copy()
+        value.activity_id = element.target.id
+        self._write_new_wi_event(out, record, WI.GATEWAY_ACTIVATED, value)
+
+    def _h_start_stateful_element(self, record, element, workflow, instance, scope, out):
+        # reference StartStatefulElementHandler
+        value = record.value.copy()
+        value.activity_id = element.target.id
+        self._write_new_wi_event(out, record, WI.ELEMENT_READY, value)
+
+    def _h_trigger_end_event(self, record, element, workflow, instance, scope, out):
+        # reference TriggerEndEventHandler
+        value = record.value.copy()
+        value.activity_id = element.target.id
+        self._write_new_wi_event(out, record, WI.END_EVENT_OCCURRED, value)
+
+    def _h_trigger_start_event(self, record, element, workflow, instance, scope, out):
+        # reference TriggerStartEventHandler (+ token accounting)
+        start_event = element.start_event
+        value = record.value.copy()
+        value.activity_id = start_event.id
+        value.scope_instance_key = record.key
+        container = self.element_instances.get(record.key)
+        if container is not None:
+            container.active_tokens = 1
+        self._write_new_wi_event(out, record, WI.START_EVENT_OCCURRED, value)
+
+    def _h_complete_process(self, record, element, workflow, instance, scope, out):
+        # reference CompleteProcessHandler
+        self._write_wi_followup(out, record, record.key, WI.ELEMENT_COMPLETED, record.value.copy())
+
+    def _h_terminate_contained(self, record, element, workflow, instance, scope, out):
+        # reference TerminateContainedElementsHandler (extended: terminate all
+        # children, not just the first — multi-token scopes)
+        container = instance
+        if container is None:
+            return
+        if not container.children:
+            self._write_wi_followup(out, record, record.key, WI.ELEMENT_TERMINATED, record.value.copy())
+        else:
+            for child in sorted(container.children, key=lambda c: c.key):
+                if child.can_terminate():
+                    self._write_wi_followup(
+                        out, record, child.key, WI.ELEMENT_TERMINATING, child.value.copy()
+                    )
+
+    def _h_terminate_job_task(self, record, element, workflow, instance, scope, out):
+        # reference TerminateServiceTaskHandler
+        if instance is not None and instance.job_key > 0:
+            job_state = self.jobs.get(instance.job_key)
+            value: WorkflowInstanceRecord = record.value
+            job = JobRecord(
+                type=job_state.record.type if job_state else "",
+                headers=JobHeaders(
+                    bpmn_process_id=value.bpmn_process_id,
+                    workflow_definition_version=value.version,
+                    workflow_instance_key=value.workflow_instance_key,
+                    activity_id=value.activity_id,
+                    activity_instance_key=instance.key,
+                ),
+            )
+            out.written.append(
+                _record(RecordType.COMMAND, job, JobIntent.CANCEL, instance.job_key, record.position)
+            )
+        self._write_wi_followup(out, record, record.key, WI.ELEMENT_TERMINATED, record.value.copy())
+
+    def _h_terminate_element(self, record, element, workflow, instance, scope, out):
+        # reference TerminateElementHandler
+        self._write_wi_followup(out, record, record.key, WI.ELEMENT_TERMINATED, record.value.copy())
+
+    def _h_terminate_catch_event(self, record, element, workflow, instance, scope, out):
+        # TPU-native: close message subscription / cancel timer, then terminate
+        if element.message_name:
+            value: WorkflowInstanceRecord = record.value
+            found, corr_value = query_json_path(value.payload, element.correlation_key_path)
+            if found:
+                target = self.partition_for_correlation_key(str(corr_value))
+                close = MessageSubscriptionRecord(
+                    workflow_instance_partition_id=self.partition_id,
+                    workflow_instance_key=value.workflow_instance_key,
+                    activity_instance_key=record.key,
+                    message_name=element.message_name,
+                    correlation_key=str(corr_value),
+                )
+                out.sends.append(
+                    (target, _record(RecordType.COMMAND, close, MessageSubscriptionIntent.CLOSE))
+                )
+        for timer_key, timer in list(self.timers.items()):
+            if timer.activity_instance_key == record.key:
+                out.written.append(
+                    _record(RecordType.COMMAND, timer.record, TimerIntent.CANCEL,
+                            timer_key, record.position)
+                )
+        self._write_wi_followup(out, record, record.key, WI.ELEMENT_TERMINATED, record.value.copy())
+
+    def _h_propagate_termination(self, record, element, workflow, instance, scope, out):
+        # reference PropagateTerminationHandler
+        if scope is None:
+            return
+        if not scope.children:
+            self._write_wi_followup(out, record, scope.key, WI.ELEMENT_TERMINATED, scope.value.copy())
+
+    def _h_subscribe_to_message(self, record, element, workflow, instance, scope, out):
+        # reference SubscribeMessageHandler: extract correlation key, send
+        # OpenMessageSubscription to the message partition
+        value: WorkflowInstanceRecord = record.value
+        found, corr_value = query_json_path(value.payload, element.correlation_key_path)
+        if not found or not isinstance(corr_value, (str, int)):
+            self._raise_incident(
+                record,
+                ErrorType.IO_MAPPING_ERROR,
+                f"Failed to extract the correlation-key by '{element.correlation_key_path}'",
+                out,
+            )
+            return
+        correlation_key = str(corr_value)
+        target = self.partition_for_correlation_key(correlation_key)
+        sub = MessageSubscriptionRecord(
+            workflow_instance_partition_id=self.partition_id,
+            workflow_instance_key=value.workflow_instance_key,
+            activity_instance_key=record.key,
+            message_name=element.message_name,
+            correlation_key=correlation_key,
+        )
+        out.sends.append(
+            (target, _record(RecordType.COMMAND, sub, MessageSubscriptionIntent.OPEN))
+        )
+
+    def _h_parallel_split(self, record, element, workflow, instance, scope, out):
+        # TPU-native: fork — one SEQUENCE_FLOW_TAKEN per outgoing flow, scope
+        # gains (n-1) tokens
+        if scope is not None:
+            scope.active_tokens += len(element.outgoing) - 1
+        for flow in element.outgoing:
+            value = record.value.copy()
+            value.activity_id = flow.id
+            self._write_new_wi_event(out, record, WI.SEQUENCE_FLOW_TAKEN, value)
+
+    def _h_parallel_merge(self, record, element, workflow, instance, scope, out):
+        # TPU-native: join — count arrivals per (scope, gateway); activate
+        # when all incoming flows have arrived; payloads merge in flow order
+        gateway = element.target
+        if scope is None:
+            return
+        arrivals = scope.join_arrivals.setdefault(gateway.index, {})
+        flow_order = [f.index for f in gateway.incoming]
+        arrivals[element.index] = dict(record.value.payload)
+        if len(arrivals) == len(gateway.incoming):
+            merged: Dict[str, Any] = {}
+            for flow_idx in flow_order:
+                merged.update(arrivals[flow_idx])
+            scope.active_tokens -= len(gateway.incoming) - 1
+            scope.join_arrivals.pop(gateway.index, None)
+            value = record.value.copy()
+            value.activity_id = gateway.id
+            value.payload = merged
+            self._write_new_wi_event(out, record, WI.GATEWAY_ACTIVATED, value)
+
+    def _h_create_timer(self, record, element, workflow, instance, scope, out):
+        # TPU-native: timer catch event
+        due = self.clock() + int(element.timer_duration_ms or 0)
+        timer = TimerRecord(
+            workflow_instance_key=record.value.workflow_instance_key,
+            activity_instance_key=record.key,
+            due_date=due,
+            handler_element_id=element.id,
+        )
+        out.written.append(
+            _record(RecordType.COMMAND, timer, TimerIntent.CREATE, -1, record.position)
+        )
+
+    def _h_cancel_process(self, record, element, workflow, instance, scope, out):
+        pass  # reference BpmnStep.CANCEL_PROCESS is unused in this version
+
+    _STEP_HANDLERS = {
+        BpmnStep.TAKE_SEQUENCE_FLOW: _h_take_sequence_flow,
+        BpmnStep.CONSUME_TOKEN: _h_consume_token,
+        BpmnStep.EXCLUSIVE_SPLIT: _h_exclusive_split,
+        BpmnStep.CREATE_JOB: _h_create_job,
+        BpmnStep.APPLY_INPUT_MAPPING: _h_apply_input_mapping,
+        BpmnStep.APPLY_OUTPUT_MAPPING: _h_apply_output_mapping,
+        BpmnStep.ACTIVATE_GATEWAY: _h_activate_gateway,
+        BpmnStep.START_STATEFUL_ELEMENT: _h_start_stateful_element,
+        BpmnStep.TRIGGER_END_EVENT: _h_trigger_end_event,
+        BpmnStep.SUBSCRIBE_TO_INTERMEDIATE_MESSAGE: _h_subscribe_to_message,
+        BpmnStep.TRIGGER_START_EVENT: _h_trigger_start_event,
+        BpmnStep.COMPLETE_PROCESS: _h_complete_process,
+        BpmnStep.TERMINATE_CONTAINED_INSTANCES: _h_terminate_contained,
+        BpmnStep.TERMINATE_JOB_TASK: _h_terminate_job_task,
+        BpmnStep.TERMINATE_ELEMENT: _h_terminate_element,
+        BpmnStep.PROPAGATE_TERMINATION: _h_propagate_termination,
+        BpmnStep.CANCEL_PROCESS: _h_cancel_process,
+        BpmnStep.PARALLEL_SPLIT: _h_parallel_split,
+        BpmnStep.PARALLEL_MERGE: _h_parallel_merge,
+        BpmnStep.CREATE_TIMER: _h_create_timer,
+        BpmnStep.TERMINATE_CATCH_EVENT: _h_terminate_catch_event,
+    }
+
+    # ------------------------------------------------------------------
+    # job subsystem (reference JobInstanceStreamProcessor)
+    # ------------------------------------------------------------------
+    def _job_response(self, command: Record, intent: JobIntent, value: JobRecord,
+                      out: ProcessingResult, key: int) -> Record:
+        event = _record(
+            RecordType.EVENT, value.copy(), intent, key, command.position,
+            {
+                "request_id": command.metadata.request_id,
+                "request_stream_id": command.metadata.request_stream_id,
+            },
+        )
+        out.written.append(event)
+        if command.metadata.request_id >= 0:
+            out.responses.append(event)
+        return event
+
+    def _job_rejection(self, command: Record, reason: str, out: ProcessingResult,
+                       rejection_type: RejectionType = RejectionType.NOT_APPLICABLE) -> None:
+        rejection = _record(
+            RecordType.COMMAND_REJECTION, command.value, command.metadata.intent,
+            command.key, command.position,
+            {
+                "rejection_type": rejection_type,
+                "rejection_reason": reason,
+                "request_id": command.metadata.request_id,
+                "request_stream_id": command.metadata.request_stream_id,
+            },
+        )
+        out.written.append(rejection)
+        if command.metadata.request_id >= 0:
+            out.responses.append(rejection)
+
+    def _process_job_command(self, command: Record, out: ProcessingResult) -> None:
+        intent = JobIntent(command.metadata.intent)
+        value: JobRecord = command.value
+        job = self.jobs.get(command.key)
+
+        if intent == JobIntent.CREATE:
+            key = self.job_keys.next_key()
+            self.jobs[key] = JobState(state=int(JobIntent.CREATED), record=value.copy())
+            self._job_response(command, JobIntent.CREATED, value, out, key)
+        elif intent == JobIntent.ACTIVATE:
+            # reference ActivateJobProcessor
+            if job is not None and job.state in (
+                int(JobIntent.CREATED), int(JobIntent.FAILED), int(JobIntent.TIMED_OUT)
+            ):
+                job.state = int(JobIntent.ACTIVATED)
+                job.record = value.copy()
+                job.deadline = value.deadline
+                event = _record(RecordType.EVENT, value.copy(), JobIntent.ACTIVATED,
+                                command.key, command.position)
+                out.written.append(event)
+                subscriber_key = command.metadata.request_stream_id
+                out.pushes.append((subscriber_key, event))
+            else:
+                self._job_rejection(
+                    command, "Job is not in one of these states: CREATED, FAILED, TIMED_OUT", out
+                )
+                self._return_job_credit(command.metadata.request_stream_id)
+        elif intent == JobIntent.COMPLETE:
+            if job is not None and job.state in (int(JobIntent.ACTIVATED), int(JobIntent.TIMED_OUT)):
+                # merge the (possibly thin) command value onto the stored job
+                # record so the COMPLETED event carries full headers — the
+                # workflow processor resolves the activity instance from them
+                completed = job.record.copy()
+                completed.payload = dict(value.payload)
+                del self.jobs[command.key]
+                self._job_response(command, JobIntent.COMPLETED, completed, out, command.key)
+            else:
+                self._job_rejection(command, "Job is not in state: ACTIVATED, TIMED_OUT", out)
+        elif intent == JobIntent.FAIL:
+            if job is not None and job.state == int(JobIntent.ACTIVATED):
+                failed = job.record.copy()
+                failed.retries = value.retries
+                if value.payload:
+                    failed.payload = dict(value.payload)
+                job.state = int(JobIntent.FAILED)
+                job.record = failed.copy()
+                self._job_response(command, JobIntent.FAILED, failed, out, command.key)
+            else:
+                self._job_rejection(command, "Job is not in state ACTIVATED", out)
+        elif intent == JobIntent.TIME_OUT:
+            if job is not None and job.state == int(JobIntent.ACTIVATED):
+                job.state = int(JobIntent.TIMED_OUT)
+                self._job_response(command, JobIntent.TIMED_OUT, value, out, command.key)
+            else:
+                self._job_rejection(command, "Job is not in state ACTIVATED", out)
+        elif intent == JobIntent.UPDATE_RETRIES:
+            if job is not None and job.state == int(JobIntent.FAILED):
+                if value.retries > 0:
+                    # respond with the stored job record (the reference client
+                    # echoes the full job record in the command; a thin client
+                    # may send only retries)
+                    job.record.retries = value.retries
+                    self._job_response(
+                        command, JobIntent.RETRIES_UPDATED, job.record, out, command.key
+                    )
+                else:
+                    self._job_rejection(
+                        command, "Retries must be greater than 0", out, RejectionType.BAD_VALUE
+                    )
+            else:
+                self._job_rejection(command, "Job is not in state FAILED", out)
+        elif intent == JobIntent.CANCEL:
+            if job is not None:
+                del self.jobs[command.key]
+                self._job_response(command, JobIntent.CANCELED, value, out, command.key)
+            else:
+                self._job_rejection(command, "Job does not exist", out)
+
+    def _workflow_on_job_event(self, record: Record, out: ProcessingResult) -> None:
+        """Reference JobCreatedProcessor / JobCompletedEventProcessor in the
+        workflow instance stream processor."""
+        intent = JobIntent(record.metadata.intent)
+        value: JobRecord = record.value
+        activity_instance_key = value.headers.activity_instance_key
+        if intent == JobIntent.CREATED:
+            if activity_instance_key > 0:
+                instance = self.element_instances.get(activity_instance_key)
+                if instance is not None:
+                    instance.job_key = record.key
+        elif intent == JobIntent.COMPLETED:
+            instance = self.element_instances.get(activity_instance_key)
+            if instance is not None:
+                wi_value = instance.value
+                wi_value.payload = dict(value.payload)
+                self._write_wi_followup(
+                    out, record, activity_instance_key, WI.ELEMENT_COMPLETING, wi_value
+                )
+                instance.job_key = -1
+
+    def _activate_jobs_on_event(self, record: Record, out: ProcessingResult) -> None:
+        """Reference ActivateJobStreamProcessor (push with credits)."""
+        intent = JobIntent(record.metadata.intent)
+        if intent not in (
+            JobIntent.CREATED, JobIntent.TIMED_OUT, JobIntent.FAILED, JobIntent.RETRIES_UPDATED
+        ):
+            return
+        value: JobRecord = record.value
+        if value.retries <= 0:
+            return
+        subscription = self._next_job_subscription(value.type)
+        if subscription is None:
+            return
+        activated = value.copy()
+        activated.deadline = self.clock() + subscription.timeout
+        activated.worker = subscription.worker
+        out.written.append(
+            _record(
+                RecordType.COMMAND, activated, JobIntent.ACTIVATE, record.key, record.position,
+                {"request_stream_id": subscription.subscriber_key},
+            )
+        )
+        subscription.credits -= 1
+
+    def _next_job_subscription(self, job_type: str) -> Optional[JobSubscription]:
+        """Round-robin over subscriptions with credits (reference
+        getNextAvailableSubscription)."""
+        matching = [s for s in self.job_subscriptions if s.job_type == job_type]
+        if not matching or sum(s.credits for s in matching) <= 0:
+            return None
+        for i in range(len(matching)):
+            sub = matching[(self._job_rr_cursor + i) % len(matching)]
+            if sub.credits > 0:
+                self._job_rr_cursor = (self._job_rr_cursor + i + 1) % len(matching)
+                return sub
+        return None
+
+    def _return_job_credit(self, subscriber_key: int) -> None:
+        for sub in self.job_subscriptions:
+            if sub.subscriber_key == subscriber_key:
+                sub.credits += 1
+                return
+
+    # -- host API: subscriptions + deadline checks ------------------------
+    def add_job_subscription(self, subscription: JobSubscription) -> None:
+        self.job_subscriptions.append(subscription)
+
+    def remove_job_subscription(self, subscriber_key: int) -> None:
+        self.job_subscriptions = [
+            s for s in self.job_subscriptions if s.subscriber_key != subscriber_key
+        ]
+
+    def increase_job_credits(self, subscriber_key: int, credits: int) -> None:
+        for sub in self.job_subscriptions:
+            if sub.subscriber_key == subscriber_key:
+                sub.credits += credits
+
+    def check_job_deadlines(self) -> List[Record]:
+        """Reference JobTimeOutStreamProcessor: TIME_OUT commands for expired
+        activated jobs; returned commands must be appended to the log."""
+        now = self.clock()
+        commands = []
+        for key, job in sorted(self.jobs.items()):
+            if job.state == int(JobIntent.ACTIVATED) and 0 <= job.deadline <= now:
+                commands.append(
+                    _record(RecordType.COMMAND, job.record.copy(), JobIntent.TIME_OUT, key)
+                )
+        return commands
+
+    def check_timer_deadlines(self) -> List[Record]:
+        """TPU-native timer firing: TRIGGER commands for due timers."""
+        now = self.clock()
+        commands = []
+        for key, timer in sorted(self.timers.items()):
+            if timer.due_date <= now:
+                commands.append(
+                    _record(RecordType.COMMAND, timer.record.copy(), TimerIntent.TRIGGER, key)
+                )
+        return commands
+
+    def check_message_ttls(self) -> List[Record]:
+        """Reference MessageTimeToLiveChecker: DELETE commands for expired
+        messages."""
+        now = self.clock()
+        commands = []
+        for key, message in sorted(self.messages.items()):
+            if message.deadline <= now:
+                commands.append(
+                    _record(
+                        RecordType.COMMAND,
+                        MessageRecord(
+                            name=message.name,
+                            correlation_key=message.correlation_key,
+                            time_to_live=message.time_to_live,
+                            payload=dict(message.payload),
+                            message_id=message.message_id,
+                        ),
+                        MessageIntent.DELETE,
+                        key,
+                    )
+                )
+        return commands
+
+    # ------------------------------------------------------------------
+    # incident subsystem (reference IncidentStreamProcessor)
+    # ------------------------------------------------------------------
+    def _process_incident(self, record: Record, out: ProcessingResult) -> None:
+        intent = IncidentIntent(record.metadata.intent)
+        rt = record.metadata.record_type
+        value: IncidentRecord = record.value
+
+        if rt == RecordType.COMMAND and intent == IncidentIntent.CREATE:
+            is_job_incident = value.job_key > 0
+            if is_job_incident and self.incident_by_failed_job.get(value.job_key, -1) != -2:
+                self._job_rejection(record, "Job is not failed", out)
+                return
+            key = self.incident_keys.next_key()
+            created = _record(RecordType.EVENT, value.copy(), IncidentIntent.CREATED,
+                              key, record.position)
+            out.written.append(created)
+            if is_job_incident:
+                self.incident_by_failed_job[value.job_key] = key
+            else:
+                self.incident_by_activity[value.activity_instance_key] = key
+            self.incidents[key] = IncidentState(
+                state=INCIDENT_CREATED,
+                incident_event_position=record.position,
+                failure_event_position=value.failure_event_position,
+            )
+            self.incident_records[key] = value.copy()
+        elif rt == RecordType.COMMAND and intent == IncidentIntent.RESOLVE:
+            incident = self.incidents.get(record.key)
+            if incident is not None and incident.state == INCIDENT_CREATED:
+                failure = self.records_by_position.get(incident.failure_event_position)
+                if failure is not None:
+                    new_value = failure.value.copy()
+                    new_value.payload = dict(value.payload)
+                    self._write_wi_followup(
+                        out, record, failure.key, WI(failure.metadata.intent), new_value,
+                        {"incident_key": record.key},
+                    )
+                    incident.state = INCIDENT_RESOLVING
+            else:
+                self._job_rejection(record, "Incident is not in state CREATED", out)
+        elif rt == RecordType.EVENT and intent == IncidentIntent.RESOLVE_FAILED:
+            incident = self.incidents.get(record.key)
+            if incident is not None and incident.state == INCIDENT_RESOLVING:
+                incident.state = INCIDENT_CREATED
+        elif rt == RecordType.COMMAND and intent == IncidentIntent.DELETE:
+            incident = self.incidents.pop(record.key, None)
+            if incident is not None:
+                prior = self.incident_records.pop(record.key, None)
+                out.written.append(
+                    _record(RecordType.EVENT, prior or value, IncidentIntent.DELETED,
+                            record.key, record.position)
+                )
+            else:
+                self._job_rejection(record, "Incident does not exist", out)
+
+    def _incident_on_workflow_record(self, record: Record, out: ProcessingResult) -> None:
+        if record.metadata.record_type != RecordType.EVENT:
+            return
+        intent = WI(record.metadata.intent)
+        # ActivityRewrittenProcessor: remember re-written failure events
+        if intent in (WI.ELEMENT_READY, WI.GATEWAY_ACTIVATED, WI.ELEMENT_COMPLETING):
+            if record.metadata.incident_key > 0:
+                self.resolving_events[record.position] = record.metadata.incident_key
+        # PayloadUpdatedProcessor: trigger RESOLVE
+        if intent == WI.PAYLOAD_UPDATED:
+            incident_key = self.incident_by_activity.get(record.key, -1)
+            if incident_key > 0 and self.incidents.get(incident_key, None) is not None \
+                    and self.incidents[incident_key].state == INCIDENT_CREATED:
+                resolve_value = IncidentRecord(
+                    workflow_instance_key=record.value.workflow_instance_key,
+                    activity_instance_key=record.key,
+                    payload=dict(record.value.payload),
+                )
+                out.written.append(
+                    _record(RecordType.COMMAND, resolve_value, IncidentIntent.RESOLVE,
+                            incident_key, record.position)
+                )
+        # ActivityIncidentResolvedProcessor: resolution completes on the next
+        # lifecycle event produced from the re-written failure event
+        if intent in (
+            WI.ELEMENT_ACTIVATED, WI.SEQUENCE_FLOW_TAKEN, WI.ELEMENT_COMPLETED,
+        ):
+            incident_key = self.resolving_events.get(record.source_record_position, -1)
+            if incident_key > 0:
+                incident = self.incidents.get(incident_key)
+                if incident is not None and incident.state == INCIDENT_RESOLVING:
+                    prior = self.incident_records.get(incident_key)
+                    out.written.append(
+                        _record(RecordType.EVENT, prior, IncidentIntent.RESOLVED,
+                                incident_key, record.position)
+                    )
+                    self.incidents.pop(incident_key, None)
+                    if prior is not None:
+                        self.incident_by_activity.pop(prior.activity_instance_key, None)
+                    self.resolving_events.pop(record.source_record_position, None)
+        # ActivityTerminatedProcessor: delete incidents of terminated elements
+        if intent == WI.ELEMENT_TERMINATED:
+            incident_key = self.incident_by_activity.pop(record.key, -1)
+            if incident_key > 0:
+                incident = self.incidents.get(incident_key)
+                if incident is not None and incident.state in (
+                    INCIDENT_CREATED, INCIDENT_RESOLVING
+                ):
+                    incident.state = INCIDENT_DELETING
+                    out.written.append(
+                        _record(RecordType.COMMAND, IncidentRecord(), IncidentIntent.DELETE,
+                                incident_key, record.position)
+                    )
+
+    def _incident_on_job_event(self, record: Record, out: ProcessingResult) -> None:
+        intent = JobIntent(record.metadata.intent)
+        value: JobRecord = record.value
+        if intent == JobIntent.FAILED and value.retries <= 0:
+            # reference JobFailedProcessor
+            headers = value.headers
+            incident = IncidentRecord(
+                error_type=int(ErrorType.JOB_NO_RETRIES),
+                error_message="No more retries left.",
+                failure_event_position=record.position,
+                bpmn_process_id=headers.bpmn_process_id,
+                workflow_instance_key=headers.workflow_instance_key,
+                activity_id=headers.activity_id,
+                activity_instance_key=headers.activity_instance_key,
+                job_key=record.key,
+                payload=dict(value.payload),
+            )
+            self.incident_by_failed_job[record.key] = -2  # NON_PERSISTENT_INCIDENT
+            if record.metadata.incident_key < 0:
+                out.written.append(
+                    _record(RecordType.COMMAND, incident, IncidentIntent.CREATE, -1, record.position)
+                )
+            else:
+                out.written.append(
+                    _record(RecordType.EVENT, incident, IncidentIntent.RESOLVE_FAILED,
+                            record.metadata.incident_key, record.position)
+                )
+        elif intent in (JobIntent.RETRIES_UPDATED, JobIntent.CANCELED):
+            # reference JobIncidentResolvedProcessor
+            incident_key = self.incident_by_failed_job.pop(record.key, -1)
+            if incident_key > 0:
+                incident = self.incidents.get(incident_key)
+                if incident is not None and incident.state == INCIDENT_CREATED:
+                    if intent == JobIntent.RETRIES_UPDATED:
+                        # re-activate by re-writing the failure event: the job
+                        # goes back to the activation pool
+                        prior = self.incident_records.get(incident_key)
+                        out.written.append(
+                            _record(RecordType.EVENT, prior, IncidentIntent.RESOLVED,
+                                    incident_key, record.position)
+                        )
+                    else:
+                        prior = self.incident_records.get(incident_key)
+                        out.written.append(
+                            _record(RecordType.COMMAND, prior or IncidentRecord(),
+                                    IncidentIntent.DELETE, incident_key, record.position)
+                        )
+                    self.incidents.pop(incident_key, None)
+                    self.incident_records.pop(incident_key, None)
+
+    # ------------------------------------------------------------------
+    # message subsystem (reference subscription/message/processor/*)
+    # ------------------------------------------------------------------
+    def _process_message_command(self, record: Record, out: ProcessingResult) -> None:
+        intent = MessageIntent(record.metadata.intent)
+        value: MessageRecord = record.value
+        if intent == MessageIntent.PUBLISH:
+            if value.message_id and any(
+                m.name == value.name
+                and m.correlation_key == value.correlation_key
+                and m.message_id == value.message_id
+                for m in self.messages.values()
+            ):
+                reason = f"message with id '{value.message_id}' is already published"
+                self._job_rejection(record, reason, out, RejectionType.BAD_VALUE)
+                return
+            key = self.wf_keys.next_key()
+            published = _record(
+                RecordType.EVENT, value.copy(), MessageIntent.PUBLISHED, key, record.position,
+                {
+                    "request_id": record.metadata.request_id,
+                    "request_stream_id": record.metadata.request_stream_id,
+                },
+            )
+            out.written.append(published)
+            if record.metadata.request_id >= 0:
+                out.responses.append(published)
+            # correlate to open subscriptions
+            for sub in self.message_subscriptions:
+                if sub.message_name == value.name and sub.correlation_key == value.correlation_key:
+                    out.sends.append(
+                        (
+                            sub.workflow_instance_partition_id,
+                            _record(
+                                RecordType.COMMAND,
+                                WorkflowInstanceSubscriptionRecord(
+                                    workflow_instance_key=sub.workflow_instance_key,
+                                    activity_instance_key=sub.activity_instance_key,
+                                    message_name=value.name,
+                                    payload=dict(value.payload),
+                                    message_partition_id=self.partition_id,
+                                ),
+                                WorkflowInstanceSubscriptionIntent.CORRELATE,
+                            ),
+                        )
+                    )
+            if value.time_to_live > 0:
+                self.messages[key] = StoredMessage(
+                    key=key,
+                    name=value.name,
+                    correlation_key=value.correlation_key,
+                    time_to_live=value.time_to_live,
+                    payload=dict(value.payload),
+                    message_id=value.message_id,
+                    deadline=self.clock() + value.time_to_live,
+                )
+            else:
+                out.written.append(
+                    _record(RecordType.EVENT, value.copy(), MessageIntent.DELETED,
+                            key, record.position)
+                )
+        elif intent == MessageIntent.DELETE:
+            if record.key in self.messages:
+                del self.messages[record.key]
+                out.written.append(
+                    _record(RecordType.EVENT, value.copy(), MessageIntent.DELETED,
+                            record.key, record.position)
+                )
+
+    def _process_message_subscription(self, record: Record, out: ProcessingResult) -> None:
+        intent = MessageSubscriptionIntent(record.metadata.intent)
+        value: MessageSubscriptionRecord = record.value
+        if intent == MessageSubscriptionIntent.OPEN:
+            # reference OpenMessageSubscriptionProcessor
+            key = self.wf_keys.next_key()
+            out.written.append(
+                _record(RecordType.EVENT, value.copy(), MessageSubscriptionIntent.OPENED,
+                        key, record.position)
+            )
+            self.message_subscriptions.append(
+                StoredSubscription(
+                    message_name=value.message_name,
+                    correlation_key=value.correlation_key,
+                    workflow_instance_partition_id=value.workflow_instance_partition_id,
+                    workflow_instance_key=value.workflow_instance_key,
+                    activity_instance_key=value.activity_instance_key,
+                )
+            )
+            for message in sorted(self.messages.values(), key=lambda m: m.key):
+                if message.name == value.message_name and message.correlation_key == value.correlation_key:
+                    out.sends.append(
+                        (
+                            value.workflow_instance_partition_id,
+                            _record(
+                                RecordType.COMMAND,
+                                WorkflowInstanceSubscriptionRecord(
+                                    workflow_instance_key=value.workflow_instance_key,
+                                    activity_instance_key=value.activity_instance_key,
+                                    message_name=value.message_name,
+                                    payload=dict(message.payload),
+                                    message_partition_id=self.partition_id,
+                                ),
+                                WorkflowInstanceSubscriptionIntent.CORRELATE,
+                            ),
+                        )
+                    )
+                    break
+        elif intent == MessageSubscriptionIntent.CLOSE:
+            before = len(self.message_subscriptions)
+            self.message_subscriptions = [
+                s
+                for s in self.message_subscriptions
+                if not (
+                    s.activity_instance_key == value.activity_instance_key
+                    and s.workflow_instance_key == value.workflow_instance_key
+                )
+            ]
+            if len(self.message_subscriptions) != before:
+                out.written.append(
+                    _record(RecordType.EVENT, value.copy(), MessageSubscriptionIntent.CLOSED,
+                            record.key, record.position)
+                )
+
+    def _process_wi_subscription(self, record: Record, out: ProcessingResult) -> None:
+        """Reference CorrelateWorkflowInstanceSubscription."""
+        value: WorkflowInstanceSubscriptionRecord = record.value
+        instance = self.element_instances.get(value.activity_instance_key)
+        if instance is None:
+            self._job_rejection(record, "activity is not active anymore", out)
+            return
+        wi_value = instance.value
+        wi_value.payload = dict(value.payload)
+        out.written.append(
+            _record(RecordType.EVENT, value.copy(),
+                    WorkflowInstanceSubscriptionIntent.CORRELATED,
+                    record.key, record.position)
+        )
+        self._write_wi_followup(
+            out, record, value.activity_instance_key, WI.ELEMENT_COMPLETING, wi_value
+        )
+        # close the now-consumed subscription on the message partition (the
+        # reference leaks it in this version; see MessageSubscriptionIntent)
+        if value.message_partition_id >= 0:
+            close = MessageSubscriptionRecord(
+                workflow_instance_partition_id=self.partition_id,
+                workflow_instance_key=value.workflow_instance_key,
+                activity_instance_key=value.activity_instance_key,
+                message_name=value.message_name,
+            )
+            out.sends.append(
+                (
+                    value.message_partition_id,
+                    _record(RecordType.COMMAND, close, MessageSubscriptionIntent.CLOSE),
+                )
+            )
+
+    # ------------------------------------------------------------------
+    # timers (TPU-native)
+    # ------------------------------------------------------------------
+    def _process_timer(self, record: Record, out: ProcessingResult) -> None:
+        intent = TimerIntent(record.metadata.intent)
+        value: TimerRecord = record.value
+        if intent == TimerIntent.CREATE:
+            key = self.wf_keys.next_key()
+            self.timers[key] = TimerState(
+                due_date=value.due_date,
+                activity_instance_key=value.activity_instance_key,
+                record=value.copy(),
+            )
+            out.written.append(
+                _record(RecordType.EVENT, value.copy(), TimerIntent.CREATED, key, record.position)
+            )
+        elif intent == TimerIntent.TRIGGER:
+            timer = self.timers.pop(record.key, None)
+            if timer is None:
+                self._job_rejection(record, "timer does not exist", out)
+                return
+            out.written.append(
+                _record(RecordType.EVENT, value.copy(), TimerIntent.TRIGGERED,
+                        record.key, record.position)
+            )
+            instance = self.element_instances.get(value.activity_instance_key)
+            if instance is not None and instance.state == WI.ELEMENT_ACTIVATED:
+                self._write_wi_followup(
+                    out, record, instance.key, WI.ELEMENT_COMPLETING, instance.value
+                )
+        elif intent == TimerIntent.CANCEL:
+            timer = self.timers.pop(record.key, None)
+            if timer is not None:
+                out.written.append(
+                    _record(RecordType.EVENT, value.copy(), TimerIntent.CANCELED,
+                            record.key, record.position)
+                )
+
+
+def _correlation_hash(key: str) -> int:
+    """Deterministic correlation-key hash (reference uses String.hashCode-style
+    routing in SubscriptionCommandSender; any stable hash works as long as
+    every node agrees)."""
+    h = 0
+    for ch in key:
+        h = (h * 31 + ord(ch)) & 0x7FFFFFFF
+    return h
+
+

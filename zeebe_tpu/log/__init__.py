@@ -1,0 +1,6 @@
+"""Append-only event-sourced log (reference: ``logstreams/`` module)."""
+
+from zeebe_tpu.log.storage import SegmentedLogStorage
+from zeebe_tpu.log.logstream import LogStream, LogStreamReader, LogStreamWriter
+
+__all__ = ["SegmentedLogStorage", "LogStream", "LogStreamReader", "LogStreamWriter"]

@@ -1,0 +1,150 @@
+"""Segmented append-only log storage.
+
+Reference parity: ``logstreams/.../impl/log/fs/FsLogStorage.java`` (512 LoC;
+segments, addresses = (segmentId, offset), block append, truncate, recovery
+scan) and ``FsLogSegment.java``.
+
+This is the pure-Python backend; ``native/log_storage.cc`` provides a C++
+mmap backend with the same on-disk format (selected via
+``SegmentedLogStorage(native=True)`` once built).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, List, Optional, Tuple
+
+SEGMENT_MAGIC = 0x5A4C4F47  # "ZLOG"
+SEGMENT_HEADER = struct.Struct("<IIq")  # magic, segment_id, start_offset_unused
+SEGMENT_HEADER_SIZE = SEGMENT_HEADER.size
+
+DEFAULT_SEGMENT_SIZE = 64 * 1024 * 1024  # reference default is 512M; smaller here
+
+
+class SegmentedLogStorage:
+    """Append-only storage of opaque blocks across size-bounded segment files.
+
+    Addresses are ``(segment_id << 32) | byte_offset`` — the reference packs
+    (segmentId, offset) into a long the same way.
+    """
+
+    def __init__(self, directory: str, segment_size: int = DEFAULT_SEGMENT_SIZE):
+        self.directory = directory
+        self.segment_size = segment_size
+        os.makedirs(directory, exist_ok=True)
+        self._segments: List[int] = []  # segment ids, sorted
+        self._current_file = None
+        self._current_id = -1
+        self._current_size = 0
+        self._open()
+
+    # -- address packing ---------------------------------------------------
+    @staticmethod
+    def address(segment_id: int, offset: int) -> int:
+        return (segment_id << 32) | offset
+
+    @staticmethod
+    def segment_of(address: int) -> int:
+        return address >> 32
+
+    @staticmethod
+    def offset_of(address: int) -> int:
+        return address & 0xFFFFFFFF
+
+    # -- lifecycle ---------------------------------------------------------
+    def _segment_path(self, segment_id: int) -> str:
+        return os.path.join(self.directory, f"segment-{segment_id:06d}.log")
+
+    def _open(self) -> None:
+        existing = sorted(
+            int(name[len("segment-") : -len(".log")])
+            for name in os.listdir(self.directory)
+            if name.startswith("segment-") and name.endswith(".log")
+        )
+        self._segments = existing
+        if existing:
+            last = existing[-1]
+            path = self._segment_path(last)
+            self._current_file = open(path, "r+b")
+            self._current_file.seek(0, os.SEEK_END)
+            self._current_size = self._current_file.tell()
+            self._current_id = last
+        else:
+            self._roll_segment(0)
+
+    def _roll_segment(self, segment_id: int) -> None:
+        if self._current_file is not None:
+            self._current_file.flush()
+            self._current_file.close()
+        path = self._segment_path(segment_id)
+        self._current_file = open(path, "w+b")
+        self._current_file.write(SEGMENT_HEADER.pack(SEGMENT_MAGIC, segment_id, 0))
+        self._current_size = SEGMENT_HEADER_SIZE
+        self._current_id = segment_id
+        self._segments.append(segment_id)
+
+    def close(self) -> None:
+        if self._current_file is not None:
+            self._current_file.flush()
+            self._current_file.close()
+            self._current_file = None
+
+    # -- append / read -----------------------------------------------------
+    def append(self, block: bytes) -> int:
+        """Append a block; returns its address."""
+        if self._current_size + len(block) > self.segment_size and self._current_size > SEGMENT_HEADER_SIZE:
+            self._roll_segment(self._current_id + 1)
+        address = self.address(self._current_id, self._current_size)
+        self._current_file.seek(self._current_size)
+        self._current_file.write(block)
+        self._current_size += len(block)
+        return address
+
+    def flush(self) -> None:
+        if self._current_file is not None:
+            self._current_file.flush()
+            os.fsync(self._current_file.fileno())
+
+    def read(self, address: int, length: int) -> bytes:
+        segment_id = self.segment_of(address)
+        offset = self.offset_of(address)
+        if segment_id == self._current_id:
+            self._current_file.flush()
+        with open(self._segment_path(segment_id), "rb") as f:
+            f.seek(offset)
+            return f.read(length)
+
+    def read_segment(self, segment_id: int) -> bytes:
+        if segment_id == self._current_id and self._current_file is not None:
+            self._current_file.flush()
+        with open(self._segment_path(segment_id), "rb") as f:
+            f.seek(SEGMENT_HEADER_SIZE)
+            return f.read()
+
+    def iter_blocks(self) -> Iterator[Tuple[int, bytes]]:
+        """Recovery scan: yields (address, segment_bytes) per segment; framing
+        of records inside the segment is the codec's concern."""
+        for segment_id in list(self._segments):
+            data = self.read_segment(segment_id)
+            yield self.address(segment_id, SEGMENT_HEADER_SIZE), data
+
+    def first_address(self) -> Optional[int]:
+        if not self._segments:
+            return None
+        return self.address(self._segments[0], SEGMENT_HEADER_SIZE)
+
+    # -- truncate (test/failure injection; reference FsLogStorage.truncate) --
+    def truncate(self, address: int) -> None:
+        segment_id = self.segment_of(address)
+        offset = self.offset_of(address)
+        for sid in [s for s in self._segments if s > segment_id]:
+            os.unlink(self._segment_path(sid))
+            self._segments.remove(sid)
+        if self._current_id != segment_id:
+            self._current_file.close()
+            self._current_file = open(self._segment_path(segment_id), "r+b")
+            self._current_id = segment_id
+        self._current_file.truncate(offset)
+        self._current_file.seek(offset)
+        self._current_size = offset

@@ -1,0 +1,13 @@
+"""Workflow models: BPMN 2.0 front-end, condition language, transforms.
+
+Reference parity: ``bpmn-model/`` (meta-model, builder, XML IO, Zeebe
+extension elements, validation), ``json-el/`` (condition language),
+``broker-core/.../workflow/model/`` (transformation to executable graphs).
+"""
+
+from zeebe_tpu.models.bpmn.builder import Bpmn
+from zeebe_tpu.models.bpmn.model import BpmnModel
+from zeebe_tpu.models.transform.transformer import transform_model
+from zeebe_tpu.models.transform.executable import ExecutableWorkflow
+
+__all__ = ["Bpmn", "BpmnModel", "transform_model", "ExecutableWorkflow"]

@@ -1,0 +1,117 @@
+"""Deploy-time model validation.
+
+Reference parity: ``bpmn-model/.../validation/`` + broker-side
+``BpmnValidator`` / ``ZeebeExpressionValidator``
+(broker-core/.../workflow/model/validation/): structural checks and
+condition-expression compilation errors surfaced as deployment rejections.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+from zeebe_tpu.models.bpmn.model import (
+    BpmnModel,
+    ExclusiveGateway,
+    FlowNode,
+    IntermediateCatchEvent,
+    ReceiveTask,
+    SequenceFlow,
+    ServiceTask,
+    StartEvent,
+    SubProcess,
+)
+from zeebe_tpu.models.el.parser import ConditionParseError, parse_condition
+
+
+@dataclasses.dataclass
+class ValidationError:
+    element_id: str
+    message: str
+
+    def __str__(self):
+        return f"{self.element_id}: {self.message}"
+
+
+def validate_model(model: BpmnModel) -> List[ValidationError]:
+    errors: List[ValidationError] = []
+
+    for process in model.processes:
+        if not process.executable:
+            continue
+        starts = [
+            n
+            for n in model.nodes_in_scope(process.id)
+            if isinstance(n, StartEvent)
+        ]
+        if len(starts) != 1:
+            errors.append(
+                ValidationError(process.id, "process must have exactly one start event")
+            )
+
+    for element in model.elements.values():
+        if isinstance(element, ServiceTask):
+            if not element.task_definition.type:
+                errors.append(
+                    ValidationError(element.id, "service task must have a task type")
+                )
+            if element.task_definition.retries < 0:
+                errors.append(
+                    ValidationError(element.id, "task retries must be >= 0")
+                )
+        elif isinstance(element, SubProcess):
+            starts = [
+                n
+                for n in model.nodes_in_scope(element.id)
+                if isinstance(n, StartEvent)
+            ]
+            if len(starts) != 1:
+                errors.append(
+                    ValidationError(
+                        element.id, "sub-process must have exactly one start event"
+                    )
+                )
+        elif isinstance(element, ExclusiveGateway):
+            for flow in element.outgoing:
+                if (
+                    len(element.outgoing) > 1
+                    and flow.condition_expression is None
+                    and flow.id != element.default_flow_id
+                ):
+                    errors.append(
+                        ValidationError(
+                            flow.id,
+                            "sequence flow out of a splitting exclusive gateway "
+                            "must have a condition or be the default flow",
+                        )
+                    )
+        elif isinstance(element, (IntermediateCatchEvent, ReceiveTask)):
+            msg = element.message
+            timer = getattr(element, "timer_duration_ms", None)
+            if msg is None and timer is None:
+                errors.append(
+                    ValidationError(
+                        element.id, "catch event must have a message or timer definition"
+                    )
+                )
+            elif msg is not None and not msg.correlation_key:
+                errors.append(
+                    ValidationError(
+                        element.id, "message subscription must have a correlation key"
+                    )
+                )
+        elif isinstance(element, SequenceFlow):
+            if element.condition_expression is not None:
+                try:
+                    parse_condition(element.condition_expression)
+                except ConditionParseError as e:
+                    errors.append(ValidationError(element.id, str(e)))
+
+        if isinstance(element, FlowNode) and not isinstance(element, StartEvent):
+            if not element.incoming and element.scope_id:
+                errors.append(
+                    ValidationError(element.id, "flow node has no incoming sequence flow")
+                )
+
+    return errors

@@ -1,0 +1,34 @@
+"""Condition expression language for exclusive gateways.
+
+Reference parity: ``json-el/`` — a JS-like grammar of comparisons combined
+with ``&&``/``||`` over JSONPath/string/number/bool/null literals
+(``JsonConditionParser.scala:37-52``), evaluated against the instance
+payload. Here: a recursive-descent parser to an AST, a host interpreter with
+reference semantics (``JsonConditionInterpreter``), and a compiler to a
+fixed-width predicate bytecode evaluated vectorized on device
+(``zeebe_tpu.ops.predicate``).
+"""
+
+from zeebe_tpu.models.el.ast import (
+    Comparison,
+    Condition,
+    Conjunction,
+    Disjunction,
+    JsonPathLiteral,
+    Literal,
+)
+from zeebe_tpu.models.el.parser import ConditionParseError, parse_condition
+from zeebe_tpu.models.el.interpreter import ConditionEvalError, evaluate_condition
+
+__all__ = [
+    "Comparison",
+    "Condition",
+    "Conjunction",
+    "Disjunction",
+    "JsonPathLiteral",
+    "Literal",
+    "ConditionParseError",
+    "parse_condition",
+    "ConditionEvalError",
+    "evaluate_condition",
+]

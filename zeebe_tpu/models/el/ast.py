@@ -1,0 +1,105 @@
+"""Condition AST (reference: json-el ``JsonCondition.scala`` case classes)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Union
+
+
+class Condition:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Literal:
+    """A constant: str, int, float, bool, or None."""
+
+    value: Any
+
+
+@dataclasses.dataclass(frozen=True)
+class JsonPathLiteral:
+    """A JSONPath reference into the payload, e.g. ``$.orderValue`` or
+    ``$.items[0].price`` (reference: JsonPath case class; paths are compiled
+    by json-path's JsonPathQueryCompiler)."""
+
+    path: str
+
+    @property
+    def steps(self) -> List[Union[str, int]]:
+        return compile_json_path(self.path)
+
+
+@dataclasses.dataclass(frozen=True)
+class Comparison(Condition):
+    op: str  # '==', '!=', '<', '<=', '>', '>='
+    left: Union[Literal, JsonPathLiteral]
+    right: Union[Literal, JsonPathLiteral]
+
+
+@dataclasses.dataclass(frozen=True)
+class Disjunction(Condition):
+    left: Condition
+    right: Condition
+
+
+@dataclasses.dataclass(frozen=True)
+class Conjunction(Condition):
+    left: Condition
+    right: Condition
+
+
+def compile_json_path(path: str) -> List[Union[str, int]]:
+    """Compile a JSONPath subset to access steps.
+
+    Reference: ``json-path/.../jsonpath/JsonPathQueryCompiler.java`` — the
+    engine subset: ``$``, ``$.a.b``, ``$['a']``, ``$.items[0]``.
+    """
+    if not path.startswith("$"):
+        raise ValueError(f"JSONPath must start with '$': {path}")
+    steps: List[Union[str, int]] = []
+    i = 1
+    n = len(path)
+    while i < n:
+        ch = path[i]
+        if ch == ".":
+            i += 1
+            start = i
+            while i < n and path[i] not in ".[":
+                i += 1
+            if i > start:
+                steps.append(path[start:i])
+        elif ch == "[":
+            i += 1
+            if i < n and path[i] in "'\"":
+                quote = path[i]
+                i += 1
+                start = i
+                while i < n and path[i] != quote:
+                    i += 1
+                steps.append(path[start:i])
+                i += 2  # skip quote and ]
+            else:
+                start = i
+                while i < n and path[i] != "]":
+                    i += 1
+                steps.append(int(path[start:i]))
+                i += 1
+        else:
+            raise ValueError(f"bad JSONPath syntax at {i}: {path}")
+    return steps
+
+
+def query_json_path(document: Any, path: str):
+    """Apply a compiled path to a document; returns (found, value)."""
+    node = document
+    for step in compile_json_path(path):
+        if isinstance(step, str):
+            if not isinstance(node, dict) or step not in node:
+                return False, None
+            node = node[step]
+        else:
+            if not isinstance(node, list) or step >= len(node) or step < -len(node):
+                return False, None
+            node = node[step]
+    return True, node

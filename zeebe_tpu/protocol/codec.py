@@ -1,0 +1,159 @@
+"""Fixed-layout binary record frame codec.
+
+SBE-equivalent framing for log storage and the wire (reference: the SBE
+schema ``protocol/src/main/resources/protocol.xml`` plus logstreams'
+``LogEntryDescriptor`` framing: position, raft term, producer id, source
+event position, key, metadata + value).
+
+Frame layout (little-endian):
+
+    offset  size  field
+    0       4     frame_length (total, including this field)
+    4       4     crc32 of bytes [8:frame_length)
+    8       8     position
+    16      8     source_record_position
+    24      8     key
+    32      8     timestamp
+    40      4     producer_id
+    44      4     raft_term
+    48      8     request_id
+    56      4     request_stream_id
+    60      8     incident_key
+    68      1     record_type
+    69      1     value_type
+    70      1     intent
+    71      1     rejection_type
+    72      4     rejection_reason_length = R
+    76      R     rejection_reason (utf-8)
+    76+R    4     value_length = V
+    80+R    V     value (msgpack document)
+    ...           zero padding to 8-byte alignment
+
+Alignment keeps mmap'd native readers (native/log_storage.cc) word-aligned,
+mirroring the reference's dispatcher fragment alignment.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Optional, Tuple
+
+from zeebe_tpu.protocol import msgpack
+from zeebe_tpu.protocol.enums import RecordType, RejectionType, ValueType
+from zeebe_tpu.protocol.metadata import RecordMetadata
+from zeebe_tpu.protocol.records import Record, VALUE_CLASS_BY_TYPE
+
+_HEADER = struct.Struct("<iIqqqqiiqiqBBBB")
+HEADER_SIZE = _HEADER.size  # 72
+assert HEADER_SIZE == 72
+
+FRAME_ALIGNMENT = 8
+
+
+def encode_record(record: Record) -> bytes:
+    md = record.metadata
+    reason = md.rejection_reason.encode("utf-8")
+    value_bytes = record.value.encode() if record.value is not None else msgpack.EMPTY_DOCUMENT
+
+    body_len = HEADER_SIZE + 4 + len(reason) + 4 + len(value_bytes)
+    frame_len = (body_len + FRAME_ALIGNMENT - 1) // FRAME_ALIGNMENT * FRAME_ALIGNMENT
+
+    buf = bytearray(frame_len)
+    _HEADER.pack_into(
+        buf,
+        0,
+        frame_len,
+        0,  # crc placeholder
+        record.position,
+        record.source_record_position,
+        record.key,
+        record.timestamp,
+        record.producer_id,
+        record.raft_term,
+        md.request_id,
+        md.request_stream_id,
+        md.incident_key,
+        int(md.record_type) & 0xFF,
+        int(md.value_type) & 0xFF,
+        int(md.intent) & 0xFF,
+        int(md.rejection_type) & 0xFF,
+    )
+    o = HEADER_SIZE
+    struct.pack_into("<I", buf, o, len(reason))
+    o += 4
+    buf[o : o + len(reason)] = reason
+    o += len(reason)
+    struct.pack_into("<I", buf, o, len(value_bytes))
+    o += 4
+    buf[o : o + len(value_bytes)] = value_bytes
+
+    crc = zlib.crc32(bytes(buf[8:]))
+    struct.pack_into("<I", buf, 4, crc)
+    return bytes(buf)
+
+
+def decode_record(data: bytes, offset: int = 0) -> Tuple[Record, int]:
+    """Decode one frame at ``offset``; returns (record, next_offset)."""
+    (
+        frame_len,
+        crc,
+        position,
+        source_pos,
+        key,
+        timestamp,
+        producer_id,
+        raft_term,
+        request_id,
+        request_stream_id,
+        incident_key,
+        record_type,
+        value_type,
+        intent,
+        rejection_type,
+    ) = _HEADER.unpack_from(data, offset)
+
+    actual_crc = zlib.crc32(bytes(data[offset + 8 : offset + frame_len]))
+    if actual_crc != crc:
+        raise ValueError(f"crc mismatch at offset {offset}: {actual_crc:#x} != {crc:#x}")
+
+    o = offset + HEADER_SIZE
+    (reason_len,) = struct.unpack_from("<I", data, o)
+    o += 4
+    reason = bytes(data[o : o + reason_len]).decode("utf-8")
+    o += reason_len
+    (value_len,) = struct.unpack_from("<I", data, o)
+    o += 4
+    value_bytes = bytes(data[o : o + value_len])
+
+    vt = ValueType(value_type) if value_type != 255 else ValueType.NULL_VAL
+    value_cls = VALUE_CLASS_BY_TYPE.get(vt)
+    value = value_cls.decode(value_bytes) if value_cls is not None else None
+
+    record = Record(
+        position=position,
+        source_record_position=source_pos,
+        key=key,
+        timestamp=timestamp,
+        producer_id=producer_id,
+        raft_term=raft_term,
+        metadata=RecordMetadata(
+            record_type=RecordType(record_type),
+            value_type=vt,
+            intent=intent,
+            rejection_type=RejectionType(rejection_type),
+            rejection_reason=reason,
+            request_id=request_id,
+            request_stream_id=request_stream_id,
+            incident_key=incident_key,
+        ),
+        value=value,
+    )
+    return record, offset + frame_len
+
+
+def peek_frame_length(data: bytes, offset: int = 0) -> Optional[int]:
+    if len(data) - offset < 4:
+        return None
+    (frame_len,) = struct.unpack_from("<i", data, offset)
+    return frame_len if frame_len > 0 else None

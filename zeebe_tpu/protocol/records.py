@@ -1,0 +1,298 @@
+"""Typed record values.
+
+Reference parity: every broker record type extends ``UnpackedObject``
+(``msgpack-value/src/main/java/io/zeebe/msgpack/UnpackedObject.java``); the
+concrete value classes live under ``broker-core/.../{workflow,job,incident,
+subscription}/data/``. Property names below match the reference msgpack
+document keys exactly so value documents are wire-comparable.
+
+Host-side values are plain dataclasses serialized to msgpack documents; the
+device engine uses columnarized forms (``zeebe_tpu.engine.state``) and the
+host materializes these classes only at the log/client boundary.
+"""
+
+from __future__ import annotations
+
+import copy as copy_module
+import dataclasses
+from typing import Any, ClassVar, Dict, List, Optional
+
+from zeebe_tpu.protocol import msgpack
+from zeebe_tpu.protocol.enums import ErrorType, ValueType
+from zeebe_tpu.protocol.metadata import RecordMetadata
+
+EMPTY_PAYLOAD: Dict[str, Any] = {}
+
+
+class RecordValue:
+    """Base for typed record values; subclasses are dataclasses whose field
+    metadata carries the reference msgpack key."""
+
+    VALUE_TYPE: ClassVar[ValueType]
+
+    def to_document(self) -> Dict[str, Any]:
+        doc = {}
+        for f in dataclasses.fields(self):
+            key = f.metadata.get("key", f.name)
+            v = getattr(self, f.name)
+            if dataclasses.is_dataclass(v):
+                v = v.to_document()
+            elif isinstance(v, list):
+                v = [x.to_document() if dataclasses.is_dataclass(x) else x for x in v]
+            doc[key] = v
+        return doc
+
+    @classmethod
+    def from_document(cls, doc: Dict[str, Any]) -> "RecordValue":
+        kwargs = {}
+        for f in dataclasses.fields(cls):
+            key = f.metadata.get("key", f.name)
+            if key in doc:
+                v = doc[key]
+                sub = f.metadata.get("cls")
+                if sub is not None and isinstance(v, dict):
+                    v = sub.from_document(v)
+                elif sub is not None and isinstance(v, list):
+                    v = [sub.from_document(x) if isinstance(x, dict) else x for x in v]
+                kwargs[f.name] = v
+        return cls(**kwargs)
+
+    def encode(self) -> bytes:
+        return msgpack.pack(self.to_document())
+
+    @classmethod
+    def decode(cls, data: bytes) -> "RecordValue":
+        return cls.from_document(msgpack.unpack(data))
+
+    def copy(self):
+        return copy_module.deepcopy(self)
+
+
+def _f(key: str, default=None, **kw):
+    return dataclasses.field(default=default, metadata={"key": key, **kw})
+
+
+@dataclasses.dataclass
+class WorkflowInstanceRecord(RecordValue):
+    # Reference: broker-core/.../workflow/data/WorkflowInstanceRecord.java
+    VALUE_TYPE: ClassVar[ValueType] = ValueType.WORKFLOW_INSTANCE
+
+    bpmn_process_id: str = _f("bpmnProcessId", "")
+    version: int = _f("version", -1)
+    workflow_key: int = _f("workflowKey", -1)
+    workflow_instance_key: int = _f("workflowInstanceKey", -1)
+    activity_id: str = _f("activityId", "")
+    payload: Dict[str, Any] = dataclasses.field(
+        default_factory=dict, metadata={"key": "payload"}
+    )
+    scope_instance_key: int = _f("scopeInstanceKey", -1)
+
+
+@dataclasses.dataclass
+class JobHeaders(RecordValue):
+    # Reference: broker-core/.../job/data/JobHeaders.java
+    VALUE_TYPE: ClassVar[ValueType] = ValueType.NOOP
+
+    workflow_instance_key: int = _f("workflowInstanceKey", -1)
+    bpmn_process_id: str = _f("bpmnProcessId", "")
+    workflow_definition_version: int = _f("workflowDefinitionVersion", -1)
+    workflow_key: int = _f("workflowKey", -1)
+    activity_id: str = _f("activityId", "")
+    activity_instance_key: int = _f("activityInstanceKey", -1)
+
+
+@dataclasses.dataclass
+class JobRecord(RecordValue):
+    # Reference: broker-core/.../job/data/JobRecord.java
+    VALUE_TYPE: ClassVar[ValueType] = ValueType.JOB
+
+    deadline: int = _f("deadline", -1)
+    worker: str = _f("worker", "")
+    retries: int = _f("retries", -1)
+    type: str = _f("type", "")
+    headers: JobHeaders = dataclasses.field(
+        default_factory=JobHeaders, metadata={"key": "headers", "cls": JobHeaders}
+    )
+    custom_headers: Dict[str, Any] = dataclasses.field(
+        default_factory=dict, metadata={"key": "customHeaders"}
+    )
+    payload: Dict[str, Any] = dataclasses.field(
+        default_factory=dict, metadata={"key": "payload"}
+    )
+
+
+@dataclasses.dataclass
+class IncidentRecord(RecordValue):
+    # Reference: broker-core/.../incident/data/IncidentRecord.java
+    VALUE_TYPE: ClassVar[ValueType] = ValueType.INCIDENT
+
+    error_type: int = _f("errorType", int(ErrorType.UNKNOWN))
+    error_message: str = _f("errorMessage", "")
+    failure_event_position: int = _f("failureEventPosition", -1)
+    bpmn_process_id: str = _f("bpmnProcessId", "")
+    workflow_instance_key: int = _f("workflowInstanceKey", -1)
+    activity_id: str = _f("activityId", "")
+    activity_instance_key: int = _f("activityInstanceKey", -1)
+    job_key: int = _f("jobKey", -1)
+    payload: Dict[str, Any] = dataclasses.field(
+        default_factory=dict, metadata={"key": "payload"}
+    )
+
+
+@dataclasses.dataclass
+class MessageRecord(RecordValue):
+    # Reference: broker-core/.../subscription/message/data/MessageRecord.java
+    VALUE_TYPE: ClassVar[ValueType] = ValueType.MESSAGE
+
+    name: str = _f("name", "")
+    correlation_key: str = _f("correlationKey", "")
+    time_to_live: int = _f("timeToLive", -1)
+    payload: Dict[str, Any] = dataclasses.field(
+        default_factory=dict, metadata={"key": "payload"}
+    )
+    message_id: str = _f("messageId", "")
+
+
+@dataclasses.dataclass
+class MessageSubscriptionRecord(RecordValue):
+    # Reference: broker-core/.../subscription/message/data/MessageSubscriptionRecord.java
+    VALUE_TYPE: ClassVar[ValueType] = ValueType.MESSAGE_SUBSCRIPTION
+
+    workflow_instance_partition_id: int = _f("workflowInstancePartitionId", -1)
+    workflow_instance_key: int = _f("workflowInstanceKey", -1)
+    activity_instance_key: int = _f("activityInstanceKey", -1)
+    message_name: str = _f("messageName", "")
+    correlation_key: str = _f("correlationKey", "")
+
+
+@dataclasses.dataclass
+class WorkflowInstanceSubscriptionRecord(RecordValue):
+    # Reference: broker-core/.../subscription/message/data/WorkflowInstanceSubscriptionRecord.java
+    VALUE_TYPE: ClassVar[ValueType] = ValueType.WORKFLOW_INSTANCE_SUBSCRIPTION
+
+    workflow_instance_key: int = _f("workflowInstanceKey", -1)
+    activity_instance_key: int = _f("activityInstanceKey", -1)
+    message_name: str = _f("messageName", "")
+    payload: Dict[str, Any] = dataclasses.field(
+        default_factory=dict, metadata={"key": "payload"}
+    )
+    # TPU-native: the partition holding the message subscription, so the
+    # workflow partition can route the post-correlation CLOSE (the reference
+    # leaks subscriptions after correlation in this version)
+    message_partition_id: int = _f("messagePartitionId", -1)
+
+
+@dataclasses.dataclass
+class DeploymentResource(RecordValue):
+    # Reference: broker-core/.../system/workflow/repository/data/DeploymentResource.java
+    VALUE_TYPE: ClassVar[ValueType] = ValueType.NOOP
+
+    resource: bytes = _f("resource", b"")
+    resource_type: str = _f("resourceType", "BPMN_XML")  # BPMN_XML | YAML_WORKFLOW
+    resource_name: str = _f("resourceName", "resource")
+
+
+@dataclasses.dataclass
+class DeployedWorkflowMeta(RecordValue):
+    # Reference: broker-core/.../system/workflow/repository/data/DeployedWorkflow.java
+    VALUE_TYPE: ClassVar[ValueType] = ValueType.NOOP
+
+    bpmn_process_id: str = _f("bpmnProcessId", "")
+    version: int = _f("version", -1)
+    key: int = _f("workflowKey", -1)
+    resource_name: str = _f("resourceName", "")
+
+
+@dataclasses.dataclass
+class DeploymentRecord(RecordValue):
+    # Reference: broker-core/.../system/workflow/repository/data/DeploymentRecord.java
+    VALUE_TYPE: ClassVar[ValueType] = ValueType.DEPLOYMENT
+
+    topic_name: str = _f("topicName", "")
+    resources: List[DeploymentResource] = dataclasses.field(
+        default_factory=list,
+        metadata={"key": "resources", "cls": DeploymentResource},
+    )
+    deployed_workflows: List[DeployedWorkflowMeta] = dataclasses.field(
+        default_factory=list,
+        metadata={"key": "deployedWorkflows", "cls": DeployedWorkflowMeta},
+    )
+
+
+@dataclasses.dataclass
+class TopicRecord(RecordValue):
+    # Reference: broker-core/.../clustering/orchestration/topic/TopicRecord.java
+    VALUE_TYPE: ClassVar[ValueType] = ValueType.TOPIC
+
+    name: str = _f("name", "")
+    partitions: int = _f("partitions", 1)
+    replication_factor: int = _f("replicationFactor", 1)
+    partition_ids: List[int] = dataclasses.field(
+        default_factory=list, metadata={"key": "partitionIds"}
+    )
+
+
+@dataclasses.dataclass
+class TimerRecord(RecordValue):
+    """TPU-native: explicit timer record (due-date driven element triggers)."""
+
+    VALUE_TYPE: ClassVar[ValueType] = ValueType.TIMER
+
+    workflow_instance_key: int = _f("workflowInstanceKey", -1)
+    activity_instance_key: int = _f("activityInstanceKey", -1)
+    due_date: int = _f("dueDate", -1)
+    handler_element_id: str = _f("handlerElementId", "")
+
+
+VALUE_CLASS_BY_TYPE = {
+    ValueType.WORKFLOW_INSTANCE: WorkflowInstanceRecord,
+    ValueType.JOB: JobRecord,
+    ValueType.INCIDENT: IncidentRecord,
+    ValueType.MESSAGE: MessageRecord,
+    ValueType.MESSAGE_SUBSCRIPTION: MessageSubscriptionRecord,
+    ValueType.WORKFLOW_INSTANCE_SUBSCRIPTION: WorkflowInstanceSubscriptionRecord,
+    ValueType.DEPLOYMENT: DeploymentRecord,
+    ValueType.TOPIC: TopicRecord,
+    ValueType.TIMER: TimerRecord,
+}
+
+
+@dataclasses.dataclass
+class Record:
+    """A full log record: framing fields + metadata + typed value.
+
+    Reference: logstreams ``LoggedEvent`` + ``RecordMetadata`` + value.
+    """
+
+    position: int = -1
+    source_record_position: int = -1
+    key: int = -1
+    timestamp: int = -1
+    producer_id: int = -1
+    raft_term: int = 0
+    metadata: RecordMetadata = dataclasses.field(default_factory=RecordMetadata)
+    value: Optional[RecordValue] = None
+
+    @property
+    def record_type(self):
+        return self.metadata.record_type
+
+    @property
+    def value_type(self):
+        return self.metadata.value_type
+
+    @property
+    def intent(self) -> int:
+        return self.metadata.intent
+
+    def copy(self) -> "Record":
+        return Record(
+            position=self.position,
+            source_record_position=self.source_record_position,
+            key=self.key,
+            timestamp=self.timestamp,
+            producer_id=self.producer_id,
+            raft_term=self.raft_term,
+            metadata=self.metadata.copy(),
+            value=self.value.copy() if self.value is not None else None,
+        )

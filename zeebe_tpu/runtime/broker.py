@@ -1,0 +1,186 @@
+"""Single-process broker: partitions + processing loop + routing.
+
+Reference parity: the broker assembles per-partition log streams and stream
+processors (``PartitionInstallService``), commands enter via the client API
+handler (``ClientApiMessageHandler``: validate + write COMMAND with request
+metadata), processors run the StreamProcessorController loop
+(read committed → process → write follow-ups → side effects), and
+cross-partition subscription commands travel over the subscription transport
+(``SubscriptionApiCommandMessageHandler``).
+
+Here the loop is explicit (`run_until_idle`) and single-threaded —
+determinism is the point: the same committed log always replays to the same
+state. The TPU engine plugs in as an alternative partition processor.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Callable, Dict, List, Optional, Tuple
+
+from zeebe_tpu.engine.interpreter import PartitionEngine, WorkflowRepository
+from zeebe_tpu.log import LogStream, SegmentedLogStorage
+from zeebe_tpu.protocol.enums import RecordType, ValueType
+from zeebe_tpu.protocol.records import Record
+from zeebe_tpu.runtime.clock import SystemClock
+
+
+class Partition:
+    """A partition: log stream + stream processor + reader position."""
+
+    def __init__(self, partition_id: int, log: LogStream, engine: PartitionEngine):
+        self.partition_id = partition_id
+        self.log = log
+        self.engine = engine
+        self.next_read_position = 0
+
+    def has_backlog(self) -> bool:
+        return self.next_read_position <= self.log.commit_position
+
+
+class Broker:
+    """In-process broker (reference: EmbeddedBrokerRule-style single JVM)."""
+
+    def __init__(
+        self,
+        num_partitions: int = 1,
+        data_dir: Optional[str] = None,
+        clock: Optional[Callable[[], int]] = None,
+        engine_factory=None,
+    ):
+        self.clock = clock or SystemClock()
+        self.data_dir = data_dir or tempfile.mkdtemp(prefix="zeebe-tpu-")
+        self.repository = WorkflowRepository()
+        self.partitions: List[Partition] = []
+        self._next_request_id = 0
+        self._responses: Dict[int, Record] = {}
+        self._push_listeners: Dict[int, Callable[[Record], None]] = {}
+        self._record_listeners: List[Callable[[int, Record], None]] = []
+        self._rr_partition = 0
+
+        factory = engine_factory or (
+            lambda pid: PartitionEngine(
+                partition_id=pid,
+                num_partitions=num_partitions,
+                repository=self.repository,
+                clock=self.clock,
+            )
+        )
+        for pid in range(num_partitions):
+            storage = SegmentedLogStorage(os.path.join(self.data_dir, f"partition-{pid}"))
+            log = LogStream(storage, partition_id=pid, clock=self.clock)
+            self.partitions.append(Partition(pid, log, factory(pid)))
+
+    # -- client API (reference ClientApiMessageHandler) --------------------
+    def write_command(
+        self,
+        partition_id: int,
+        value,
+        intent: int,
+        key: int = -1,
+        with_response: bool = True,
+    ) -> Optional[int]:
+        """Write a COMMAND record to a partition's log; returns request id."""
+        from zeebe_tpu.protocol.metadata import RecordMetadata
+
+        request_id = None
+        md = RecordMetadata(
+            record_type=RecordType.COMMAND,
+            value_type=value.VALUE_TYPE,
+            intent=int(intent),
+        )
+        if with_response:
+            request_id = self._next_request_id
+            self._next_request_id += 1
+            md.request_id = request_id
+            md.request_stream_id = 0
+        record = Record(key=key, metadata=md, value=value)
+        self.partitions[partition_id].log.append([record])
+        return request_id
+
+    def next_partition(self) -> int:
+        """Round-robin partition selection (reference client routing)."""
+        pid = self._rr_partition
+        self._rr_partition = (self._rr_partition + 1) % len(self.partitions)
+        return pid
+
+    def partition_for_correlation_key(self, correlation_key: str) -> int:
+        return self.partitions[0].engine.partition_for_correlation_key(correlation_key)
+
+    def take_response(self, request_id: int) -> Optional[Record]:
+        return self._responses.pop(request_id, None)
+
+    def on_push(
+        self, subscriber_key: int, listener: Callable[[int, Record], None]
+    ) -> None:
+        """Register a push listener; called with (partition_id, record)."""
+        self._push_listeners[subscriber_key] = listener
+
+    def on_record(self, listener: Callable[[int, Record], None]) -> None:
+        """Topic-subscription analogue: observe every committed record."""
+        self._record_listeners.append(listener)
+
+    # -- processing loop ----------------------------------------------------
+    def run_until_idle(self, max_iterations: int = 100_000) -> int:
+        """Process all partitions until no backlog remains. Returns the number
+        of records processed (the StreamProcessorController hot loop,
+        StreamProcessorController.java:296-399, run to quiescence)."""
+        processed = 0
+        progress = True
+        while progress:
+            progress = False
+            for partition in self.partitions:
+                while partition.has_backlog():
+                    reader = partition.log.reader(partition.next_read_position)
+                    records = reader.read_committed()
+                    if not records:
+                        break
+                    for record in records:
+                        self._process_one(partition, record)
+                        processed += 1
+                        if processed > max_iterations:
+                            raise RuntimeError("broker did not reach quiescence")
+                    progress = True
+        return processed
+
+    def _process_one(self, partition: Partition, record: Record) -> None:
+        result = partition.engine.process(record)
+        partition.next_read_position = record.position + 1
+        if result.written:
+            partition.log.append(result.written)
+            for written in result.written:
+                partition.engine.records_by_position[written.position] = written
+        for response in result.responses:
+            if response.metadata.request_id >= 0:
+                self._responses[response.metadata.request_id] = response
+        for target_pid, send in result.sends:
+            # reference: subscription transport → command on the target log
+            self.partitions[target_pid].log.append([send])
+        for subscriber_key, push in result.pushes:
+            listener = self._push_listeners.get(subscriber_key)
+            if listener is not None:
+                listener(partition.partition_id, push)
+        for listener in self._record_listeners:
+            listener(partition.partition_id, record)
+
+    # -- time-driven side processors ---------------------------------------
+    def tick(self) -> None:
+        """Fire due timers / job timeouts / message TTLs (reference: periodic
+        actor jobs — JobTimeOutStreamProcessor, MessageTimeToLiveChecker)."""
+        for partition in self.partitions:
+            for command in partition.engine.check_job_deadlines():
+                partition.log.append([command])
+            for command in partition.engine.check_timer_deadlines():
+                partition.log.append([command])
+            for command in partition.engine.check_message_ttls():
+                partition.log.append([command])
+
+    def records(self, partition_id: int = 0) -> List[Record]:
+        """All committed records of a partition (test/debug; reference
+        LogStreamPrinter / RecordStream asserts)."""
+        return list(self.partitions[partition_id].log.reader(0))
+
+    def close(self) -> None:
+        for partition in self.partitions:
+            partition.log.storage.close()
