@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""North-star benchmark: BPMN token transitions/sec on the device engine.
+
+Config 1 of BASELINE.json: the order-process single service-task sequence
+(reference ``samples/src/main/resources/demoProcess.bpmn`` analogue), driven
+entirely on device — CREATE commands staged in waves, the drive loop
+(zeebe_tpu/tpu/drive.py) feeding emissions back through the step kernel,
+synthetic instant workers completing jobs (the worker round-trip of
+``gateway/.../impl/subscription/job/JobSubscriber.java`` without leaving
+the device). Every processed record is one applied state transition — the
+unit the reference's StreamProcessorController handles one at a time.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "transitions/sec", "vs_baseline": N}
+vs_baseline is against the 10M transitions/sec north-star target
+(BASELINE.md; the reference publishes no absolute numbers).
+"""
+
+import dataclasses
+import json
+import time
+
+import numpy as np
+
+
+def build_graph():
+    from zeebe_tpu.models.bpmn.builder import Bpmn
+    from zeebe_tpu.models.transform.transformer import transform_model
+    from zeebe_tpu.tpu import graph as graph_mod
+
+    model = (
+        Bpmn.create_process("order-process")
+        .start_event("start")
+        .service_task("collect-money", type="payment-service")
+        .end_event("end")
+        .done()
+    )
+    workflows = transform_model(model)
+    for i, wf in enumerate(workflows):
+        wf.key = 9
+        wf.version = 1
+    return graph_mod.compile_graph(workflows)
+
+
+def stage_creates(meta, wave, num_vars, interns):
+    """Columnar CREATE commands (payload {orderId, orderValue}) — the
+    ClientApiMessageHandler write path, batched."""
+    import jax.numpy as jnp
+
+    from zeebe_tpu.protocol.enums import RecordType, ValueType
+    from zeebe_tpu.protocol.intents import WorkflowInstanceIntent as WI
+    from zeebe_tpu.tpu import batch as rb
+    from zeebe_tpu.tpu.conditions import VT_NUM
+
+    b = rb.empty(wave, num_vars)
+    oid = meta.varspace.column("orderId")
+    oval = meta.varspace.column("orderValue")
+    v_vt = np.zeros((wave, num_vars), np.int8)
+    v_num = np.zeros((wave, num_vars), np.float64)
+    v_vt[:, oid] = VT_NUM
+    v_vt[:, oval] = VT_NUM
+    v_num[:, oid] = np.arange(wave)
+    v_num[:, oval] = 99.0
+    return dataclasses.replace(
+        b,
+        valid=jnp.ones((wave,), bool),
+        rtype=jnp.full((wave,), int(RecordType.COMMAND), jnp.int32),
+        vtype=jnp.full((wave,), int(ValueType.WORKFLOW_INSTANCE), jnp.int32),
+        intent=jnp.full((wave,), int(WI.CREATE), jnp.int32),
+        wf=jnp.zeros((wave,), jnp.int32),
+        v_vt=jnp.asarray(v_vt),
+        v_num=jnp.asarray(v_num),
+    )
+
+
+def main():
+    from zeebe_tpu import tpu as _tpu  # noqa: F401  (enables x64)
+    import jax
+    import jax.numpy as jnp
+
+    from zeebe_tpu.tpu import drive, hashmap, state as state_mod
+
+    backend = jax.default_backend()
+    accel = backend not in ("cpu",)
+    total_instances = 1 << 20 if accel else 1 << 12
+    wave = 1 << 17 if accel else 1 << 10
+    batch_size = wave
+    capacity = 4 * wave
+
+    graph, meta = build_graph()
+    meta.varspace.column("orderId")
+    meta.varspace.column("orderValue")
+    meta.varspace.column("paid")
+    num_vars = max(graph.num_vars, 8)
+    graph = dataclasses.replace(graph, num_vars=num_vars)
+
+    state = state_mod.make_state(
+        capacity=capacity,
+        num_vars=num_vars,
+        job_capacity=capacity,
+        sub_capacity=8,
+    )
+    # one worker subscription with unbounded credits
+    state = dataclasses.replace(
+        state,
+        sub_key=state.sub_key.at[0].set(1),
+        sub_type=state.sub_type.at[0].set(
+            meta.interns.intern("payment-service")
+        ),
+        sub_worker=state.sub_worker.at[0].set(meta.interns.intern("bench-worker")),
+        sub_credits=state.sub_credits.at[0].set(np.int32(2**31 - 1)),
+        sub_timeout=state.sub_timeout.at[0].set(300_000),
+        sub_valid=state.sub_valid.at[0].set(True),
+    )
+    queue = drive.make_queue(8 * wave, num_vars)
+    creates = stage_creates(meta, wave, num_vars, meta.interns)
+    enqueue_jit = jax.jit(drive.enqueue, donate_argnums=(0,))
+    rebuild_jit = jax.jit(
+        lambda st: dataclasses.replace(
+            st,
+            ei_map=hashmap.rebuild_from(
+                st.ei_map.keys.shape[0],
+                st.ei_key,
+                jnp.arange(st.ei_key.shape[0], dtype=jnp.int32),
+                st.ei_state >= 0,
+            )[0],
+            job_map=hashmap.rebuild_from(
+                st.job_map.keys.shape[0],
+                st.job_key,
+                jnp.arange(st.job_key.shape[0], dtype=jnp.int32),
+                st.job_state >= 0,
+            )[0],
+        ),
+        donate_argnums=(0,),
+    )
+
+    def run_wave(state, queue):
+        queue = enqueue_jit(queue, creates)
+        return drive.run_to_quiescence(
+            graph, state, queue, 0, batch_size, synthetic_workers=True
+        )
+
+    # warmup wave: compiles the kernel, populates caches
+    state, queue, warm = run_wave(state, queue)
+    state = rebuild_jit(state)
+
+    waves = max(total_instances // wave - 1, 1)
+    processed = 0
+    completed = 0
+    t0 = time.perf_counter()
+    for _ in range(waves):
+        state, queue, totals = run_wave(state, queue)
+        processed += totals["processed"]
+        completed += totals["completed_roots"]
+        state = rebuild_jit(state)
+    jax.block_until_ready(state.ei_state)
+    elapsed = time.perf_counter() - t0
+
+    assert completed == waves * wave, (completed, waves * wave)
+    tps = processed / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "bpmn_token_transitions_per_sec",
+                "value": round(tps, 1),
+                "unit": "transitions/sec",
+                "vs_baseline": round(tps / 10e6, 4),
+                "detail": {
+                    "backend": backend,
+                    "instances": waves * wave,
+                    "records": processed,
+                    "elapsed_sec": round(elapsed, 3),
+                    "wave": wave,
+                    "transitions_per_instance": round(processed / (waves * wave), 1),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -8,9 +8,18 @@ reference's strategy of running multi-node tests in one JVM
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax is imported anywhere. Forced (not setdefault): the
+# runner environment pre-sets JAX_PLATFORMS=axon (the tunneled TPU), but
+# tests must run on the virtual CPU mesh — the real chip is bench-only.
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Persistent compile cache: the step kernel is a large jit program; caching
+# makes repeat test runs fast.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 import pytest  # noqa: E402
 

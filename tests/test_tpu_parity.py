@@ -1,0 +1,393 @@
+"""Event-replay parity: TPU device engine vs host oracle engine.
+
+The correctness contract from BASELINE.json: the device kernel must produce
+the same committed record stream as the reference-semantics oracle for the
+same commands (SURVEY.md §5 — "the event log IS the trace"). Every scenario
+drives both engines through the broker runtime with identical inputs and
+compares the full log signature: position, record type, value type, intent,
+key, source position, rejection, activity, payload, scope, headers.
+
+Scenarios mirror BASELINE.json's benchmark configs: service-task sequence,
+exclusive-gateway split with json-el conditions, parallel fork/join, timer
+catch events, plus incident/rejection paths.
+"""
+
+import pytest
+
+from zeebe_tpu.engine.interpreter import WorkflowRepository
+from zeebe_tpu.gateway import ClientException, JobWorker, ZeebeClient
+from zeebe_tpu.models.bpmn.builder import Bpmn
+from zeebe_tpu.protocol.enums import RecordType, ValueType
+from zeebe_tpu.protocol.intents import WorkflowInstanceIntent as WI
+from zeebe_tpu.runtime import Broker, ControlledClock
+from zeebe_tpu.tpu import TpuPartitionEngine
+
+SIG_TYPES = {
+    int(ValueType.WORKFLOW_INSTANCE),
+    int(ValueType.JOB),
+    int(ValueType.INCIDENT),
+    int(ValueType.TIMER),
+}
+
+
+def record_signature(records):
+    out = []
+    for r in records:
+        if int(r.metadata.value_type) not in SIG_TYPES:
+            continue
+        out.append(
+            (
+                r.position,
+                int(r.metadata.record_type),
+                int(r.metadata.value_type),
+                int(r.metadata.intent),
+                r.key,
+                r.source_record_position,
+                int(r.metadata.rejection_type),
+                r.metadata.rejection_reason,
+                getattr(r.value, "activity_id", None) or None,
+                dict(getattr(r.value, "payload", {}) or {}),
+                getattr(r.value, "scope_instance_key", None),
+                getattr(r.value, "workflow_instance_key", None),
+                getattr(r.value, "retries", None),
+                getattr(r.value, "worker", None),
+                getattr(r.value, "error_type", None),
+                getattr(r.value, "error_message", None),
+                getattr(
+                    getattr(r.value, "headers", None), "activity_instance_key", None
+                ),
+            )
+        )
+    return out
+
+
+class DualRig:
+    """Runs the same scenario against oracle and TPU brokers."""
+
+    def __init__(self):
+        self.brokers = []
+        for tpu in (False, True):
+            clock = ControlledClock(start_ms=1_000_000)
+            if tpu:
+                repo = WorkflowRepository()
+                broker = Broker(
+                    num_partitions=1,
+                    clock=clock,
+                    engine_factory=lambda pid: TpuPartitionEngine(
+                        pid, 1, repository=repo, clock=clock
+                    ),
+                )
+            else:
+                broker = Broker(num_partitions=1, clock=clock)
+            broker._test_clock = clock
+            self.brokers.append(broker)
+
+    def run(self, scenario):
+        outcomes = []
+        for broker in self.brokers:
+            client = ZeebeClient(broker)
+            outcomes.append(scenario(broker, client, broker._test_clock))
+            broker.run_until_idle()
+        return outcomes
+
+    def assert_parity(self):
+        oracle = record_signature(self.brokers[0].records(0))
+        tpu = record_signature(self.brokers[1].records(0))
+        for i, (a, b) in enumerate(zip(oracle, tpu)):
+            assert a == b, f"record {i} mismatch:\n  oracle: {a}\n  tpu:    {b}"
+        assert len(oracle) == len(tpu), (
+            f"record count mismatch: oracle={len(oracle)} tpu={len(tpu)}\n"
+            f"oracle tail: {oracle[-4:]}\ntpu tail: {tpu[-4:]}"
+        )
+
+    def close(self):
+        for broker in self.brokers:
+            broker.close()
+
+
+@pytest.fixture
+def rig():
+    r = DualRig()
+    yield r
+    r.close()
+
+
+def order_process():
+    return (
+        Bpmn.create_process("order-process")
+        .start_event("start")
+        .service_task("collect-money", type="payment-service")
+        .end_event("end")
+        .done()
+    )
+
+
+def gateway_process():
+    b = Bpmn.create_process("decision").start_event("start").exclusive_gateway("split")
+    b.branch("$.orderValue >= 100").service_task(
+        "high", type="priority-service"
+    ).end_event("end-high")
+    b.branch(default=True).service_task("low", type="normal-service").end_event(
+        "end-low"
+    )
+    return b.done()
+
+
+def fork_join_process():
+    b = Bpmn.create_process("fork-join").start_event("start").parallel_gateway("fork")
+    branch1 = b.branch().service_task("task-a", type="svc-a")
+    branch2 = b.branch().service_task("task-b", type="svc-b")
+    branch1.parallel_gateway("join")
+    branch2.connect_to("join")
+    b.move_to("join").end_event("end")
+    return b.done()
+
+
+class TestServiceTaskParity:
+    def test_happy_path(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(order_process())
+            JobWorker(broker, "payment-service", lambda ctx: {"paid": True})
+            client.create_instance(
+                "order-process", payload={"orderId": 31243, "orderValue": 99}
+            )
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_multiple_instances(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(order_process())
+            JobWorker(
+                broker, "payment-service", lambda ctx: {"paid": True}, credits=64
+            )
+            for i in range(10):
+                client.create_instance("order-process", payload={"orderId": i})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_job_fail_and_retry(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(order_process())
+            attempts = []
+
+            def handler(ctx):
+                attempts.append(1)
+                if len(attempts) == 1:
+                    ctx.fail(retries=ctx.job.retries - 1)
+                    return None
+                return {"paid": True}
+
+            JobWorker(broker, "payment-service", handler)
+            client.create_instance("order-process", payload={"orderId": 1})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_job_no_retries_incident(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(order_process())
+
+            def handler(ctx):
+                ctx.fail(retries=0)
+
+            JobWorker(broker, "payment-service", handler)
+            client.create_instance("order-process", payload={"orderId": 1})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_job_timeout_reactivation(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(order_process())
+            seen = []
+
+            def handler(ctx):
+                seen.append(ctx.key)
+                if len(seen) == 1:
+                    ctx.finished = True  # crashed worker: never completes
+                    return None
+                return {"paid": True}
+
+            JobWorker(broker, "payment-service", handler, timeout_ms=5_000)
+            client.create_instance("order-process", payload={"orderId": 1})
+            broker.run_until_idle()
+            clock.advance(10_000)
+            broker.tick()
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_complete_unknown_job_rejected(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(order_process())
+            try:
+                client.complete_job(999999)
+            except ClientException:
+                pass
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_create_unknown_workflow_rejected(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(order_process())
+            try:
+                client.create_instance("no-such-process")
+            except ClientException:
+                pass
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+
+class TestExclusiveGatewayParity:
+    def test_condition_routes_high(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(gateway_process())
+            JobWorker(broker, "priority-service", lambda ctx: None)
+            JobWorker(broker, "normal-service", lambda ctx: None)
+            client.create_instance("decision", payload={"orderValue": 250})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_condition_routes_default(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(gateway_process())
+            JobWorker(broker, "priority-service", lambda ctx: None)
+            JobWorker(broker, "normal-service", lambda ctx: None)
+            client.create_instance("decision", payload={"orderValue": 42})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_condition_error_incident(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(gateway_process())
+            client.create_instance("decision", payload={"unrelated": 1})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_string_and_mixed_conditions(self, rig):
+        def scenario(broker, client, clock):
+            b = (
+                Bpmn.create_process("strings")
+                .start_event("start")
+                .exclusive_gateway("split")
+            )
+            b.branch('$.kind == "express" && $.weight < 10').service_task(
+                "a", type="svc-a"
+            ).end_event("end-a")
+            b.branch(default=True).service_task("b", type="svc-b").end_event("end-b")
+            client.deploy_model(b.done())
+            JobWorker(broker, "svc-a", lambda ctx: None)
+            JobWorker(broker, "svc-b", lambda ctx: None)
+            client.create_instance("strings", payload={"kind": "express", "weight": 5})
+            client.create_instance("strings", payload={"kind": "bulk", "weight": 5})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+
+class TestParallelGatewayParity:
+    def test_fork_join(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(fork_join_process())
+            JobWorker(broker, "svc-a", lambda ctx: {"a": 1})
+            JobWorker(broker, "svc-b", lambda ctx: {"b": 2})
+            client.create_instance("fork-join", payload={"seed": 7})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_fork_join_many(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(fork_join_process())
+            JobWorker(broker, "svc-a", lambda ctx: {"a": 1}, credits=64)
+            JobWorker(broker, "svc-b", lambda ctx: {"b": 2}, credits=64)
+            for i in range(5):
+                client.create_instance("fork-join", payload={"seed": i})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+
+class TestTimerParity:
+    def test_timer_catch_event(self, rig):
+        def scenario(broker, client, clock):
+            model = (
+                Bpmn.create_process("timed")
+                .start_event("start")
+                .timer_catch_event("wait", duration_ms=60_000)
+                .end_event("end")
+                .done()
+            )
+            client.deploy_model(model)
+            client.create_instance("timed", payload={"x": 1})
+            broker.run_until_idle()
+            clock.advance(120_000)
+            broker.tick()
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+
+class TestMappingParity:
+    def test_io_mappings(self, rig):
+        def scenario(broker, client, clock):
+            model = (
+                Bpmn.create_process("mapped")
+                .start_event("start")
+                .service_task(
+                    "work",
+                    type="svc",
+                    inputs=[("$.total", "$.amount")],
+                    outputs=[("$.result", "$.outcome")],
+                )
+                .end_event("end")
+                .done()
+            )
+            client.deploy_model(model)
+            JobWorker(broker, "svc", lambda ctx: {"result": 41})
+            client.create_instance("mapped", payload={"total": 99, "noise": 1})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_input_mapping_error_incident(self, rig):
+        def scenario(broker, client, clock):
+            model = (
+                Bpmn.create_process("mapped-err")
+                .start_event("start")
+                .service_task("work", type="svc", inputs=[("$.missing", "$.amount")])
+                .end_event("end")
+                .done()
+            )
+            client.deploy_model(model)
+            client.create_instance("mapped-err", payload={"total": 99})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+
+class TestInstanceCounts:
+    def test_completion_events_present(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(order_process())
+            JobWorker(broker, "payment-service", lambda ctx: {"paid": True})
+            client.create_instance("order-process", payload={"v": 1})
+
+        rig.run(scenario)
+        for broker in rig.brokers:
+            completed = [
+                r
+                for r in broker.records(0)
+                if int(r.metadata.value_type) == int(ValueType.WORKFLOW_INSTANCE)
+                and int(r.metadata.record_type) == int(RecordType.EVENT)
+                and int(r.metadata.intent) == int(WI.ELEMENT_COMPLETED)
+                and r.value.activity_id == "order-process"
+            ]
+            assert len(completed) == 1

@@ -1,0 +1,31 @@
+"""TPU-native execution engine.
+
+This package is the device-side replacement for the reference's per-record
+stream processors (``broker-core/.../logstreams/processor/TypedStreamProcessor.java``,
+``broker-core/.../workflow/processor/BpmnStepProcessor.java``): committed
+records are processed in batches by one ``jax.jit`` step kernel that applies
+all BPMN/job state transitions as masked SIMD updates over struct-of-arrays
+state resident in HBM, and emits follow-up records via fixed-slot emission +
+prefix-sum compaction (replay-parity with the host oracle engine in
+``zeebe_tpu.engine.interpreter``).
+
+Module map:
+
+- ``intern``    — host string interning (ids are what the device sees)
+- ``hashmap``   — open-addressing i64→i32 hash table in HBM (zb-map analogue)
+- ``conditions``— json-el condition compiler → device predicate programs
+- ``graph``     — ExecutableWorkflow set → tensor tables (the "compiled BPMN")
+- ``batch``     — SoA record batches + host<->device conversion
+- ``state``     — engine state pytree (element instances, jobs, joins, subs)
+- ``kernel``    — THE step kernel
+- ``engine``    — host wrapper: partition processor API over the kernel
+
+Keys are int64 (the reference's keyspace is 64-bit, KeyGenerator.java); the
+package enables jax x64 at import.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from zeebe_tpu.tpu.engine import TpuPartitionEngine  # noqa: E402,F401

@@ -1,0 +1,202 @@
+"""SoA record batches: the device form of log records.
+
+A batch is the columnar image of a contiguous log range (the unit the kernel
+processes per invocation), mirroring the logical record layout of the
+reference protocol (``protocol/src/main/resources/protocol.xml`` metadata +
+value fields): record type / value type / intent / key plus the value
+columns the kernel needs. Payloads are columnarized over the graph's
+variable space; strings are interned ids.
+
+Emissions reuse the same layout — the kernel's output batch IS the next
+input batch (plus host bookkeeping columns: source row, response/push
+flags, rejection codes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from zeebe_tpu.tpu.conditions import (
+    VT_ABSENT,
+    VT_BOOL,
+    VT_FLOAT,
+    VT_NIL,
+    VT_NUM,
+    VT_STR,
+)
+from zeebe_tpu.tpu.intern import InternTable
+
+# ---------------------------------------------------------------------------
+# rejection / incident codes (device → host reason strings)
+# ---------------------------------------------------------------------------
+
+REJ_NONE = 0
+REJ_JOB_NOT_ACTIVATABLE = 1
+REJ_JOB_NOT_COMPLETABLE = 2
+REJ_JOB_NOT_ACTIVATED = 3
+REJ_JOB_NOT_FAILED = 4
+REJ_RETRIES_NOT_POSITIVE = 5
+REJ_JOB_NOT_EXIST = 6
+REJ_TIMER_NOT_EXIST = 7
+
+# incident error codes (emitted on INCIDENT CREATE commands)
+ERR_CONDITION_NO_FLOW = 101
+ERR_CONDITION_EVAL = 102
+ERR_IO_MAPPING_IN = 103
+ERR_IO_MAPPING_OUT = 104
+
+# reason strings match the oracle engine exactly (interpreter.py)
+REJECTION_REASONS = {
+    REJ_JOB_NOT_ACTIVATABLE: "Job is not in one of these states: CREATED, FAILED, TIMED_OUT",
+    REJ_JOB_NOT_COMPLETABLE: "Job is not in state: ACTIVATED, TIMED_OUT",
+    REJ_JOB_NOT_ACTIVATED: "Job is not in state ACTIVATED",
+    REJ_JOB_NOT_FAILED: "Job is not in state FAILED",
+    REJ_RETRIES_NOT_POSITIVE: "Retries must be greater than 0",
+    REJ_JOB_NOT_EXIST: "Job does not exist",
+    REJ_TIMER_NOT_EXIST: "timer does not exist",
+}
+
+_FIELDS = [
+    "valid", "rtype", "vtype", "intent", "key", "elem", "wf",
+    "instance_key", "scope_key", "v_vt", "v_num", "v_str",
+    "req", "req_stream", "aux_key", "aux2_key", "type_id", "retries",
+    "deadline", "worker", "src", "resp", "push", "rej",
+]
+
+
+@partial(jax.tree_util.register_dataclass, data_fields=_FIELDS, meta_fields=[])
+@dataclasses.dataclass
+class RecordBatch:
+    valid: jax.Array        # [B] bool
+    rtype: jax.Array        # [B] i32 RecordType
+    vtype: jax.Array        # [B] i32 ValueType
+    intent: jax.Array       # [B] i32
+    key: jax.Array          # [B] i64
+    elem: jax.Array         # [B] i32 element index (-1 n/a)
+    wf: jax.Array           # [B] i32 workflow slot (-1 n/a)
+    instance_key: jax.Array # [B] i64 workflowInstanceKey
+    scope_key: jax.Array    # [B] i64 scopeInstanceKey
+    v_vt: jax.Array         # [B, V] i8 payload types
+    v_num: jax.Array        # [B, V] f64
+    v_str: jax.Array        # [B, V] i32
+    req: jax.Array          # [B] i64 request id (-1 none)
+    req_stream: jax.Array   # [B] i32 request stream / subscriber key
+    aux_key: jax.Array      # [B] i64 job activityInstanceKey / incident aik / timer aik
+    aux2_key: jax.Array     # [B] i64 incident jobKey / timer dueDate
+    type_id: jax.Array      # [B] i32 job type (interned)
+    retries: jax.Array      # [B] i32
+    deadline: jax.Array     # [B] i64
+    worker: jax.Array       # [B] i32 interned worker name
+    src: jax.Array          # [B] i32 source row in the previous batch (-1 host)
+    resp: jax.Array         # [B] bool respond to req at append
+    push: jax.Array         # [B] bool push to req_stream subscriber
+    rej: jax.Array          # [B] i32 rejection / incident code
+
+    @property
+    def size(self) -> int:
+        return self.valid.shape[0]
+
+    @property
+    def num_vars(self) -> int:
+        return self.v_vt.shape[1]
+
+
+def empty(size: int, num_vars: int) -> RecordBatch:
+    i64, i32, i8, f64 = jnp.int64, jnp.int32, jnp.int8, jnp.float64
+    z64 = lambda: jnp.full((size,), -1, i64)  # noqa: E731
+    z32 = lambda: jnp.full((size,), -1, i32)  # noqa: E731
+    return RecordBatch(
+        valid=jnp.zeros((size,), bool),
+        rtype=jnp.zeros((size,), i32),
+        vtype=jnp.zeros((size,), i32),
+        intent=jnp.zeros((size,), i32),
+        key=z64(),
+        elem=z32(),
+        wf=z32(),
+        instance_key=z64(),
+        scope_key=z64(),
+        v_vt=jnp.zeros((size, num_vars), i8),
+        v_num=jnp.zeros((size, num_vars), f64),
+        v_str=jnp.zeros((size, num_vars), i32),
+        req=z64(),
+        req_stream=z32(),
+        aux_key=z64(),
+        aux2_key=z64(),
+        type_id=jnp.zeros((size,), i32),
+        retries=jnp.zeros((size,), i32),
+        deadline=z64(),
+        worker=jnp.zeros((size,), i32),
+        src=z32(),
+        resp=jnp.zeros((size,), bool),
+        push=jnp.zeros((size,), bool),
+        rej=jnp.zeros((size,), i32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# host payload conversion
+# ---------------------------------------------------------------------------
+
+
+class PayloadError(ValueError):
+    """Payload not columnarizable (nested document / unknown type) — the
+    caller must fall back to the host oracle engine."""
+
+
+def payload_to_columns(
+    doc: Dict[str, Any],
+    column_of,          # name -> column (VarSpace.column, growable)
+    interns: InternTable,
+    num_vars: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    vt = np.zeros((num_vars,), np.int8)
+    num = np.zeros((num_vars,), np.float64)
+    sid = np.zeros((num_vars,), np.int32)
+    for name, value in doc.items():
+        col = column_of(name)
+        if col >= num_vars:
+            raise PayloadError(f"variable space overflow: {name}")
+        if value is None:
+            vt[col] = VT_NIL
+        elif isinstance(value, bool):
+            vt[col] = VT_BOOL
+            num[col] = 1.0 if value else 0.0
+        elif isinstance(value, int):
+            vt[col] = VT_NUM
+            num[col] = float(value)
+        elif isinstance(value, float):
+            vt[col] = VT_FLOAT
+            num[col] = value
+        elif isinstance(value, str):
+            vt[col] = VT_STR
+            sid[col] = interns.intern(value)
+        else:
+            raise PayloadError(f"non-scalar payload value for {name!r}: {value!r}")
+    return vt, num, sid
+
+
+def columns_to_payload(
+    vt: np.ndarray, num: np.ndarray, sid: np.ndarray, names, interns: InternTable
+) -> Dict[str, Any]:
+    doc: Dict[str, Any] = {}
+    for col, name in enumerate(names):
+        t = int(vt[col])
+        if t == VT_ABSENT:
+            continue
+        if t == VT_NIL:
+            doc[name] = None
+        elif t == VT_BOOL:
+            doc[name] = bool(num[col])
+        elif t == VT_NUM:
+            doc[name] = int(num[col])
+        elif t == VT_FLOAT:
+            doc[name] = float(num[col])
+        elif t == VT_STR:
+            doc[name] = interns.string(int(sid[col]))
+    return doc

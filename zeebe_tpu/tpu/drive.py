@@ -1,0 +1,174 @@
+"""Device-resident record queue + drive loop.
+
+The broker's hot loop (``StreamProcessorController.java:296-399``) reads
+committed records and feeds follow-ups back into the log. On device, that
+feedback must not cross the host boundary: emissions are enqueued into an
+HBM ring buffer (the dispatcher/"write buffer" analogue,
+``dispatcher/.../Dispatcher.java:222``) and dequeued as the next fixed-size
+input batch. One host sync per round (the pending-record count scalar)
+drives the loop; everything else stays on device.
+
+The bench and the (future) batched broker path both run on this driver; the
+durability path drains the same emissions to the host log asynchronously.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from zeebe_tpu.protocol.enums import RecordType, ValueType
+from zeebe_tpu.protocol.intents import JobIntent as JI
+from zeebe_tpu.tpu import batch as rb
+from zeebe_tpu.tpu.batch import RecordBatch
+from zeebe_tpu.tpu.graph import DeviceGraph
+from zeebe_tpu.tpu.kernel import step_kernel
+from zeebe_tpu.tpu.state import EngineState
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["rows", "head", "count"],
+    meta_fields=[],
+)
+@dataclasses.dataclass
+class RecordQueue:
+    rows: RecordBatch  # capacity Q storage; only [head, head+count) live
+    head: jax.Array    # i32 scalar
+    count: jax.Array   # i32 scalar
+
+    @property
+    def capacity(self) -> int:
+        return self.rows.size
+
+
+def make_queue(capacity: int, num_vars: int) -> RecordQueue:
+    return RecordQueue(
+        rows=rb.empty(capacity, num_vars),
+        head=jnp.zeros((), jnp.int32),
+        count=jnp.zeros((), jnp.int32),
+    )
+
+
+def _rows_at(store: RecordBatch, idx) -> RecordBatch:
+    return jax.tree.map(lambda a: a[idx], store)
+
+
+def _store_rows(store: RecordBatch, idx, rows: RecordBatch, mask) -> RecordBatch:
+    cap = store.size
+    widx = jnp.where(mask, idx, cap)
+    return jax.tree.map(
+        lambda a, r: a.at[widx].set(r, mode="drop"), store, rows
+    )
+
+
+def enqueue(queue: RecordQueue, batch: RecordBatch) -> RecordQueue:
+    """Append the valid rows of ``batch`` (already compacted: valid rows form
+    a prefix) to the queue."""
+    cap = queue.capacity
+    n = batch.size
+    add = jnp.sum(batch.valid, dtype=jnp.int32)
+    idx = (queue.head + queue.count + jnp.arange(n, dtype=jnp.int32)) % cap
+    rows = _store_rows(queue.rows, idx, batch, batch.valid)
+    return RecordQueue(rows=rows, head=queue.head, count=queue.count + add)
+
+
+def dequeue(queue: RecordQueue, batch_size: int) -> Tuple[RecordQueue, RecordBatch]:
+    cap = queue.capacity
+    take = jnp.minimum(queue.count, batch_size)
+    idx = (queue.head + jnp.arange(batch_size, dtype=jnp.int32)) % cap
+    batch = _rows_at(queue.rows, idx)
+    live = jnp.arange(batch_size, dtype=jnp.int32) < take
+    batch = dataclasses.replace(batch, valid=batch.valid & live)
+    return (
+        RecordQueue(
+            rows=queue.rows,
+            head=(queue.head + take) % cap,
+            count=queue.count - take,
+        ),
+        batch,
+    )
+
+
+def _synthetic_complete(out: RecordBatch) -> RecordBatch:
+    """Bench-only instant worker: turn pushed ACTIVATED job events into
+    COMPLETE commands (models the external worker round-trip of
+    ``gateway/.../impl/subscription/job/JobSubscriber.java:51`` without
+    leaving the device)."""
+    is_act = (
+        out.valid
+        & (out.vtype == int(ValueType.JOB))
+        & (out.intent == int(JI.ACTIVATED))
+        & out.push
+    )
+    return dataclasses.replace(
+        out,
+        valid=is_act,
+        rtype=jnp.where(is_act, int(RecordType.COMMAND), out.rtype),
+        intent=jnp.where(is_act, int(JI.COMPLETE), out.intent),
+        push=jnp.zeros_like(out.push),
+        resp=jnp.zeros_like(out.resp),
+        req=jnp.full_like(out.req, -1),
+        src=jnp.full_like(out.src, -1),
+    )
+
+
+def drive_round(
+    graph: DeviceGraph,
+    state: EngineState,
+    queue: RecordQueue,
+    now,
+    batch_size: int,
+    synthetic_workers: bool = False,
+):
+    """Dequeue one batch, step the kernel, enqueue the emissions.
+
+    Returns (state, queue, stats). jit-compiled per (batch_size, shapes).
+    """
+    queue, batch = dequeue(queue, batch_size)
+    state, out, stats = step_kernel(graph, state, batch, now)
+    queue = enqueue(queue, out)
+    if synthetic_workers:
+        queue = enqueue(queue, _synthetic_complete(out))
+    return state, queue, stats
+
+
+drive_jit = jax.jit(
+    drive_round,
+    static_argnames=("batch_size", "synthetic_workers"),
+    donate_argnums=(1, 2),
+)
+
+
+def run_to_quiescence(
+    graph: DeviceGraph,
+    state: EngineState,
+    queue: RecordQueue,
+    now,
+    batch_size: int,
+    synthetic_workers: bool = False,
+    max_rounds: int = 10_000,
+):
+    """Host loop: drive rounds until the queue drains. Returns
+    (state, queue, totals dict)."""
+    totals = {"processed": 0, "emitted": 0, "completed_roots": 0, "rounds": 0}
+    for _ in range(max_rounds):
+        if int(queue.count) == 0:
+            break
+        state, queue, stats = drive_jit(
+            graph, state, queue, jnp.asarray(now, jnp.int64),
+            batch_size, synthetic_workers,
+        )
+        if bool(stats["overflow"]):
+            raise RuntimeError("device table overflow during drive loop")
+        totals["processed"] += int(stats["processed"])
+        totals["emitted"] += int(stats["emitted"])
+        totals["completed_roots"] += int(stats["completed_roots"])
+        totals["rounds"] += 1
+    else:
+        raise RuntimeError("drive loop did not quiesce")
+    return state, queue, totals

@@ -1,0 +1,636 @@
+"""Host wrapper: a batched partition stream processor over the step kernel.
+
+``TpuPartitionEngine`` is the device-backed drop-in for the host oracle
+``PartitionEngine`` (``zeebe_tpu/engine/interpreter.py``): the broker feeds
+it committed records (in log order) and gets back written follow-ups,
+responses, cross-partition sends, and worker pushes — but processing runs
+as SIMD batches on the accelerator.
+
+Routing: WORKFLOW_INSTANCE / JOB / TIMER records run on device; DEPLOYMENT,
+MESSAGE, MESSAGE_SUBSCRIPTION and INCIDENT records are delegated to an
+embedded host oracle engine (they are rare control-plane work — the
+reference likewise runs deployments on the system partition only,
+``DeploymentCreateEventProcessor``). Emissions are merged back in source
+order, which preserves the oracle's append order (each record's follow-ups
+appended after the whole committed batch, record-major).
+
+Workflows must be device-compatible (``graph.check_device_compatible``);
+deploying an incompatible one raises — such topics belong on an
+oracle-backed partition instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from zeebe_tpu.engine.interpreter import (
+    JobSubscription,
+    PartitionEngine,
+    ProcessingResult,
+    WorkflowRepository,
+)
+from zeebe_tpu.engine.mappings import MappingError, extract, merge
+from zeebe_tpu.models.el.interpreter import ConditionEvalError, evaluate_condition
+from zeebe_tpu.protocol.enums import ErrorType, RecordType, RejectionType, ValueType
+from zeebe_tpu.protocol.intents import (
+    JobIntent as JI,
+    WorkflowInstanceIntent as WI,
+)
+from zeebe_tpu.protocol.metadata import RecordMetadata
+from zeebe_tpu.protocol.records import (
+    IncidentRecord,
+    JobHeaders,
+    JobRecord,
+    Record,
+    TimerRecord,
+    WorkflowInstanceRecord,
+)
+from zeebe_tpu.tpu import batch as rb
+from zeebe_tpu.tpu import graph as graph_mod
+from zeebe_tpu.tpu import kernel, state as state_mod
+from zeebe_tpu.tpu.batch import PayloadError, RecordBatch
+from zeebe_tpu.tpu.conditions import DeviceIneligible
+from zeebe_tpu.tpu.intern import InternTable
+
+_DEVICE_VALUE_TYPES = {
+    int(ValueType.WORKFLOW_INSTANCE),
+    int(ValueType.JOB),
+    int(ValueType.TIMER),
+}
+
+_ERR_NO_RETRIES = 105  # kernel's JOB_NO_RETRIES incident code
+
+
+def _pow2(n: int) -> int:
+    p = 64
+    while p < n:
+        p *= 2
+    return p
+
+
+class TpuPartitionEngine:
+    """Batched device stream processor for one partition."""
+
+    def __init__(
+        self,
+        partition_id: int = 0,
+        num_partitions: int = 1,
+        repository: Optional[WorkflowRepository] = None,
+        clock: Optional[Callable[[], int]] = None,
+        capacity: int = 1 << 12,
+        num_vars: int = 16,
+        sub_capacity: int = 16,
+    ):
+        self.partition_id = partition_id
+        self.num_partitions = num_partitions
+        self.repository = repository if repository is not None else WorkflowRepository()
+        self.clock = clock or (lambda: 0)
+        self.capacity = capacity
+        self.num_vars = num_vars
+        self.interns = InternTable()
+
+        # host oracle engine for control-plane records (deployment, messages,
+        # incidents); shares the repository and the workflow keyspace via
+        # explicit counter sync after each batch
+        self._host = PartitionEngine(
+            partition_id=partition_id,
+            num_partitions=num_partitions,
+            repository=self.repository,
+            clock=self.clock,
+        )
+
+        self.graph: Optional[graph_mod.DeviceGraph] = None
+        self.meta: Optional[graph_mod.GraphMeta] = None
+        self.state = state_mod.make_state(
+            capacity=capacity, num_vars=num_vars, sub_capacity=sub_capacity
+        )
+        self._compiled_count = 0
+        self.records_by_position: Dict[int, Record] = {}
+        self.last_processed_position = -1
+
+    # -- routing ----------------------------------------------------------
+    def partition_for_correlation_key(self, correlation_key: str) -> int:
+        return self._host.partition_for_correlation_key(correlation_key)
+
+    # -- deployment → graph recompile -------------------------------------
+    def _recompile(self) -> None:
+        workflows = []
+        for wf in sorted(self.repository.by_key, key=lambda k: k):
+            workflows.append(self.repository.by_key[wf])
+        for wf in workflows:
+            reason = graph_mod.check_device_compatible(wf)
+            if reason is not None:
+                raise DeviceIneligible(
+                    f"workflow '{wf.id}' cannot run on a TPU partition: {reason}"
+                )
+        var_names = list(self.meta.varspace.names) if self.meta else []
+        self.graph, self.meta = graph_mod.compile_graph(
+            workflows, interns=self.interns, extra_variables=var_names
+        )
+        if self.graph.num_vars > self.num_vars:
+            raise PayloadError(
+                f"workflow variables ({self.graph.num_vars}) exceed engine "
+                f"num_vars={self.num_vars}; raise num_vars"
+            )
+        self._compiled_count = len(workflows)
+
+    def _var_column(self, name: str) -> int:
+        if self.meta is None:
+            raise PayloadError("no workflows deployed")
+        col = self.meta.varspace.column(name)
+        if col >= self.num_vars:
+            raise PayloadError(f"variable space overflow at {name!r}")
+        return col
+
+    # -- worker subscriptions (host-managed device table) ------------------
+    def add_job_subscription(self, sub: JobSubscription) -> None:
+        s = self.state
+        valid = np.asarray(s.sub_valid)
+        free = int(np.argmin(valid)) if not valid.all() else -1
+        if free < 0 or valid[free]:
+            raise RuntimeError("subscription table full")
+        self.state = dataclasses.replace(
+            s,
+            sub_key=s.sub_key.at[free].set(sub.subscriber_key),
+            sub_type=s.sub_type.at[free].set(self.interns.intern(sub.job_type)),
+            sub_worker=s.sub_worker.at[free].set(self.interns.intern(sub.worker)),
+            sub_credits=s.sub_credits.at[free].set(sub.credits),
+            sub_timeout=s.sub_timeout.at[free].set(sub.timeout),
+            sub_valid=s.sub_valid.at[free].set(True),
+        )
+
+    def remove_job_subscription(self, subscriber_key: int) -> None:
+        s = self.state
+        match = np.asarray(s.sub_key) == subscriber_key
+        self.state = dataclasses.replace(
+            s, sub_valid=s.sub_valid & jnp.asarray(~match)
+        )
+
+    def increase_job_credits(self, subscriber_key: int, credits: int) -> None:
+        s = self.state
+        match = jnp.asarray(np.asarray(s.sub_key) == subscriber_key)
+        self.state = dataclasses.replace(
+            s, sub_credits=s.sub_credits + jnp.where(match, credits, 0)
+        )
+
+    # -- deadline scans (broker tick) --------------------------------------
+    def check_job_deadlines(self) -> List[Record]:
+        now = self.clock()
+        s = self.state
+        keys = np.asarray(s.job_key)
+        states = np.asarray(s.job_state)
+        deadlines = np.asarray(s.job_deadline)
+        due = (states == int(JI.ACTIVATED)) & (deadlines >= 0) & (deadlines <= now)
+        out = []
+        for slot in np.nonzero(due)[0][np.argsort(keys[np.nonzero(due)[0]])]:
+            out.append(
+                Record(
+                    key=int(keys[slot]),
+                    metadata=RecordMetadata(
+                        record_type=RecordType.COMMAND,
+                        value_type=ValueType.JOB,
+                        intent=int(JI.TIME_OUT),
+                    ),
+                    value=self._job_value_from_slot(int(slot)),
+                )
+            )
+        return out
+
+    def check_timer_deadlines(self) -> List[Record]:
+        now = self.clock()
+        s = self.state
+        keys = np.asarray(s.timer_key)
+        due = (keys >= 0) & (np.asarray(s.timer_due) <= now)
+        slots = np.nonzero(due)[0]
+        out = []
+        for slot in slots[np.argsort(keys[slots])]:
+            slot = int(slot)
+            out.append(
+                Record(
+                    key=int(keys[slot]),
+                    metadata=RecordMetadata(
+                        record_type=RecordType.COMMAND,
+                        value_type=ValueType.TIMER,
+                        intent=2,  # TimerIntent.TRIGGER
+                    ),
+                    value=TimerRecord(
+                        workflow_instance_key=int(
+                            np.asarray(s.timer_instance_key)[slot]
+                        ),
+                        activity_instance_key=int(np.asarray(s.timer_aik)[slot]),
+                        due_date=int(np.asarray(s.timer_due)[slot]),
+                        handler_element_id=self.meta.element_id(
+                            int(np.asarray(s.timer_wf)[slot]),
+                            int(np.asarray(s.timer_elem)[slot]),
+                        ),
+                    ),
+                )
+            )
+        return out
+
+    def check_message_ttls(self) -> List[Record]:
+        return self._host.check_message_ttls()
+
+    def _job_value_from_slot(self, slot: int) -> JobRecord:
+        s = self.state
+        wf_slot = int(np.asarray(s.job_wf)[slot])
+        elem = int(np.asarray(s.job_elem)[slot])
+        workflow = (
+            self.meta.workflows[wf_slot]
+            if self.meta and 0 <= wf_slot < len(self.meta.workflows)
+            else None
+        )
+        return JobRecord(
+            type=self.interns.string(int(np.asarray(s.job_type)[slot])) or "",
+            retries=int(np.asarray(s.job_retries)[slot]),
+            deadline=int(np.asarray(s.job_deadline)[slot]),
+            worker=self.interns.string(int(np.asarray(s.job_worker)[slot])) or "",
+            payload=rb.columns_to_payload(
+                np.asarray(s.job_vt)[slot],
+                np.asarray(s.job_num)[slot],
+                np.asarray(s.job_str)[slot],
+                self.meta.varspace.names if self.meta else [],
+                self.interns,
+            ),
+            headers=JobHeaders(
+                workflow_instance_key=int(np.asarray(s.job_instance_key)[slot]),
+                bpmn_process_id=workflow.id if workflow else "",
+                workflow_definition_version=workflow.version if workflow else -1,
+                workflow_key=workflow.key if workflow else -1,
+                activity_id=self.meta.element_id(wf_slot, elem) if self.meta else "",
+                activity_instance_key=int(np.asarray(s.job_aik)[slot]),
+            ),
+        )
+
+    # ------------------------------------------------------------------
+    # batch processing
+    # ------------------------------------------------------------------
+    def process(self, record: Record) -> ProcessingResult:
+        """Single-record convenience (tests); real throughput uses
+        process_batch."""
+        return self.process_batch([record])
+
+    def process_batch(self, records: List[Record]) -> ProcessingResult:
+        for record in records:
+            self.records_by_position[record.position] = record
+            self._host.records_by_position[record.position] = record
+
+        per_record: List[ProcessingResult] = [None] * len(records)
+        device_rows: List[int] = []
+        for i, record in enumerate(records):
+            vt = int(record.metadata.value_type)
+            if vt in _DEVICE_VALUE_TYPES and self.meta is not None:
+                device_rows.append(i)
+            else:
+                deployed_before = len(self.repository.by_key)
+                per_record[i] = self._host.process(record)
+                if len(self.repository.by_key) != deployed_before:
+                    self._recompile()
+
+        if device_rows:
+            results = self._process_device(
+                [records[i] for i in device_rows],
+                [records[i].position for i in device_rows],
+            )
+            for i, res in zip(device_rows, results):
+                per_record[i] = res
+
+        merged = ProcessingResult()
+        for res in per_record:
+            if res is None:
+                continue
+            merged.written.extend(res.written)
+            merged.responses.extend(res.responses)
+            merged.sends.extend(res.sends)
+            merged.pushes.extend(res.pushes)
+        if records:
+            self.last_processed_position = records[-1].position
+        return merged
+
+    # -- host record → batch row -------------------------------------------
+    def _stage(self, records: List[Record]) -> RecordBatch:
+        n = len(records)
+        size = _pow2(n)
+        v = self.num_vars
+        cols: Dict[str, np.ndarray] = {
+            "valid": np.zeros(size, bool),
+            "rtype": np.zeros(size, np.int32),
+            "vtype": np.zeros(size, np.int32),
+            "intent": np.zeros(size, np.int32),
+            "key": np.full(size, -1, np.int64),
+            "elem": np.full(size, -1, np.int32),
+            "wf": np.full(size, -1, np.int32),
+            "instance_key": np.full(size, -1, np.int64),
+            "scope_key": np.full(size, -1, np.int64),
+            "v_vt": np.zeros((size, v), np.int8),
+            "v_num": np.zeros((size, v), np.float64),
+            "v_str": np.zeros((size, v), np.int32),
+            "req": np.full(size, -1, np.int64),
+            "req_stream": np.full(size, -1, np.int32),
+            "aux_key": np.full(size, -1, np.int64),
+            "aux2_key": np.full(size, -1, np.int64),
+            "type_id": np.zeros(size, np.int32),
+            "retries": np.zeros(size, np.int32),
+            "deadline": np.full(size, -1, np.int64),
+            "worker": np.zeros(size, np.int32),
+            "src": np.full(size, -1, np.int32),
+            "resp": np.zeros(size, bool),
+            "push": np.zeros(size, bool),
+            "rej": np.zeros(size, np.int32),
+        }
+        for i, record in enumerate(records):
+            self._stage_row(cols, i, record)
+        return RecordBatch(**{k: jnp.asarray(a) for k, a in cols.items()})
+
+    def _stage_row(self, cols, i, record: Record) -> None:
+        md = record.metadata
+        vt = int(md.value_type)
+        cols["valid"][i] = True
+        cols["rtype"][i] = int(md.record_type)
+        cols["vtype"][i] = vt
+        cols["intent"][i] = int(md.intent)
+        cols["key"][i] = record.key
+        cols["req"][i] = md.request_id
+        cols["req_stream"][i] = md.request_stream_id
+        value = record.value
+        if vt == int(ValueType.WORKFLOW_INSTANCE):
+            wf_slot = self.meta.slot(value.workflow_key)
+            if (
+                int(md.record_type) == int(RecordType.COMMAND)
+                and int(md.intent) == int(WI.CREATE)
+            ):
+                workflow = self._resolve_workflow(value)
+                wf_slot = self.meta.slot(workflow.key) if workflow else -1
+            cols["wf"][i] = wf_slot
+            if wf_slot >= 0 and value.activity_id:
+                cols["elem"][i] = self.meta.elem_idx[wf_slot].get(
+                    value.activity_id, -1
+                )
+            cols["instance_key"][i] = value.workflow_instance_key
+            cols["scope_key"][i] = value.scope_instance_key
+            self._stage_payload(cols, i, value.payload)
+        elif vt == int(ValueType.JOB):
+            cols["type_id"][i] = self.interns.intern(value.type) if value.type else 0
+            cols["retries"][i] = value.retries
+            cols["deadline"][i] = value.deadline
+            cols["worker"][i] = (
+                self.interns.intern(value.worker) if value.worker else 0
+            )
+            headers = value.headers
+            cols["aux_key"][i] = headers.activity_instance_key
+            cols["instance_key"][i] = headers.workflow_instance_key
+            wf_slot = self.meta.slot(headers.workflow_key)
+            cols["wf"][i] = wf_slot
+            if wf_slot >= 0 and headers.activity_id:
+                cols["elem"][i] = self.meta.elem_idx[wf_slot].get(
+                    headers.activity_id, -1
+                )
+            self._stage_payload(cols, i, value.payload)
+        elif vt == int(ValueType.TIMER):
+            cols["aux_key"][i] = value.activity_instance_key
+            cols["instance_key"][i] = value.workflow_instance_key
+            cols["deadline"][i] = value.due_date
+
+    def _stage_payload(self, cols, i, payload) -> None:
+        if not payload:
+            return
+        vt, num, sid = rb.payload_to_columns(
+            payload, self._var_column, self.interns, self.num_vars
+        )
+        cols["v_vt"][i] = vt
+        cols["v_num"][i] = num
+        cols["v_str"][i] = sid
+
+    def _resolve_workflow(self, value: WorkflowInstanceRecord):
+        if value.workflow_key > 0:
+            return self.repository.by_key.get(value.workflow_key)
+        if value.version > 0:
+            return self.repository.by_id_and_version(
+                value.bpmn_process_id, value.version
+            )
+        return self.repository.latest(value.bpmn_process_id)
+
+    # -- device round -------------------------------------------------------
+    def _process_device(
+        self, records: List[Record], positions: List[int]
+    ) -> List[ProcessingResult]:
+        results = [ProcessingResult() for _ in records]
+        # Job-incident bookkeeping lives in the host engine (incident records
+        # are host-processed); mirror the oracle's _incident_on_job_event
+        # markers when the corresponding JOB events flow through the device.
+        for i, record in enumerate(records):
+            md = record.metadata
+            if int(md.value_type) != int(ValueType.JOB) or int(
+                md.record_type
+            ) != int(RecordType.EVENT):
+                continue
+            intent = int(md.intent)
+            if intent == int(JI.FAILED) and record.value.retries <= 0:
+                # NON_PERSISTENT_INCIDENT marker; the device emits the
+                # incident CREATE command itself
+                self._host.incident_by_failed_job[record.key] = -2
+            elif intent in (int(JI.RETRIES_UPDATED), int(JI.CANCELED)):
+                self._host._incident_on_job_event(record, results[i])
+        # CREATE commands with unknown workflows are rejected host-side,
+        # mirroring CreateWorkflowInstanceEventProcessor's rejection
+        rejected = set()
+        for i, record in enumerate(records):
+            md = record.metadata
+            if (
+                int(md.value_type) == int(ValueType.WORKFLOW_INSTANCE)
+                and int(md.record_type) == int(RecordType.COMMAND)
+                and int(md.intent) == int(WI.CREATE)
+                and self._resolve_workflow(record.value) is None
+            ):
+                value = record.value.copy()
+                value.workflow_instance_key = self._next_wf_key_host()
+                rejection = Record(
+                    key=record.key,
+                    source_record_position=record.position,
+                    metadata=RecordMetadata(
+                        record_type=RecordType.COMMAND_REJECTION,
+                        value_type=ValueType.WORKFLOW_INSTANCE,
+                        intent=int(WI.CREATE),
+                        rejection_type=RejectionType.BAD_VALUE,
+                        rejection_reason="Workflow is not deployed",
+                        request_id=md.request_id,
+                        request_stream_id=md.request_stream_id,
+                    ),
+                    value=value,
+                )
+                results[i].written.append(rejection)
+                results[i].responses.append(rejection)
+                rejected.add(i)
+
+        live = [i for i in range(len(records)) if i not in rejected]
+        if not live:
+            return results
+        batch = self._stage([records[i] for i in live])
+        now = jnp.asarray(self.clock(), jnp.int64)
+        self.state, out, stats = kernel.step_jit(self.graph, self.state, batch, now)
+        if bool(stats["overflow"]):
+            raise RuntimeError(
+                "device table overflow — raise TpuPartitionEngine capacity"
+            )
+        self._emit_records(out, [positions[i] for i in live], results, live)
+        return results
+
+    def _next_wf_key_host(self) -> int:
+        """Allocate a workflow key host-side, keeping the device counter in
+        sync (rejections consume a key in the oracle too)."""
+        key = int(np.asarray(self.state.next_wf_key))
+        self.state = dataclasses.replace(
+            self.state,
+            next_wf_key=self.state.next_wf_key + 5,
+        )
+        return key
+
+    # -- emission → host records -------------------------------------------
+    def _emit_records(
+        self,
+        out: RecordBatch,
+        src_positions: List[int],
+        results: List[ProcessingResult],
+        live_rows: List[int],
+    ) -> None:
+        o = {f.name: np.asarray(getattr(out, f.name)) for f in dataclasses.fields(out)}
+        count = int(o["valid"].sum())
+        names = self.meta.varspace.names
+        for r in range(count):
+            src = int(o["src"][r])
+            record = self._materialize(o, r, names)
+            record.source_record_position = (
+                src_positions[src] if 0 <= src < len(src_positions) else -1
+            )
+            res = results[live_rows[src]] if 0 <= src < len(live_rows) else results[0]
+            res.written.append(record)
+            if o["resp"][r] and int(o["req"][r]) >= 0:
+                res.responses.append(record)
+            if o["push"][r]:
+                res.pushes.append((int(o["req_stream"][r]), record))
+
+    def _materialize(self, o, r, names) -> Record:
+        vt = int(o["vtype"][r])
+        rt = int(o["rtype"][r])
+        intent = int(o["intent"][r])
+        rej = int(o["rej"][r])
+        wf_slot = int(o["wf"][r])
+        elem = int(o["elem"][r])
+        payload = rb.columns_to_payload(
+            o["v_vt"][r], o["v_num"][r], o["v_str"][r], names, self.interns
+        )
+        workflow = (
+            self.meta.workflows[wf_slot]
+            if 0 <= wf_slot < len(self.meta.workflows)
+            else None
+        )
+        elem_id = self.meta.element_id(wf_slot, elem)
+        element = (
+            workflow.elements[elem] if workflow and 0 <= elem < len(workflow.elements)
+            else None
+        )
+
+        md = RecordMetadata(
+            record_type=RecordType(rt),
+            value_type=ValueType(vt),
+            intent=intent,
+            request_id=int(o["req"][r]),
+            request_stream_id=int(o["req_stream"][r]),
+        )
+        if rt == int(RecordType.COMMAND_REJECTION):
+            md.rejection_type = (
+                RejectionType.BAD_VALUE
+                if rej == rb.REJ_RETRIES_NOT_POSITIVE
+                else RejectionType.NOT_APPLICABLE
+            )
+            md.rejection_reason = rb.REJECTION_REASONS.get(rej, "")
+
+        if vt == int(ValueType.WORKFLOW_INSTANCE):
+            value = WorkflowInstanceRecord(
+                bpmn_process_id=workflow.id if workflow else "",
+                version=workflow.version if workflow else -1,
+                workflow_key=workflow.key if workflow else -1,
+                workflow_instance_key=int(o["instance_key"][r]),
+                activity_id=elem_id,
+                payload=payload,
+                scope_instance_key=int(o["scope_key"][r]),
+            )
+        elif vt == int(ValueType.JOB):
+            value = JobRecord(
+                type=self.interns.string(int(o["type_id"][r])) or "",
+                retries=int(o["retries"][r]),
+                deadline=int(o["deadline"][r]),
+                worker=self.interns.string(int(o["worker"][r])) or "",
+                payload=payload,
+                custom_headers=dict(element.job_headers) if element else {},
+                headers=JobHeaders(
+                    workflow_instance_key=int(o["instance_key"][r]),
+                    bpmn_process_id=workflow.id if workflow else "",
+                    workflow_definition_version=workflow.version if workflow else -1,
+                    workflow_key=workflow.key if workflow else -1,
+                    activity_id=elem_id,
+                    activity_instance_key=int(o["aux_key"][r]),
+                ),
+            )
+        elif vt == int(ValueType.INCIDENT):
+            error_type, message = self._incident_error(o, r, element, payload, rej)
+            value = IncidentRecord(
+                error_type=int(error_type),
+                error_message=message,
+                bpmn_process_id=workflow.id if workflow else "",
+                workflow_instance_key=int(o["instance_key"][r]),
+                activity_id=elem_id,
+                activity_instance_key=int(o["aux_key"][r]),
+                job_key=int(o["aux2_key"][r]),
+                payload=payload,
+            )
+        elif vt == int(ValueType.TIMER):
+            value = TimerRecord(
+                workflow_instance_key=int(o["instance_key"][r]),
+                activity_instance_key=int(o["aux_key"][r]),
+                due_date=int(o["deadline"][r]),
+                handler_element_id=elem_id,
+            )
+        else:
+            value = None
+        return Record(key=int(o["key"][r]), metadata=md, value=value)
+
+    def _incident_error(self, o, r, element, payload, rej):
+        """Reconstruct the oracle's exact incident error message by
+        re-running the failing host evaluation (incidents are rare; the
+        device only ships an error code)."""
+        if rej == rb.ERR_CONDITION_NO_FLOW:
+            return (
+                ErrorType.CONDITION_ERROR,
+                "All conditions evaluated to false and no default flow is set.",
+            )
+        if rej == rb.ERR_CONDITION_EVAL and element is not None:
+            try:
+                for flow in element.outgoing_with_condition:
+                    evaluate_condition(flow.condition, payload)
+            except ConditionEvalError as e:
+                return ErrorType.CONDITION_ERROR, str(e)
+            return ErrorType.CONDITION_ERROR, "condition evaluation failed"
+        if rej in (rb.ERR_IO_MAPPING_IN, rb.ERR_IO_MAPPING_OUT) and element is not None:
+            mappings = (
+                element.input_mappings
+                if rej == rb.ERR_IO_MAPPING_IN
+                else element.output_mappings
+            )
+            try:
+                if rej == rb.ERR_IO_MAPPING_IN:
+                    extract(payload, mappings)
+                else:
+                    merge(payload, {}, mappings)
+            except MappingError as e:
+                return ErrorType.IO_MAPPING_ERROR, str(e)
+            return ErrorType.IO_MAPPING_ERROR, "io mapping failed"
+        if rej == _ERR_NO_RETRIES:
+            return ErrorType.JOB_NO_RETRIES, "No more retries left."
+        return ErrorType.UNKNOWN, ""

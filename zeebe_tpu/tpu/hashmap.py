@@ -1,0 +1,156 @@
+"""HBM-resident open-addressing hash table: int64 key → int32 slot.
+
+TPU-native analogue of the reference's off-heap hash maps
+(``zb-map/src/main/java/io/zeebe/map/ZbMap.java:37`` — Long2Long maps over
+bucket buffer arrays): the table is a pair of device arrays (keys, vals),
+capacity a power of two, linear probing, batched vectorized operations:
+
+- ``lookup``: gather-probe loop, all queries in parallel.
+- ``insert``: deterministic parallel claims — per probe round, each pending
+  insert scatters its batch rank onto its candidate bucket with
+  ``scatter-min``; the unique winner writes, losers advance their probe.
+  Assumes batch keys are unique (engine keys are monotone counters).
+- ``delete``: probe to the key's bucket, write a tombstone.
+
+Tombstones keep probe chains intact; the engine rebuilds the table
+(``rebuild_from``) when live+dead load crosses ``REBUILD_LOAD`` — the
+analogue of ZbMap's block splitting/shrinking (``ZbMap.java:45``).
+
+All ops are jit-compatible and deterministic (scatter conflicts resolved by
+batch rank, never by scheduling).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+EMPTY = -1
+TOMBSTONE = -2
+MAX_PROBES = 32
+REBUILD_LOAD = 0.45
+
+_BIG = jnp.iinfo(jnp.int32).max
+
+
+@partial(jax.tree_util.register_dataclass, data_fields=["keys", "vals"], meta_fields=[])
+@dataclasses.dataclass
+class HashTable:
+    keys: jax.Array  # [T] int64; EMPTY / TOMBSTONE sentinels
+    vals: jax.Array  # [T] int32
+
+
+def make(capacity: int) -> HashTable:
+    assert capacity & (capacity - 1) == 0, "capacity must be a power of two"
+    return HashTable(
+        keys=jnp.full((capacity,), EMPTY, dtype=jnp.int64),
+        vals=jnp.zeros((capacity,), dtype=jnp.int32),
+    )
+
+
+def _hash(keys: jax.Array, table_size: int) -> jax.Array:
+    # Fibonacci (golden-ratio) multiplicative hash, then fold high bits.
+    h = keys * jnp.int64(-7046029254386353131)  # 0x9E3779B97F4A7C15 as signed
+    h = h ^ (h >> jnp.int64(31))
+    return (h & jnp.int64(table_size - 1)).astype(jnp.int32)
+
+
+def lookup(table: HashTable, keys: jax.Array, valid: jax.Array):
+    """Batched lookup. Returns (found [B] bool, vals [B] i32)."""
+    table_size = table.keys.shape[0]
+    h0 = _hash(keys, table_size)
+
+    def body(i, carry):
+        found, vals, done = carry
+        idx = (h0 + i) & (table_size - 1)
+        k = table.keys[idx]
+        hit = (~done) & (k == keys)
+        found = found | hit
+        vals = jnp.where(hit, table.vals[idx], vals)
+        # an EMPTY bucket terminates the chain; TOMBSTONE does not
+        done = done | hit | (k == EMPTY)
+        return found, vals, done
+
+    found = jnp.zeros(keys.shape, dtype=bool)
+    vals = jnp.full(keys.shape, -1, dtype=jnp.int32)
+    done = ~valid
+    found, vals, _ = lax.fori_loop(0, MAX_PROBES, body, (found, vals, done))
+    return found, vals
+
+
+def insert(table: HashTable, keys: jax.Array, vals: jax.Array, valid: jax.Array):
+    """Batched insert of unique keys. Returns (table', inserted [B] bool).
+
+    ``inserted`` is False for entries that could not be placed within
+    MAX_PROBES (over-full table) — the engine must rebuild larger then.
+    """
+    table_size = table.keys.shape[0]
+    batch = keys.shape[0]
+    vals = vals.astype(jnp.int32)
+    h0 = _hash(keys, table_size)
+    rank = jnp.arange(batch, dtype=jnp.int32)
+
+    def body(_, carry):
+        tkeys, tvals, pending, probe = carry
+        idx = (h0 + probe) & (table_size - 1)
+        free = tkeys[idx] == EMPTY
+        attempt = pending & free
+        # deterministic bucket claim: lowest batch rank wins
+        order = jnp.where(attempt, rank, _BIG)
+        claims = jnp.full((table_size,), _BIG, dtype=jnp.int32).at[idx].min(
+            order, mode="drop"
+        )
+        win = attempt & (claims[idx] == rank)
+        widx = jnp.where(win, idx, table_size)
+        tkeys = tkeys.at[widx].set(keys, mode="drop")
+        tvals = tvals.at[widx].set(vals, mode="drop")
+        pending = pending & ~win
+        probe = jnp.where(pending, probe + 1, probe)
+        return tkeys, tvals, pending, probe
+
+    probe = jnp.zeros((batch,), dtype=jnp.int32)
+    tkeys, tvals, pending, _ = lax.fori_loop(
+        0, MAX_PROBES, body, (table.keys, table.vals, valid, probe)
+    )
+    return HashTable(tkeys, tvals), valid & ~pending
+
+
+def delete(table: HashTable, keys: jax.Array, valid: jax.Array) -> HashTable:
+    """Batched delete: the key's bucket becomes a tombstone."""
+    table_size = table.keys.shape[0]
+    h0 = _hash(keys, table_size)
+
+    def body(i, carry):
+        slot, done = carry
+        idx = (h0 + i) & (table_size - 1)
+        k = table.keys[idx]
+        hit = (~done) & (k == keys)
+        slot = jnp.where(hit, idx, slot)
+        done = done | hit | (k == EMPTY)
+        return slot, done
+
+    slot = jnp.full(keys.shape, table_size, dtype=jnp.int32)
+    slot, _ = lax.fori_loop(0, MAX_PROBES, body, (slot, ~valid))
+    tkeys = table.keys.at[slot].set(TOMBSTONE, mode="drop")
+    return HashTable(tkeys, table.vals)
+
+
+def rebuild_from(capacity: int, keys: jax.Array, vals: jax.Array, valid: jax.Array):
+    """Fresh table from live entries (tombstone purge / growth).
+
+    Returns (table, all_inserted bool scalar).
+    """
+    table = make(capacity)
+    table, inserted = insert(table, keys, vals, valid)
+    return table, jnp.all(inserted == valid)
+
+
+def fill_counts(table: HashTable):
+    """(live, dead) bucket counts — host uses these to decide on rebuilds."""
+    live = jnp.sum(table.keys >= 0)
+    dead = jnp.sum(table.keys == TOMBSTONE)
+    return live, dead

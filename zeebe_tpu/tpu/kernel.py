@@ -1,0 +1,1156 @@
+"""THE step kernel: one jit'd application of all stream processors to a
+record batch.
+
+This replaces the reference's per-record hot loop
+(``logstreams/.../processor/StreamProcessorController.java:296-399`` driving
+``BpmnStepProcessor.processRecord`` and the job/incident processors) with a
+single SIMD pass: every record in the batch is routed, guarded, and stepped
+in parallel; follow-up records are produced into fixed emission slots and
+compacted; state lands via deterministic scatters (conflicts resolved by
+batch rank or flow position, never by scheduling). Feeding emissions back
+as the next batch reproduces the oracle's serial log exactly — a batch is a
+contiguous log range, and slot order (record-major, then emission slot)
+equals the oracle's append order.
+
+Kernel phases:
+  A. hash lookups (record key / scope key / job aik → table slots)
+  B. routing + step guards (BpmnStepProcessor.java:127-151 semantics)
+  C. masked per-step compute: payload mappings, condition programs,
+     parallel-join arrival merge, job state machine, timers
+  D. key assignment (strided counters + prefix sums — KeyGenerator parity)
+  E. emissions → compaction; state scatters; table insert/delete
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from zeebe_tpu.engine import keyspace
+from zeebe_tpu.models.transform.steps import BpmnStep as BS
+from zeebe_tpu.protocol.enums import RecordType, ValueType
+from zeebe_tpu.protocol.intents import (
+    JobIntent as JI,
+    TimerIntent as TI,
+    WorkflowInstanceIntent as WI,
+)
+from zeebe_tpu.tpu import batch as rb
+from zeebe_tpu.tpu import hashmap
+from zeebe_tpu.tpu.batch import RecordBatch
+from zeebe_tpu.tpu.conditions import ERROR as TRI_ERROR
+from zeebe_tpu.tpu.conditions import TRUE as TRI_TRUE
+from zeebe_tpu.tpu.conditions import VT_ABSENT, eval_programs
+from zeebe_tpu.tpu.graph import DeviceGraph
+from zeebe_tpu.tpu.state import EngineState
+
+RT_EVENT = int(RecordType.EVENT)
+RT_CMD = int(RecordType.COMMAND)
+RT_REJ = int(RecordType.COMMAND_REJECTION)
+VT_WI = int(ValueType.WORKFLOW_INSTANCE)
+VT_JOB = int(ValueType.JOB)
+VT_INCIDENT = int(ValueType.INCIDENT)
+VT_TIMER = int(ValueType.TIMER)
+
+_KEY_STEP = keyspace.STEP_SIZE
+
+
+def _excl_cumsum(x):
+    c = jnp.cumsum(x)
+    return c - x
+
+
+def _last_writer(slots, mask, size):
+    """True for the highest-batch-rank writer per target slot (deterministic
+    conflict resolution for duplicate scatters)."""
+    n = slots.shape[0]
+    rank = jnp.arange(n, dtype=jnp.int32)
+    tgt = jnp.where(mask, slots, size)
+    best = jnp.full((size + 1,), -1, jnp.int32).at[tgt].max(
+        jnp.where(mask, rank, -1), mode="drop"
+    )
+    return mask & (best[jnp.clip(tgt, 0, size)] == rank)
+
+
+def _scatter_payload(vt, num, sid, slots, mask, b_vt, b_num, b_sid, size):
+    """Write batch payload rows into table rows at ``slots`` (last writer
+    wins)."""
+    win = _last_writer(slots, mask, size)
+    idx = jnp.where(win, slots, size)
+    vt = vt.at[idx].set(b_vt, mode="drop")
+    num = num.at[idx].set(b_num, mode="drop")
+    sid = sid.at[idx].set(b_sid, mode="drop")
+    return vt, num, sid
+
+
+def _apply_mappings(graph, wf, elem, src_vt, src_num, src_sid, is_input):
+    """Vectorized MappingProcessor.extract (input) source selection.
+
+    Returns (dst_from [B, V] source column per target column or -1,
+    has_mappings [B], root [B], err [B] — any listed source absent).
+    """
+    b = wf.shape[0]
+    v = src_vt.shape[1]
+    if is_input:
+        m_src, m_dst, m_n, m_root = (
+            graph.in_map_src, graph.in_map_dst, graph.in_map_n, graph.in_root
+        )
+    else:
+        m_src, m_dst, m_n, m_root = (
+            graph.out_map_src, graph.out_map_dst, graph.out_map_n, graph.out_root
+        )
+    k_max = m_src.shape[2]
+    rows = jnp.arange(b, dtype=jnp.int32)
+    dst_from = jnp.full((b, v), -1, jnp.int32)
+    err = jnp.zeros((b,), bool)
+    for k in range(k_max):
+        src = m_src[wf, elem, k]
+        dst = m_dst[wf, elem, k]
+        active = src >= 0
+        src_c = jnp.clip(src, 0, v - 1)
+        err = err | (active & (src_vt[rows, src_c] == VT_ABSENT))
+        dst_c = jnp.where(active, dst, v)
+        dst_from = dst_from.at[rows, dst_c].set(src, mode="drop")
+    has = m_n[wf, elem] > 0
+    root = m_root[wf, elem]
+    return dst_from, has, root, err
+
+
+def _select_by_map(dst_from, vt, num, sid):
+    """payload'[v] = payload[dst_from[v]] (absent where dst_from = -1)."""
+    c = jnp.clip(dst_from, 0, vt.shape[1] - 1)
+    got = dst_from >= 0
+    take = lambda a, fill: jnp.where(got, jnp.take_along_axis(a, c, axis=1), fill)  # noqa: E731
+    return (
+        take(vt, jnp.int8(VT_ABSENT)),
+        take(num, 0.0),
+        take(sid, 0),
+    )
+
+
+def step_kernel(
+    graph: DeviceGraph, state: EngineState, batch: RecordBatch, now
+) -> Tuple[EngineState, RecordBatch, dict]:
+    """Process one committed-record batch; returns (state', emissions, stats).
+
+    Emissions are compacted in oracle append order; ``emissions.src`` links
+    each emission to its source row (host assigns positions/responses).
+    """
+    b = batch.size
+    v = state.num_vars
+    e_w = graph.emit_width
+    n_cap = state.capacity
+    m_cap = state.job_key.shape[0]
+    j_cap = state.join_key.shape[0]
+    t_cap = state.timer_key.shape[0]
+    s_cap = state.sub_key.shape[0]
+    rows = jnp.arange(b, dtype=jnp.int32)
+
+    valid = batch.valid
+    rt, vt_, it = batch.rtype, batch.vtype, batch.intent
+    wf_c = jnp.clip(batch.wf, 0, graph.elem_type.shape[0] - 1)
+    el_c = jnp.clip(batch.elem, 0, graph.elem_type.shape[1] - 1)
+
+    # ---------------- A. lookups ----------------
+    is_wi = valid & (vt_ == VT_WI)
+    wi_ev = is_wi & (rt == RT_EVENT)
+    wi_cmd = is_wi & (rt == RT_CMD)
+    is_job = valid & (vt_ == VT_JOB)
+    job_cmd = is_job & (rt == RT_CMD)
+    job_ev = is_job & (rt == RT_EVENT)
+    timer_cmd = valid & (vt_ == VT_TIMER) & (rt == RT_CMD)
+
+    ei_found, ei_slot = hashmap.lookup(state.ei_map, batch.key, wi_ev)
+    sc_found, sc_slot = hashmap.lookup(
+        state.ei_map, batch.scope_key, wi_ev & (batch.scope_key >= 0)
+    )
+    aik_found, aik_slot = hashmap.lookup(
+        state.ei_map, batch.aux_key, job_ev | timer_cmd
+    )
+    jb_found, jb_slot = hashmap.lookup(
+        state.job_map, batch.key, job_cmd & (batch.key >= 0)
+    )
+    tm_found, tm_slot = hashmap.lookup(
+        state.timer_map, batch.key, timer_cmd & (batch.key >= 0)
+    )
+    ei_clip = jnp.clip(ei_slot, 0, n_cap - 1)
+    sc_clip = jnp.clip(sc_slot, 0, n_cap - 1)
+    aik_clip = jnp.clip(aik_slot, 0, n_cap - 1)
+    jb_clip = jnp.clip(jb_slot, 0, m_cap - 1)
+    tm_clip = jnp.clip(tm_slot, 0, t_cap - 1)
+
+    inst_state = jnp.where(ei_found, state.ei_state[ei_clip], -1)
+    scope_state = jnp.where(sc_found, state.ei_state[sc_clip], -1)
+
+    # ---------------- B. routing + guards ----------------
+    m_create = wi_cmd & (it == int(WI.CREATE)) & (batch.wf >= 0)
+    m_created_ev = wi_ev & (it == int(WI.CREATED))
+
+    g_own = (
+        (it == int(WI.ELEMENT_READY))
+        | (it == int(WI.ELEMENT_ACTIVATED))
+        | (it == int(WI.ELEMENT_COMPLETING))
+    )
+    g_flow = (
+        (it == int(WI.END_EVENT_OCCURRED))
+        | (it == int(WI.GATEWAY_ACTIVATED))
+        | (it == int(WI.START_EVENT_OCCURRED))
+        | (it == int(WI.SEQUENCE_FLOW_TAKEN))
+    )
+    guard = jnp.where(
+        g_own,
+        ei_found & (inst_state == it),
+        jnp.where(
+            it == int(WI.ELEMENT_COMPLETED),
+            sc_found & (scope_state == int(WI.ELEMENT_ACTIVATED)),
+            jnp.where(
+                it == int(WI.ELEMENT_TERMINATED),
+                sc_found & (scope_state == int(WI.ELEMENT_TERMINATING)),
+                jnp.where(
+                    g_flow, sc_found & (scope_state == int(WI.ELEMENT_ACTIVATED)), True
+                ),
+            ),
+        ),
+    )
+    shall = ei_found | sc_found
+    stepped = wi_ev & ~m_created_ev & shall & guard & (batch.wf >= 0) & (batch.elem >= 0)
+    step_id = jnp.where(
+        stepped,
+        graph.step_table[wf_c, el_c, jnp.clip(it, 0, graph.step_table.shape[2] - 1)],
+        int(BS.NONE),
+    )
+
+    def m_step(s):
+        return stepped & (step_id == int(s))
+
+    m_take = m_step(BS.TAKE_SEQUENCE_FLOW)
+    m_consume = m_step(BS.CONSUME_TOKEN)
+    m_xsplit = m_step(BS.EXCLUSIVE_SPLIT)
+    m_createjob = m_step(BS.CREATE_JOB)
+    m_inmap = m_step(BS.APPLY_INPUT_MAPPING)
+    m_outmap = m_step(BS.APPLY_OUTPUT_MAPPING)
+    m_actgw = m_step(BS.ACTIVATE_GATEWAY)
+    m_startst = m_step(BS.START_STATEFUL_ELEMENT)
+    m_trigend = m_step(BS.TRIGGER_END_EVENT)
+    m_trigstart = m_step(BS.TRIGGER_START_EVENT)
+    m_complete_proc = m_step(BS.COMPLETE_PROCESS)
+    m_psplit = m_step(BS.PARALLEL_SPLIT)
+    m_pmerge = m_step(BS.PARALLEL_MERGE)
+    m_timer_step = m_step(BS.CREATE_TIMER)
+
+    # job commands
+    job_state = jnp.where(jb_found, state.job_state[jb_clip], -1)
+    m_jcreate = job_cmd & (it == int(JI.CREATE))
+    m_jactivate = job_cmd & (it == int(JI.ACTIVATE))
+    m_jcomplete = job_cmd & (it == int(JI.COMPLETE))
+    m_jfail = job_cmd & (it == int(JI.FAIL))
+    m_jtimeout = job_cmd & (it == int(JI.TIME_OUT))
+    m_jretries = job_cmd & (it == int(JI.UPDATE_RETRIES))
+    m_jcancel = job_cmd & (it == int(JI.CANCEL))
+
+    activatable = (
+        (job_state == int(JI.CREATED))
+        | (job_state == int(JI.FAILED))
+        | (job_state == int(JI.TIMED_OUT))
+    )
+    completable = (job_state == int(JI.ACTIVATED)) | (job_state == int(JI.TIMED_OUT))
+    jact_ok = m_jactivate & jb_found & activatable
+    jact_rej = m_jactivate & ~(jb_found & activatable)
+    jcomp_ok = m_jcomplete & jb_found & completable
+    jcomp_rej = m_jcomplete & ~(jb_found & completable)
+    jfail_ok = m_jfail & jb_found & (job_state == int(JI.ACTIVATED))
+    jfail_rej = m_jfail & ~(jb_found & (job_state == int(JI.ACTIVATED)))
+    jtime_ok = m_jtimeout & jb_found & (job_state == int(JI.ACTIVATED))
+    jtime_rej = m_jtimeout & ~(jb_found & (job_state == int(JI.ACTIVATED)))
+    jret_ok = m_jretries & jb_found & (job_state == int(JI.FAILED)) & (batch.retries > 0)
+    jret_badv = m_jretries & jb_found & (job_state == int(JI.FAILED)) & (batch.retries <= 0)
+    jret_rej = m_jretries & ~(jb_found & (job_state == int(JI.FAILED)))
+    jcan_ok = m_jcancel & jb_found
+    jcan_rej = m_jcancel & ~jb_found
+
+    # job events (workflow-side processors + activation pool + incidents)
+    jev_created = job_ev & (it == int(JI.CREATED))
+    jev_completed = job_ev & (it == int(JI.COMPLETED)) & aik_found
+    m_actpool = job_ev & (
+        (it == int(JI.CREATED))
+        | (it == int(JI.TIMED_OUT))
+        | (it == int(JI.FAILED))
+        | (it == int(JI.RETRIES_UPDATED))
+    ) & (batch.retries > 0)
+    jev_fail_noretry = job_ev & (it == int(JI.FAILED)) & (batch.retries <= 0)
+
+    # timer commands
+    m_tcreate = timer_cmd & (it == int(TI.CREATE))
+    ttrig_ok = timer_cmd & (it == int(TI.TRIGGER)) & tm_found
+    ttrig_rej = timer_cmd & (it == int(TI.TRIGGER)) & ~tm_found
+    tcan_ok = timer_cmd & (it == int(TI.CANCEL)) & tm_found
+    # timer trigger resumes the catch event when still active
+    ttrig_inst = ttrig_ok & aik_found & (
+        jnp.where(aik_found, state.ei_state[aik_clip], -1) == int(WI.ELEMENT_ACTIVATED)
+    )
+
+    # ---------------- C. per-step compute ----------------
+    # exclusive split: evaluate conditioned flows in order
+    fan = graph.cond_flows.shape[2]
+    cflow = graph.cond_flows[wf_c, el_c]          # [B, F]
+    cprog = graph.cond_prog[wf_c, el_c]           # [B, F]
+    has_cond = cprog >= 0
+    tri = eval_programs(
+        graph.progs,
+        graph.lit_nums,
+        cprog,
+        jnp.broadcast_to(batch.v_vt[:, None, :], (b, fan, v)),
+        jnp.broadcast_to(batch.v_num[:, None, :], (b, fan, v)),
+        jnp.broadcast_to(batch.v_str[:, None, :], (b, fan, v)),
+    )
+    tri = jnp.where(has_cond, tri, -1)
+    is_true = tri == TRI_TRUE
+    is_err = tri == TRI_ERROR
+    fidx = jnp.arange(fan, dtype=jnp.int32)
+    first_true = jnp.min(jnp.where(is_true, fidx, fan), axis=1)
+    first_err = jnp.min(jnp.where(is_err, fidx, fan), axis=1)
+    cond_errored = first_err < first_true
+    default_f = graph.default_flow[wf_c, el_c]
+    taken_flow = jnp.where(
+        first_true < fan,
+        cflow[rows, jnp.clip(first_true, 0, fan - 1)],
+        default_f,
+    )
+    xs_ok = m_xsplit & ~cond_errored & (taken_flow >= 0)
+    xs_nofl = m_xsplit & ~cond_errored & (taken_flow < 0)
+    xs_err = m_xsplit & cond_errored
+
+    # input mapping
+    in_from, in_has, in_root, in_err = _apply_mappings(
+        graph, wf_c, el_c, batch.v_vt, batch.v_num, batch.v_str, True
+    )
+    im_vt, im_num, im_sid = _select_by_map(in_from, batch.v_vt, batch.v_num, batch.v_str)
+    sel_in = (in_has & ~in_root)[:, None]
+    in_vt = jnp.where(sel_in, im_vt, batch.v_vt)
+    in_num = jnp.where(sel_in, im_num, batch.v_num)
+    in_sid = jnp.where(sel_in, im_sid, batch.v_str)
+    inmap_ok = m_inmap & ~(in_has & in_err)
+    inmap_err = m_inmap & in_has & in_err
+
+    # output mapping: merge(record payload → scope payload)
+    scope_vt = state.ei_vt[sc_clip]
+    scope_num = state.ei_num[sc_clip]
+    scope_sid = state.ei_str[sc_clip]
+    no_scope = ~sc_found
+    scope_vt = jnp.where(no_scope[:, None], jnp.int8(VT_ABSENT), scope_vt)
+    out_from, out_has, out_root, out_err = _apply_mappings(
+        graph, wf_c, el_c, batch.v_vt, batch.v_num, batch.v_str, False
+    )
+    om_vt, om_num, om_sid = _select_by_map(
+        out_from, batch.v_vt, batch.v_num, batch.v_str
+    )
+    behavior = graph.out_behavior[wf_c, el_c]
+    B_MERGE, B_OVERWRITE, B_NONE = 0, 1, 2
+    src_present = batch.v_vt != VT_ABSENT
+
+    def _merge_one(scope_a, src_a, mapped_a, fill):
+        base = jnp.where((behavior == B_OVERWRITE)[:, None], fill, scope_a)
+        with_maps = jnp.where(out_from >= 0, mapped_a, base)
+        without = jnp.where(
+            (behavior == B_OVERWRITE)[:, None],
+            src_a,
+            jnp.where(src_present, src_a, scope_a),
+        )
+        merged = jnp.where((out_has & ~out_root)[:, None], with_maps, jnp.where(
+            out_root[:, None], src_a, without))
+        return jnp.where((behavior == B_NONE)[:, None], scope_a, merged)
+
+    out_vt = _merge_one(scope_vt, batch.v_vt, om_vt, jnp.int8(VT_ABSENT))
+    out_num = _merge_one(scope_num, batch.v_num, om_num, 0.0)
+    out_sid = _merge_one(scope_sid, batch.v_str, om_sid, 0)
+    outmap_ok = m_outmap & ~(out_has & out_err)
+    outmap_err = m_outmap & out_has & out_err
+
+    # parallel join: composite key (scope_key, gateway element)
+    gw_elem = graph.flow_target[wf_c, el_c]
+    gw_clip = jnp.clip(gw_elem, 0, graph.elem_type.shape[1] - 1)
+    join_key = jnp.where(
+        m_pmerge, (batch.scope_key << jnp.int64(10)) | gw_clip.astype(jnp.int64), -1
+    )
+    jn_found, jn_slot = hashmap.lookup(state.join_map, join_key, m_pmerge)
+    # leaders: first batch occurrence of each missing join key (sort-dedup)
+    missing = m_pmerge & ~jn_found
+    sort_k = jnp.where(missing, join_key, jnp.int64(2**62))
+    order = jnp.argsort(sort_k, stable=True)
+    sorted_k = sort_k[order]
+    first_occ = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_k[1:] != sorted_k[:-1]]
+    )
+    leader = jnp.zeros((b,), bool).at[order].set(first_occ) & missing
+    # allocate join slots for leaders
+    join_free = jnp.nonzero(state.join_key < 0, size=b, fill_value=j_cap)[0]
+    l_rank = _excl_cumsum(leader.astype(jnp.int32))
+    l_slot = join_free[jnp.clip(l_rank, 0, b - 1)]
+    join_overflow = jnp.any(leader & (l_slot >= j_cap))
+    lw = jnp.where(leader, l_slot, j_cap)
+    join_key_arr = state.join_key.at[lw].set(join_key, mode="drop")
+    nin_here = graph.join_nin[wf_c, gw_clip]
+    join_nin_arr = state.join_nin.at[lw].set(nin_here, mode="drop")
+    jmap, jins = hashmap.insert(state.join_map, join_key, l_slot, leader)
+    # re-lookup so every arrival sees its slot
+    jn_found2, jn_slot2 = hashmap.lookup(jmap, join_key, m_pmerge)
+    arr_slot = jnp.clip(jn_slot2, 0, j_cap - 1)
+    my_pos = graph.join_pos[wf_c, el_c]
+    aw = jnp.where(m_pmerge & jn_found2, arr_slot, j_cap)
+    arrived = state.join_arrived.at[
+        aw, jnp.clip(my_pos, 0, state.join_arrived.shape[1] - 1)
+    ].set(True, mode="drop")
+    # flow-position-stamped payload merge: higher flow pos wins per variable
+    stamp = state.join_pos_stamp.at[aw].max(
+        jnp.where(src_present, my_pos[:, None], -1), mode="drop"
+    )
+    win_var = m_pmerge[:, None] & src_present & (
+        stamp[jnp.clip(aw, 0, j_cap - 1)] == my_pos[:, None]
+    )
+    aw_var = jnp.where(win_var, aw[:, None], j_cap)
+    cols = jnp.broadcast_to(jnp.arange(v, dtype=jnp.int32)[None, :], (b, v))
+    join_vt = state.join_vt.at[aw_var, cols].set(batch.v_vt, mode="drop")
+    join_num = state.join_num.at[aw_var, cols].set(batch.v_num, mode="drop")
+    join_sid = state.join_str.at[aw_var, cols].set(batch.v_str, mode="drop")
+    # completion: all incoming arrived; completer = last arrival in batch
+    arr_count = jnp.sum(arrived, axis=1).astype(jnp.int32)
+    complete_slot = (join_nin_arr > 0) & (arr_count >= join_nin_arr)
+    my_complete = m_pmerge & jn_found2 & complete_slot[arr_slot]
+    completer = _last_writer(arr_slot, my_complete, j_cap)
+    # merged payload for the completer
+    mg_vt = join_vt[arr_slot]
+    mg_num = join_num[arr_slot]
+    mg_sid = join_sid[arr_slot]
+
+    # ---------------- D. key assignment ----------------
+    out_count = graph.out_count[wf_c, el_c]
+    single_key = (
+        m_create | m_take | xs_ok | m_actgw | m_startst | m_trigend
+        | m_trigstart | completer | m_tcreate
+    )
+    n_wf = jnp.where(single_key, 1, jnp.where(m_psplit, out_count, 0))
+    wf_base = state.next_wf_key + _KEY_STEP * _excl_cumsum(n_wf).astype(jnp.int64)
+    key0 = wf_base  # key for single-allocation steps
+    n_job = m_jcreate.astype(jnp.int32)
+    job_base = state.next_job_key + _KEY_STEP * _excl_cumsum(n_job).astype(jnp.int64)
+    next_wf_key = state.next_wf_key + _KEY_STEP * jnp.sum(n_wf, dtype=jnp.int64)
+    next_job_key = state.next_job_key + _KEY_STEP * jnp.sum(n_job, dtype=jnp.int64)
+
+    # ---------------- job activation pool ----------------
+    # candidate subscription: first valid sub of the job's type (oracle
+    # round-robin degenerates to this for one subscription per type)
+    sub_match = (
+        state.sub_valid[None, :]
+        & (state.sub_type[None, :] == batch.type_id[:, None])
+        & (state.sub_credits[None, :] > 0)
+    )  # [B, S]
+    cand = jnp.argmax(sub_match, axis=1).astype(jnp.int32)
+    has_sub = jnp.any(sub_match, axis=1)
+    pool = m_actpool & has_sub
+    sub_credits = state.sub_credits
+    activated = jnp.zeros((b,), bool)
+    for s in range(s_cap):
+        mask_s = pool & (cand == s)
+        rank_s = _excl_cumsum(mask_s.astype(jnp.int32))
+        act_s = mask_s & (rank_s < sub_credits[s])
+        activated = activated | act_s
+        sub_credits = sub_credits.at[s].add(-jnp.sum(act_s, dtype=jnp.int32))
+    cand_c = jnp.clip(cand, 0, s_cap - 1)
+    act_deadline = now + state.sub_timeout[cand_c]
+    act_worker = state.sub_worker[cand_c]
+    act_stream = state.sub_key[cand_c].astype(jnp.int32)
+    # credit return on activate rejection
+    ret_idx = jnp.argmax(
+        state.sub_key[None, :] == batch.req_stream[:, None].astype(jnp.int64), axis=1
+    ).astype(jnp.int32)
+    ret_has = jnp.any(
+        state.sub_key[None, :] == batch.req_stream[:, None].astype(jnp.int64), axis=1
+    )
+    ret_w = jnp.where(jact_rej & ret_has, ret_idx, s_cap)
+    sub_credits = sub_credits.at[ret_w].add(1, mode="drop")
+
+    # ---------------- E. emissions ----------------
+    zero_vt = jnp.zeros((b, v), jnp.int8)
+    zero_num = jnp.zeros((b, v), jnp.float64)
+    zero_sid = jnp.zeros((b, v), jnp.int32)
+
+    def blank():
+        return {
+            "valid": jnp.zeros((b,), bool),
+            "rtype": jnp.zeros((b,), jnp.int32),
+            "vtype": jnp.zeros((b,), jnp.int32),
+            "intent": jnp.zeros((b,), jnp.int32),
+            "key": jnp.full((b,), -1, jnp.int64),
+            "elem": jnp.full((b,), -1, jnp.int32),
+            "wf": batch.wf,
+            "instance_key": batch.instance_key,
+            "scope_key": batch.scope_key,
+            "v_vt": batch.v_vt,
+            "v_num": batch.v_num,
+            "v_str": batch.v_str,
+            "req": jnp.full((b,), -1, jnp.int64),
+            "req_stream": jnp.full((b,), -1, jnp.int32),
+            "aux_key": jnp.full((b,), -1, jnp.int64),
+            "aux2_key": jnp.full((b,), -1, jnp.int64),
+            "type_id": jnp.zeros((b,), jnp.int32),
+            "retries": jnp.zeros((b,), jnp.int32),
+            "deadline": jnp.full((b,), -1, jnp.int64),
+            "worker": jnp.zeros((b,), jnp.int32),
+            "src": rows,
+            "resp": jnp.zeros((b,), bool),
+            "push": jnp.zeros((b,), bool),
+            "rej": jnp.zeros((b,), jnp.int32),
+        }
+
+    def put(em, mask, **kw):
+        for name, val in kw.items():
+            em[name] = jnp.where(mask, val, em[name])
+        return em
+
+    e0 = blank()
+    e1 = blank()
+
+    # --- slot 0: workflow-instance emissions
+    scope_parent = jnp.where(
+        sc_found, state.ei_scope_slot[sc_clip], -1
+    )
+    scope_parent_key = jnp.where(
+        scope_parent >= 0, state.ei_key[jnp.clip(scope_parent, 0, n_cap - 1)], -1
+    )
+    scope_elem = jnp.where(sc_found, state.ei_elem[sc_clip], -1)
+
+    e0 = put(
+        e0, m_create,
+        valid=True, rtype=RT_EVENT, vtype=VT_WI, intent=int(WI.CREATED),
+        key=key0, elem=0, instance_key=key0, scope_key=jnp.int64(-1),
+        req=batch.req, req_stream=batch.req_stream, resp=batch.req >= 0,
+    )
+    e1 = put(
+        e1, m_create,
+        valid=True, rtype=RT_EVENT, vtype=VT_WI, intent=int(WI.ELEMENT_READY),
+        key=key0, elem=0, instance_key=key0, scope_key=jnp.int64(-1),
+    )
+
+    first_out = graph.first_out_flow[wf_c, el_c]
+    e0 = put(
+        e0, m_take,
+        valid=True, rtype=RT_EVENT, vtype=VT_WI,
+        intent=int(WI.SEQUENCE_FLOW_TAKEN), key=key0, elem=first_out,
+    )
+    # consume token: the last consumed token completes the scope
+    tokens_after = jnp.zeros((n_cap,), jnp.int32).at[
+        jnp.where(m_consume, sc_clip, n_cap)
+    ].add(-1, mode="drop") + state.ei_tokens
+    consume_done = m_consume & (tokens_after[sc_clip] <= 0)
+    consume_completer = _last_writer(sc_clip, consume_done, n_cap)
+    e0 = put(
+        e0, consume_completer,
+        valid=True, rtype=RT_EVENT, vtype=VT_WI,
+        intent=int(WI.ELEMENT_COMPLETING), key=batch.scope_key, elem=scope_elem,
+        scope_key=scope_parent_key,
+    )
+    e0 = put(
+        e0, xs_ok,
+        valid=True, rtype=RT_EVENT, vtype=VT_WI,
+        intent=int(WI.SEQUENCE_FLOW_TAKEN), key=key0, elem=taken_flow,
+    )
+    e0 = put(
+        e0, xs_nofl | xs_err,
+        valid=True, rtype=RT_CMD, vtype=VT_INCIDENT, intent=0,  # IncidentIntent.CREATE
+        key=jnp.int64(-1), elem=batch.elem, aux_key=batch.key,
+        rej=jnp.where(xs_nofl, rb.ERR_CONDITION_NO_FLOW, rb.ERR_CONDITION_EVAL),
+    )
+    e0 = put(
+        e0, m_createjob,
+        valid=True, rtype=RT_CMD, vtype=VT_JOB, intent=int(JI.CREATE),
+        key=jnp.int64(-1), elem=batch.elem, aux_key=batch.key,
+        type_id=graph.job_type[wf_c, el_c], retries=graph.job_retries[wf_c, el_c],
+    )
+    e0 = put(
+        e0, inmap_ok,
+        valid=True, rtype=RT_EVENT, vtype=VT_WI,
+        intent=int(WI.ELEMENT_ACTIVATED), key=batch.key, elem=batch.elem,
+    )
+    e0["v_vt"] = jnp.where(inmap_ok[:, None], in_vt, e0["v_vt"])
+    e0["v_num"] = jnp.where(inmap_ok[:, None], in_num, e0["v_num"])
+    e0["v_str"] = jnp.where(inmap_ok[:, None], in_sid, e0["v_str"])
+    e0 = put(
+        e0, outmap_ok,
+        valid=True, rtype=RT_EVENT, vtype=VT_WI,
+        intent=int(WI.ELEMENT_COMPLETED), key=batch.key, elem=batch.elem,
+    )
+    e0["v_vt"] = jnp.where(outmap_ok[:, None], out_vt, e0["v_vt"])
+    e0["v_num"] = jnp.where(outmap_ok[:, None], out_num, e0["v_num"])
+    e0["v_str"] = jnp.where(outmap_ok[:, None], out_sid, e0["v_str"])
+    e0 = put(
+        e0, inmap_err | outmap_err,
+        valid=True, rtype=RT_CMD, vtype=VT_INCIDENT, intent=0,
+        key=jnp.int64(-1), elem=batch.elem, aux_key=batch.key,
+        rej=jnp.where(inmap_err, rb.ERR_IO_MAPPING_IN, rb.ERR_IO_MAPPING_OUT),
+    )
+    ftarget = graph.flow_target[wf_c, el_c]
+    e0 = put(
+        e0, m_actgw,
+        valid=True, rtype=RT_EVENT, vtype=VT_WI,
+        intent=int(WI.GATEWAY_ACTIVATED), key=key0, elem=ftarget,
+    )
+    e0 = put(
+        e0, m_startst,
+        valid=True, rtype=RT_EVENT, vtype=VT_WI,
+        intent=int(WI.ELEMENT_READY), key=key0, elem=ftarget,
+    )
+    e0 = put(
+        e0, m_trigend,
+        valid=True, rtype=RT_EVENT, vtype=VT_WI,
+        intent=int(WI.END_EVENT_OCCURRED), key=key0, elem=ftarget,
+    )
+    start_ev = graph.start_event[wf_c, el_c]
+    e0 = put(
+        e0, m_trigstart,
+        valid=True, rtype=RT_EVENT, vtype=VT_WI,
+        intent=int(WI.START_EVENT_OCCURRED), key=key0, elem=start_ev,
+        scope_key=batch.key,
+    )
+    e0 = put(
+        e0, m_complete_proc,
+        valid=True, rtype=RT_EVENT, vtype=VT_WI,
+        intent=int(WI.ELEMENT_COMPLETED), key=batch.key, elem=batch.elem,
+    )
+    e0 = put(
+        e0, completer,
+        valid=True, rtype=RT_EVENT, vtype=VT_WI,
+        intent=int(WI.GATEWAY_ACTIVATED), key=key0, elem=gw_elem,
+    )
+    e0["v_vt"] = jnp.where(completer[:, None], mg_vt, e0["v_vt"])
+    e0["v_num"] = jnp.where(completer[:, None], mg_num, e0["v_num"])
+    e0["v_str"] = jnp.where(completer[:, None], mg_sid, e0["v_str"])
+    e0 = put(
+        e0, m_timer_step,
+        valid=True, rtype=RT_CMD, vtype=VT_TIMER, intent=int(TI.CREATE),
+        key=jnp.int64(-1), elem=batch.elem, aux_key=batch.key,
+        deadline=now + graph.timer_dur[wf_c, el_c],
+    )
+
+    # --- slot 0: job command results
+    jrej = jact_rej | jcomp_rej | jfail_rej | jtime_rej | jret_rej | jret_badv | jcan_rej
+    e0 = put(
+        e0, m_jcreate,
+        valid=True, rtype=RT_EVENT, vtype=VT_JOB, intent=int(JI.CREATED),
+        key=job_base, elem=batch.elem, aux_key=batch.aux_key,
+        type_id=batch.type_id, retries=batch.retries,
+        req=batch.req, req_stream=batch.req_stream, resp=batch.req >= 0,
+    )
+    e0 = put(
+        e0, jact_ok,
+        valid=True, rtype=RT_EVENT, vtype=VT_JOB, intent=int(JI.ACTIVATED),
+        key=batch.key, elem=batch.elem, aux_key=batch.aux_key,
+        type_id=batch.type_id, retries=batch.retries, deadline=batch.deadline,
+        worker=batch.worker, push=True, req_stream=batch.req_stream,
+    )
+    # completed value = stored job record + command payload
+    st_elem = state.job_elem[jb_clip]
+    st_wf = state.job_wf[jb_clip]
+    st_ik = state.job_instance_key[jb_clip]
+    st_aik = state.job_aik[jb_clip]
+    st_type = state.job_type[jb_clip]
+    st_retries = state.job_retries[jb_clip]
+    st_worker = state.job_worker[jb_clip]
+    st_deadline = state.job_deadline[jb_clip]
+    e0 = put(
+        e0, jcomp_ok,
+        valid=True, rtype=RT_EVENT, vtype=VT_JOB, intent=int(JI.COMPLETED),
+        key=batch.key, elem=st_elem, wf=st_wf, instance_key=st_ik,
+        aux_key=st_aik, type_id=st_type, retries=st_retries,
+        worker=st_worker, deadline=st_deadline,
+        req=batch.req, req_stream=batch.req_stream, resp=batch.req >= 0,
+    )
+    payload_nonempty = jnp.any(batch.v_vt != VT_ABSENT, axis=1)
+    fail_vt = jnp.where(payload_nonempty[:, None], batch.v_vt, state.job_vt[jb_clip])
+    fail_num = jnp.where(payload_nonempty[:, None], batch.v_num, state.job_num[jb_clip])
+    fail_sid = jnp.where(payload_nonempty[:, None], batch.v_str, state.job_str[jb_clip])
+    e0 = put(
+        e0, jfail_ok,
+        valid=True, rtype=RT_EVENT, vtype=VT_JOB, intent=int(JI.FAILED),
+        key=batch.key, elem=st_elem, wf=st_wf, instance_key=st_ik,
+        aux_key=st_aik, type_id=st_type, retries=batch.retries,
+        worker=st_worker, deadline=st_deadline,
+        req=batch.req, req_stream=batch.req_stream, resp=batch.req >= 0,
+    )
+    e0["v_vt"] = jnp.where(jfail_ok[:, None], fail_vt, e0["v_vt"])
+    e0["v_num"] = jnp.where(jfail_ok[:, None], fail_num, e0["v_num"])
+    e0["v_str"] = jnp.where(jfail_ok[:, None], fail_sid, e0["v_str"])
+    e0 = put(
+        e0, jtime_ok,
+        valid=True, rtype=RT_EVENT, vtype=VT_JOB, intent=int(JI.TIMED_OUT),
+        key=batch.key, elem=batch.elem, aux_key=batch.aux_key,
+        type_id=batch.type_id, retries=batch.retries,
+        deadline=batch.deadline, worker=batch.worker,
+        req=batch.req, req_stream=batch.req_stream, resp=batch.req >= 0,
+    )
+    ret_vt = state.job_vt[jb_clip]
+    ret_num = state.job_num[jb_clip]
+    ret_sid = state.job_str[jb_clip]
+    e0 = put(
+        e0, jret_ok,
+        valid=True, rtype=RT_EVENT, vtype=VT_JOB, intent=int(JI.RETRIES_UPDATED),
+        key=batch.key, elem=st_elem, wf=st_wf, instance_key=st_ik,
+        aux_key=st_aik, type_id=st_type, retries=batch.retries,
+        worker=st_worker, deadline=st_deadline,
+        req=batch.req, req_stream=batch.req_stream, resp=batch.req >= 0,
+    )
+    e0["v_vt"] = jnp.where(jret_ok[:, None], ret_vt, e0["v_vt"])
+    e0["v_num"] = jnp.where(jret_ok[:, None], ret_num, e0["v_num"])
+    e0["v_str"] = jnp.where(jret_ok[:, None], ret_sid, e0["v_str"])
+    e0 = put(
+        e0, jcan_ok,
+        valid=True, rtype=RT_EVENT, vtype=VT_JOB, intent=int(JI.CANCELED),
+        key=batch.key, elem=batch.elem, aux_key=batch.aux_key,
+        type_id=batch.type_id, retries=batch.retries,
+        deadline=batch.deadline, worker=batch.worker,
+        req=batch.req, req_stream=batch.req_stream, resp=batch.req >= 0,
+    )
+    rej_code = jnp.select(
+        [jact_rej, jcomp_rej, jfail_rej, jtime_rej, jret_badv, jret_rej, jcan_rej],
+        [
+            rb.REJ_JOB_NOT_ACTIVATABLE, rb.REJ_JOB_NOT_COMPLETABLE,
+            rb.REJ_JOB_NOT_ACTIVATED, rb.REJ_JOB_NOT_ACTIVATED,
+            rb.REJ_RETRIES_NOT_POSITIVE, rb.REJ_JOB_NOT_FAILED,
+            rb.REJ_JOB_NOT_EXIST,
+        ],
+        0,
+    )
+    e0 = put(
+        e0, jrej,
+        valid=True, rtype=RT_REJ, vtype=vt_, intent=it, key=batch.key,
+        elem=batch.elem, aux_key=batch.aux_key, type_id=batch.type_id,
+        retries=batch.retries, deadline=batch.deadline, worker=batch.worker,
+        rej=rej_code, req=batch.req, req_stream=batch.req_stream,
+        resp=batch.req >= 0,
+    )
+
+    # --- slot 0: job events → workflow / activation / incident
+    wi_of_inst_vt = state.ei_vt[aik_clip]
+    wi_of_inst_num = state.ei_num[aik_clip]
+    wi_of_inst_sid = state.ei_str[aik_clip]
+    inst_elem = state.ei_elem[aik_clip]
+    inst_wf = state.ei_wf[aik_clip]
+    inst_scope_slot = state.ei_scope_slot[aik_clip]
+    inst_scope_key = jnp.where(
+        inst_scope_slot >= 0,
+        state.ei_key[jnp.clip(inst_scope_slot, 0, n_cap - 1)],
+        -1,
+    )
+    e0 = put(
+        e0, jev_completed,
+        valid=True, rtype=RT_EVENT, vtype=VT_WI,
+        intent=int(WI.ELEMENT_COMPLETING), key=batch.aux_key,
+        elem=inst_elem, wf=inst_wf, scope_key=inst_scope_key,
+    )
+    act_pool_win = activated
+    e0 = put(
+        e0, act_pool_win,
+        valid=True, rtype=RT_CMD, vtype=VT_JOB, intent=int(JI.ACTIVATE),
+        key=batch.key, elem=batch.elem, aux_key=batch.aux_key,
+        type_id=batch.type_id, retries=batch.retries,
+        deadline=act_deadline, worker=act_worker, req_stream=act_stream,
+    )
+    e0 = put(
+        e0, jev_fail_noretry,
+        valid=True, rtype=RT_CMD, vtype=VT_INCIDENT, intent=0,
+        key=jnp.int64(-1), elem=batch.elem, aux_key=batch.aux_key,
+        aux2_key=batch.key, rej=0,  # JOB_NO_RETRIES handled host-side by code 0? no:
+    )
+    # job-no-retries uses a dedicated code so the host maps the error type
+    e0["rej"] = jnp.where(jev_fail_noretry, 105, e0["rej"])
+
+    # --- slot 0/1: timer commands
+    e0 = put(
+        e0, m_tcreate,
+        valid=True, rtype=RT_EVENT, vtype=VT_TIMER, intent=int(TI.CREATED),
+        key=key0, elem=batch.elem, aux_key=batch.aux_key, deadline=batch.deadline,
+    )
+    e0 = put(
+        e0, ttrig_ok,
+        valid=True, rtype=RT_EVENT, vtype=VT_TIMER, intent=int(TI.TRIGGERED),
+        key=batch.key, elem=batch.elem, aux_key=batch.aux_key,
+        deadline=batch.deadline,
+    )
+    e1 = put(
+        e1, ttrig_inst,
+        valid=True, rtype=RT_EVENT, vtype=VT_WI,
+        intent=int(WI.ELEMENT_COMPLETING), key=batch.aux_key,
+        elem=inst_elem, wf=inst_wf, scope_key=inst_scope_key,
+    )
+    e1["v_vt"] = jnp.where(ttrig_inst[:, None], wi_of_inst_vt, e1["v_vt"])
+    e1["v_num"] = jnp.where(ttrig_inst[:, None], wi_of_inst_num, e1["v_num"])
+    e1["v_str"] = jnp.where(ttrig_inst[:, None], wi_of_inst_sid, e1["v_str"])
+    e1["instance_key"] = jnp.where(
+        ttrig_inst, state.ei_instance_key[aik_clip], e1["instance_key"]
+    )
+    e0 = put(
+        e0, ttrig_rej,
+        valid=True, rtype=RT_REJ, vtype=vt_, intent=it, key=batch.key,
+        rej=rb.REJ_TIMER_NOT_EXIST, req=batch.req, req_stream=batch.req_stream,
+        resp=batch.req >= 0,
+    )
+    e0 = put(
+        e0, tcan_ok,
+        valid=True, rtype=RT_EVENT, vtype=VT_TIMER, intent=int(TI.CANCELED),
+        key=batch.key, elem=batch.elem, aux_key=batch.aux_key,
+        deadline=batch.deadline,
+    )
+
+    # jev_completed payload = job payload (record payload already in columns)
+    # (value defaults carry batch payload, which is the job's — correct)
+
+    # --- fork slots (parallel split) + assemble [B, E]
+    em = {}
+    for name in e0:
+        a0 = e0[name]
+        a1 = e1[name]
+        if a0.ndim == 1:
+            stack = [a0, a1] + [
+                jnp.zeros_like(a0) for _ in range(e_w - 2)
+            ]
+            em[name] = jnp.stack(stack, axis=1)  # [B, E]
+        else:
+            stack = [a0, a1] + [jnp.zeros_like(a0) for _ in range(e_w - 2)]
+            em[name] = jnp.stack(stack, axis=1)  # [B, E, V]
+
+    fork_flows = graph.out_flows[wf_c, el_c]  # [B, F<=E]
+    fan_out = fork_flows.shape[1]
+    for f in range(min(fan_out, e_w)):
+        mask_f = m_psplit & (f < out_count)
+        em["valid"] = em["valid"].at[:, f].set(
+            jnp.where(mask_f, True, em["valid"][:, f])
+        )
+        for name, val in (
+            ("rtype", RT_EVENT), ("vtype", VT_WI),
+            ("intent", int(WI.SEQUENCE_FLOW_TAKEN)),
+        ):
+            em[name] = em[name].at[:, f].set(
+                jnp.where(mask_f, val, em[name][:, f])
+            )
+        em["key"] = em["key"].at[:, f].set(
+            jnp.where(mask_f, wf_base + _KEY_STEP * f, em["key"][:, f])
+        )
+        em["elem"] = em["elem"].at[:, f].set(
+            jnp.where(mask_f, fork_flows[:, f], em["elem"][:, f])
+        )
+        for name in ("wf", "instance_key", "scope_key"):
+            em[name] = em[name].at[:, f].set(
+                jnp.where(mask_f, getattr(batch, name), em[name][:, f])
+            )
+        for name in ("v_vt", "v_num", "v_str"):
+            em[name] = em[name].at[:, f].set(
+                jnp.where(mask_f[:, None], getattr(batch, name), em[name][:, f])
+            )
+        em["src"] = em["src"].at[:, f].set(rows)
+
+    # ---------------- state scatters ----------------
+    # token counters
+    tok_delta = jnp.zeros((n_cap,), jnp.int32)
+    tok_delta = tok_delta.at[jnp.where(m_consume, sc_clip, n_cap)].add(-1, mode="drop")
+    tok_delta = tok_delta.at[jnp.where(m_psplit, sc_clip, n_cap)].add(
+        out_count - 1, mode="drop"
+    )
+    nin_rec = join_nin_arr[arr_slot]
+    tok_delta = tok_delta.at[jnp.where(completer, sc_clip, n_cap)].add(
+        -(nin_rec - 1), mode="drop"
+    )
+    ei_tokens = state.ei_tokens + tok_delta
+    ei_tokens = ei_tokens.at[jnp.where(m_trigstart, ei_clip, n_cap)].set(
+        1, mode="drop"
+    )
+
+    # scope payload on consume (oracle: scope value.payload = record payload)
+    ei_vt, ei_num, ei_str = _scatter_payload(
+        state.ei_vt, state.ei_num, state.ei_str,
+        sc_clip, m_consume, batch.v_vt, batch.v_num, batch.v_str, n_cap,
+    )
+    # scope state transition by consume completer
+    ei_state_arr = state.ei_state.at[
+        jnp.where(consume_completer, sc_clip, n_cap)
+    ].set(int(WI.ELEMENT_COMPLETING), mode="drop")
+    # own-instance transitions
+    ei_state_arr = ei_state_arr.at[jnp.where(inmap_ok, ei_clip, n_cap)].set(
+        int(WI.ELEMENT_ACTIVATED), mode="drop"
+    )
+    ei_vt, ei_num, ei_str = _scatter_payload(
+        ei_vt, ei_num, ei_str, ei_clip, inmap_ok, in_vt, in_num, in_sid, n_cap
+    )
+    # job completed → instance completing
+    ei_state_arr = ei_state_arr.at[jnp.where(jev_completed, aik_clip, n_cap)].set(
+        int(WI.ELEMENT_COMPLETING), mode="drop"
+    )
+    ei_vt, ei_num, ei_str = _scatter_payload(
+        ei_vt, ei_num, ei_str, aik_clip, jev_completed,
+        batch.v_vt, batch.v_num, batch.v_str, n_cap,
+    )
+    ei_job_key = state.ei_job_key.at[jnp.where(jev_completed, aik_clip, n_cap)].set(
+        -1, mode="drop"
+    )
+    ei_job_key = ei_job_key.at[
+        jnp.where(jev_created & aik_found, aik_clip, n_cap)
+    ].set(batch.key, mode="drop")
+    # timer trigger → instance completing
+    ei_state_arr = ei_state_arr.at[jnp.where(ttrig_inst, aik_clip, n_cap)].set(
+        int(WI.ELEMENT_COMPLETING), mode="drop"
+    )
+
+    # removals (final states written this round)
+    ei_remove = outmap_ok | m_complete_proc
+    rm_w = jnp.where(ei_remove, ei_clip, n_cap)
+    ei_state_arr = ei_state_arr.at[rm_w].set(-1, mode="drop")
+    ei_key_arr = state.ei_key.at[rm_w].set(-1, mode="drop")
+    ei_map = hashmap.delete(state.ei_map, batch.key, ei_remove)
+
+    # inserts: CREATE command roots + START_STATEFUL children (+ replayed
+    # CREATED events whose instance is missing)
+    ins_root = m_create
+    ins_child = m_startst
+    ins_replay = m_created_ev & ~ei_found
+    ins = ins_root | ins_child | ins_replay
+    ins_key = jnp.where(ins_root, key0, jnp.where(ins_child, key0, batch.key))
+    ins_elem = jnp.where(ins_root, 0, jnp.where(ins_child, ftarget, batch.elem))
+    ins_parent = jnp.where(ins_child, sc_slot, -1)
+    ins_ikey = jnp.where(ins_root, key0, batch.instance_key)
+    free = jnp.nonzero(state.ei_state < 0, size=b, fill_value=n_cap)[0]
+    ins_rank = _excl_cumsum(ins.astype(jnp.int32))
+    ins_slot = free[jnp.clip(ins_rank, 0, b - 1)]
+    ei_overflow = jnp.any(ins & (ins_slot >= n_cap))
+    iw = jnp.where(ins, ins_slot, n_cap)
+    ei_key_arr = ei_key_arr.at[iw].set(ins_key, mode="drop")
+    ei_state_arr = ei_state_arr.at[iw].set(int(WI.ELEMENT_READY), mode="drop")
+    ei_elem_arr = state.ei_elem.at[iw].set(ins_elem, mode="drop")
+    ei_wf_arr = state.ei_wf.at[iw].set(batch.wf, mode="drop")
+    ei_scope_arr = state.ei_scope_slot.at[iw].set(ins_parent, mode="drop")
+    ei_ikey_arr = state.ei_instance_key.at[iw].set(ins_ikey, mode="drop")
+    ei_tokens = ei_tokens.at[iw].set(0, mode="drop")
+    ei_job_key = ei_job_key.at[iw].set(-1, mode="drop")
+    ei_vt = ei_vt.at[iw].set(batch.v_vt, mode="drop")
+    ei_num = ei_num.at[iw].set(batch.v_num, mode="drop")
+    ei_str = ei_str.at[iw].set(batch.v_str, mode="drop")
+    ei_map, ei_ins_ok = hashmap.insert(ei_map, ins_key, ins_slot, ins)
+
+    # ---------------- job table ----------------
+    job_ins = m_jcreate
+    jfree = jnp.nonzero(state.job_state < 0, size=b, fill_value=m_cap)[0]
+    j_rank = _excl_cumsum(job_ins.astype(jnp.int32))
+    j_slot = jfree[jnp.clip(j_rank, 0, b - 1)]
+    job_overflow = jnp.any(job_ins & (j_slot >= m_cap))
+    jw = jnp.where(job_ins, j_slot, m_cap)
+    job_key_arr = state.job_key.at[jw].set(job_base, mode="drop")
+    job_state_arr = state.job_state.at[jw].set(int(JI.CREATED), mode="drop")
+    job_elem_arr = state.job_elem.at[jw].set(batch.elem, mode="drop")
+    job_wf_arr = state.job_wf.at[jw].set(batch.wf, mode="drop")
+    job_ik_arr = state.job_instance_key.at[jw].set(batch.instance_key, mode="drop")
+    job_aik_arr = state.job_aik.at[jw].set(batch.aux_key, mode="drop")
+    job_type_arr = state.job_type.at[jw].set(batch.type_id, mode="drop")
+    job_retries_arr = state.job_retries.at[jw].set(batch.retries, mode="drop")
+    job_deadline_arr = state.job_deadline.at[jw].set(-1, mode="drop")
+    job_worker_arr = state.job_worker.at[jw].set(0, mode="drop")
+    job_vt_arr = state.job_vt.at[jw].set(batch.v_vt, mode="drop")
+    job_num_arr = state.job_num.at[jw].set(batch.v_num, mode="drop")
+    job_str_arr = state.job_str.at[jw].set(batch.v_str, mode="drop")
+    job_map, job_ins_ok = hashmap.insert(state.job_map, job_base, j_slot, job_ins)
+
+    # transitions
+    jup = jnp.where(jact_ok, jb_clip, m_cap)
+    job_state_arr = job_state_arr.at[jup].set(int(JI.ACTIVATED), mode="drop")
+    job_deadline_arr = job_deadline_arr.at[jup].set(batch.deadline, mode="drop")
+    job_worker_arr = job_worker_arr.at[jup].set(batch.worker, mode="drop")
+    job_retries_arr = job_retries_arr.at[jup].set(batch.retries, mode="drop")
+    job_vt_arr = job_vt_arr.at[jup].set(batch.v_vt, mode="drop")
+    job_num_arr = job_num_arr.at[jup].set(batch.v_num, mode="drop")
+    job_str_arr = job_str_arr.at[jup].set(batch.v_str, mode="drop")
+
+    jfw = jnp.where(jfail_ok, jb_clip, m_cap)
+    job_state_arr = job_state_arr.at[jfw].set(int(JI.FAILED), mode="drop")
+    job_retries_arr = job_retries_arr.at[jfw].set(batch.retries, mode="drop")
+    job_vt_arr = job_vt_arr.at[jfw].set(fail_vt, mode="drop")
+    job_num_arr = job_num_arr.at[jfw].set(fail_num, mode="drop")
+    job_str_arr = job_str_arr.at[jfw].set(fail_sid, mode="drop")
+
+    job_state_arr = job_state_arr.at[jnp.where(jtime_ok, jb_clip, m_cap)].set(
+        int(JI.TIMED_OUT), mode="drop"
+    )
+    job_retries_arr = job_retries_arr.at[jnp.where(jret_ok, jb_clip, m_cap)].set(
+        batch.retries, mode="drop"
+    )
+    job_rm = jcomp_ok | jcan_ok
+    jrm = jnp.where(job_rm, jb_clip, m_cap)
+    job_state_arr = job_state_arr.at[jrm].set(-1, mode="drop")
+    job_key_arr = job_key_arr.at[jrm].set(-1, mode="drop")
+    job_map = hashmap.delete(job_map, batch.key, job_rm)
+
+    # ---------------- join cleanup ----------------
+    done_slot = jnp.where(completer, arr_slot, j_cap)
+    join_key_arr = join_key_arr.at[done_slot].set(-1, mode="drop")
+    join_nin_arr = join_nin_arr.at[done_slot].set(0, mode="drop")
+    arrived = arrived.at[done_slot].set(False, mode="drop")
+    stamp = stamp.at[done_slot].set(-1, mode="drop")
+    join_map = hashmap.delete(jmap, join_key, completer)
+
+    # ---------------- timer table ----------------
+    t_ins = m_tcreate
+    tfree = jnp.nonzero(state.timer_key < 0, size=b, fill_value=t_cap)[0]
+    t_rank = _excl_cumsum(t_ins.astype(jnp.int32))
+    t_slot = tfree[jnp.clip(t_rank, 0, b - 1)]
+    timer_overflow = jnp.any(t_ins & (t_slot >= t_cap))
+    tw = jnp.where(t_ins, t_slot, t_cap)
+    timer_key_arr = state.timer_key.at[tw].set(key0, mode="drop")
+    timer_due_arr = state.timer_due.at[tw].set(batch.deadline, mode="drop")
+    timer_aik_arr = state.timer_aik.at[tw].set(batch.aux_key, mode="drop")
+    timer_ik_arr = state.timer_instance_key.at[tw].set(batch.instance_key, mode="drop")
+    timer_elem_arr = state.timer_elem.at[tw].set(batch.elem, mode="drop")
+    timer_wf_arr = state.timer_wf.at[tw].set(batch.wf, mode="drop")
+    timer_map, _t_ok = hashmap.insert(state.timer_map, key0, t_slot, t_ins)
+    t_rm = ttrig_ok | tcan_ok
+    trm = jnp.where(t_rm, tm_clip, t_cap)
+    timer_key_arr = timer_key_arr.at[trm].set(-1, mode="drop")
+    timer_due_arr = timer_due_arr.at[trm].set(-1, mode="drop")
+    timer_map = hashmap.delete(timer_map, batch.key, t_rm)
+
+    # ---------------- output compaction ----------------
+    flat_valid = em["valid"].reshape(-1)
+    be = b * e_w
+    take_idx = jnp.nonzero(flat_valid, size=be, fill_value=be)[0]
+    count = jnp.sum(flat_valid, dtype=jnp.int32)
+
+    def compact(a):
+        flat = a.reshape((be,) + a.shape[2:])
+        return jnp.take(flat, jnp.clip(take_idx, 0, be - 1), axis=0)
+
+    out = RecordBatch(
+        valid=jnp.arange(be, dtype=jnp.int32) < count,
+        rtype=compact(em["rtype"]),
+        vtype=compact(em["vtype"]),
+        intent=compact(em["intent"]),
+        key=compact(em["key"]),
+        elem=compact(em["elem"]),
+        wf=compact(em["wf"]),
+        instance_key=compact(em["instance_key"]),
+        scope_key=compact(em["scope_key"]),
+        v_vt=compact(em["v_vt"]),
+        v_num=compact(em["v_num"]),
+        v_str=compact(em["v_str"]),
+        req=compact(em["req"]),
+        req_stream=compact(em["req_stream"]),
+        aux_key=compact(em["aux_key"]),
+        aux2_key=compact(em["aux2_key"]),
+        type_id=compact(em["type_id"]),
+        retries=compact(em["retries"]),
+        deadline=compact(em["deadline"]),
+        worker=compact(em["worker"]),
+        src=compact(em["src"]),
+        resp=compact(em["resp"]),
+        push=compact(em["push"]),
+        rej=compact(em["rej"]),
+    )
+
+    new_state = EngineState(
+        ei_key=ei_key_arr, ei_elem=ei_elem_arr, ei_state=ei_state_arr,
+        ei_wf=ei_wf_arr, ei_scope_slot=ei_scope_arr, ei_instance_key=ei_ikey_arr,
+        ei_tokens=ei_tokens, ei_job_key=ei_job_key,
+        ei_vt=ei_vt, ei_num=ei_num, ei_str=ei_str, ei_map=ei_map,
+        job_key=job_key_arr, job_state=job_state_arr, job_elem=job_elem_arr,
+        job_wf=job_wf_arr, job_instance_key=job_ik_arr, job_aik=job_aik_arr,
+        job_type=job_type_arr, job_retries=job_retries_arr,
+        job_deadline=job_deadline_arr, job_worker=job_worker_arr,
+        job_vt=job_vt_arr, job_num=job_num_arr, job_str=job_str_arr,
+        job_map=job_map,
+        join_key=join_key_arr, join_nin=join_nin_arr, join_arrived=arrived,
+        join_vt=join_vt, join_num=join_num, join_str=join_sid,
+        join_pos_stamp=stamp, join_map=join_map,
+        timer_key=timer_key_arr, timer_due=timer_due_arr,
+        timer_aik=timer_aik_arr, timer_instance_key=timer_ik_arr,
+        timer_elem=timer_elem_arr, timer_wf=timer_wf_arr, timer_map=timer_map,
+        sub_key=state.sub_key, sub_type=state.sub_type,
+        sub_worker=state.sub_worker, sub_credits=sub_credits,
+        sub_timeout=state.sub_timeout, sub_valid=state.sub_valid,
+        sub_rr=state.sub_rr,
+        next_wf_key=next_wf_key, next_job_key=next_job_key,
+    )
+    stats = {
+        "processed": jnp.sum(valid, dtype=jnp.int32),
+        "stepped": jnp.sum(stepped, dtype=jnp.int32)
+        + jnp.sum(job_cmd | job_ev | timer_cmd | m_create | m_created_ev,
+                  dtype=jnp.int32),
+        "emitted": count,
+        "completed_roots": jnp.sum(
+            m_complete_proc & (batch.elem == 0), dtype=jnp.int32
+        ),
+        "overflow": (
+            ei_overflow | job_overflow | join_overflow | timer_overflow
+            | ~jnp.all(ei_ins_ok == ins) | ~jnp.all(job_ins_ok == job_ins)
+        ),
+    }
+    return new_state, out, stats
+
+
+step_jit = jax.jit(step_kernel, donate_argnums=(1,))
+
+
+def tick_kernel(state: EngineState, now) -> Tuple[RecordBatch, jax.Array]:
+    """Due-timer and job-deadline scan → TIME_OUT / TRIGGER command batch
+    (reference JobTimeOutStreamProcessor + the oracle's check_*_deadlines;
+    ordered by key like the oracle's sorted iteration)."""
+    t_cap = state.timer_key.shape[0]
+    m_cap = state.job_key.shape[0]
+    v = state.num_vars
+    size = t_cap + m_cap
+
+    timer_due = (state.timer_key >= 0) & (state.timer_due <= now)
+    job_due = (
+        (state.job_state == int(JI.ACTIVATED))
+        & (state.job_deadline >= 0)
+        & (state.job_deadline <= now)
+    )
+    keys = jnp.concatenate([state.timer_key, state.job_key])
+    due = jnp.concatenate([timer_due, job_due])
+    order = jnp.argsort(jnp.where(due, keys, jnp.int64(2**62)), stable=True)
+    count = jnp.sum(due, dtype=jnp.int32)
+
+    is_timer = jnp.concatenate(
+        [jnp.ones((t_cap,), bool), jnp.zeros((m_cap,), bool)]
+    )[order]
+    tidx = jnp.clip(order, 0, t_cap - 1)
+    jidx = jnp.clip(order - t_cap, 0, m_cap - 1)
+
+    sel = jnp.arange(size, dtype=jnp.int32) < count
+    out = RecordBatch(
+        valid=sel,
+        rtype=jnp.full((size,), RT_CMD, jnp.int32),
+        vtype=jnp.where(is_timer, VT_TIMER, VT_JOB),
+        intent=jnp.where(is_timer, int(TI.TRIGGER), int(JI.TIME_OUT)),
+        key=keys[order],
+        elem=jnp.where(is_timer, state.timer_elem[tidx], state.job_elem[jidx]),
+        wf=jnp.where(is_timer, state.timer_wf[tidx], state.job_wf[jidx]),
+        instance_key=jnp.where(
+            is_timer, state.timer_instance_key[tidx], state.job_instance_key[jidx]
+        ),
+        scope_key=jnp.full((size,), -1, jnp.int64),
+        v_vt=jnp.where(is_timer[:, None], jnp.int8(0), state.job_vt[jidx]),
+        v_num=jnp.where(is_timer[:, None], 0.0, state.job_num[jidx]),
+        v_str=jnp.where(is_timer[:, None], 0, state.job_str[jidx]),
+        req=jnp.full((size,), -1, jnp.int64),
+        req_stream=jnp.full((size,), -1, jnp.int32),
+        aux_key=jnp.where(is_timer, state.timer_aik[tidx], state.job_aik[jidx]),
+        aux2_key=jnp.full((size,), -1, jnp.int64),
+        type_id=jnp.where(is_timer, 0, state.job_type[jidx]),
+        retries=jnp.where(is_timer, 0, state.job_retries[jidx]),
+        deadline=jnp.where(
+            is_timer, state.timer_due[tidx], state.job_deadline[jidx]
+        ),
+        worker=jnp.where(is_timer, 0, state.job_worker[jidx]),
+        src=jnp.full((size,), -1, jnp.int32),
+        resp=jnp.zeros((size,), bool),
+        push=jnp.zeros((size,), bool),
+        rej=jnp.zeros((size,), jnp.int32),
+    )
+    return out, count
+
+
+tick_jit = jax.jit(tick_kernel)

@@ -1,0 +1,197 @@
+"""Engine state: struct-of-arrays tables in HBM.
+
+The reference keeps per-partition state in heap object maps / RocksDB
+(``broker-core/.../workflow/index/ElementInstanceIndex.java:25``,
+``broker-core/.../job/state/JobInstanceStateController.java:28``); here
+each state family is a fixed-capacity SoA table plus an HBM hash index
+(``zeebe_tpu.tpu.hashmap``) mapping entity key → slot:
+
+- element instances: lifecycle state, element, scope linkage, token counts,
+  columnar payload (the ElementInstanceIndex analogue)
+- jobs: the short job state machine + stored job record
+- joins: in-flight parallel-gateway joins keyed by (scope, gateway), with
+  flow-position-stamped payload merge (matches the oracle's flow-order merge)
+- timers: due-date table scanned by the tick kernel
+- job subscriptions: small table mutated host-side (credits, workers)
+- key counters (reference KeyGenerator strides: workflow ≡1, job ≡2 mod 5)
+
+Capacities are static (jit shapes); the host engine grows tables by
+re-padding when occupancy crosses a threshold.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from zeebe_tpu.engine import keyspace
+from zeebe_tpu.tpu import hashmap
+
+_STATE_FIELDS = [
+    "ei_key", "ei_elem", "ei_state", "ei_wf", "ei_scope_slot", "ei_instance_key",
+    "ei_tokens", "ei_job_key", "ei_vt", "ei_num", "ei_str", "ei_map",
+    "job_key", "job_state", "job_elem", "job_wf", "job_instance_key",
+    "job_aik", "job_type", "job_retries", "job_deadline", "job_worker",
+    "job_vt", "job_num", "job_str", "job_map",
+    "join_key", "join_nin", "join_arrived", "join_vt", "join_num", "join_str",
+    "join_pos_stamp", "join_map",
+    "timer_key", "timer_due", "timer_aik", "timer_instance_key", "timer_elem",
+    "timer_wf", "timer_map",
+    "sub_key", "sub_type", "sub_worker", "sub_credits", "sub_timeout", "sub_valid",
+    "sub_rr",
+    "next_wf_key", "next_job_key",
+]
+
+
+@partial(jax.tree_util.register_dataclass, data_fields=_STATE_FIELDS, meta_fields=[])
+@dataclasses.dataclass
+class EngineState:
+    # element instances [N]
+    ei_key: jax.Array          # i64, -1 free
+    ei_elem: jax.Array         # i32
+    ei_state: jax.Array        # i32 lifecycle intent, -1 free
+    ei_wf: jax.Array           # i32 workflow slot
+    ei_scope_slot: jax.Array   # i32 parent slot, -1 root
+    ei_instance_key: jax.Array # i64 workflowInstanceKey
+    ei_tokens: jax.Array       # i32 active tokens in this scope
+    ei_job_key: jax.Array      # i64
+    ei_vt: jax.Array           # [N, V] i8 payload value types
+    ei_num: jax.Array          # [N, V] f64
+    ei_str: jax.Array          # [N, V] i32
+    ei_map: hashmap.HashTable  # key → slot
+
+    # jobs [M]
+    job_key: jax.Array         # i64, -1 free
+    job_state: jax.Array       # i32 (JobIntent of last state event), -1 free
+    job_elem: jax.Array        # i32 (headers.activityId element)
+    job_wf: jax.Array          # i32
+    job_instance_key: jax.Array# i64
+    job_aik: jax.Array         # i64 headers.activityInstanceKey
+    job_type: jax.Array        # i32 interned
+    job_retries: jax.Array     # i32
+    job_deadline: jax.Array    # i64
+    job_worker: jax.Array      # i32 interned
+    job_vt: jax.Array          # [M, V]
+    job_num: jax.Array
+    job_str: jax.Array
+    job_map: hashmap.HashTable
+
+    # parallel joins [J]
+    join_key: jax.Array        # i64 composite (scope_key<<8 | gateway), -1 free
+    join_nin: jax.Array        # i32
+    join_arrived: jax.Array    # [J, F_in] bool
+    join_vt: jax.Array         # [J, V] merged payload
+    join_num: jax.Array
+    join_str: jax.Array
+    join_pos_stamp: jax.Array  # [J, V] i32: flow position that wrote each var
+    join_map: hashmap.HashTable
+
+    # timers [TM]
+    timer_key: jax.Array       # i64, -1 free
+    timer_due: jax.Array       # i64
+    timer_aik: jax.Array       # i64
+    timer_instance_key: jax.Array  # i64
+    timer_elem: jax.Array      # i32 handler element
+    timer_wf: jax.Array        # i32
+    timer_map: hashmap.HashTable
+
+    # job worker subscriptions [S] (host-managed)
+    sub_key: jax.Array         # i64 subscriber key
+    sub_type: jax.Array        # i32 interned job type
+    sub_worker: jax.Array      # i32 interned worker name
+    sub_credits: jax.Array     # i32
+    sub_timeout: jax.Array     # i64
+    sub_valid: jax.Array       # bool
+    sub_rr: jax.Array          # i32 round-robin cursor (global, like the oracle)
+
+    # key counters (i64 scalars)
+    next_wf_key: jax.Array
+    next_job_key: jax.Array
+
+    @property
+    def capacity(self) -> int:
+        return self.ei_key.shape[0]
+
+    @property
+    def num_vars(self) -> int:
+        return self.ei_vt.shape[1]
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def make_state(
+    capacity: int = 1 << 12,
+    num_vars: int = 8,
+    job_capacity: int = 0,
+    join_capacity: int = 0,
+    timer_capacity: int = 0,
+    sub_capacity: int = 64,
+    max_join_in: int = 4,
+) -> EngineState:
+    n = capacity
+    m = job_capacity or capacity
+    j = join_capacity or max(capacity // 8, 256)
+    tm = timer_capacity or max(capacity // 8, 256)
+    v = num_vars
+    i64, i32, i8, f64 = jnp.int64, jnp.int32, jnp.int8, jnp.float64
+
+    return EngineState(
+        ei_key=jnp.full((n,), -1, i64),
+        ei_elem=jnp.zeros((n,), i32),
+        ei_state=jnp.full((n,), -1, i32),
+        ei_wf=jnp.zeros((n,), i32),
+        ei_scope_slot=jnp.full((n,), -1, i32),
+        ei_instance_key=jnp.full((n,), -1, i64),
+        ei_tokens=jnp.zeros((n,), i32),
+        ei_job_key=jnp.full((n,), -1, i64),
+        ei_vt=jnp.zeros((n, v), i8),
+        ei_num=jnp.zeros((n, v), f64),
+        ei_str=jnp.zeros((n, v), i32),
+        ei_map=hashmap.make(_pow2(4 * n)),
+        job_key=jnp.full((m,), -1, i64),
+        job_state=jnp.full((m,), -1, i32),
+        job_elem=jnp.zeros((m,), i32),
+        job_wf=jnp.zeros((m,), i32),
+        job_instance_key=jnp.full((m,), -1, i64),
+        job_aik=jnp.full((m,), -1, i64),
+        job_type=jnp.zeros((m,), i32),
+        job_retries=jnp.zeros((m,), i32),
+        job_deadline=jnp.full((m,), -1, i64),
+        job_worker=jnp.zeros((m,), i32),
+        job_vt=jnp.zeros((m, v), i8),
+        job_num=jnp.zeros((m, v), f64),
+        job_str=jnp.zeros((m, v), i32),
+        job_map=hashmap.make(_pow2(4 * m)),
+        join_key=jnp.full((j,), -1, i64),
+        join_nin=jnp.zeros((j,), i32),
+        join_arrived=jnp.zeros((j, max_join_in), bool),
+        join_vt=jnp.zeros((j, v), i8),
+        join_num=jnp.zeros((j, v), f64),
+        join_str=jnp.zeros((j, v), i32),
+        join_pos_stamp=jnp.full((j, v), -1, i32),
+        join_map=hashmap.make(_pow2(4 * j)),
+        timer_key=jnp.full((tm,), -1, i64),
+        timer_due=jnp.full((tm,), -1, i64),
+        timer_aik=jnp.full((tm,), -1, i64),
+        timer_instance_key=jnp.full((tm,), -1, i64),
+        timer_elem=jnp.zeros((tm,), i32),
+        timer_wf=jnp.zeros((tm,), i32),
+        timer_map=hashmap.make(_pow2(4 * tm)),
+        sub_key=jnp.full((sub_capacity,), -1, i64),
+        sub_type=jnp.zeros((sub_capacity,), i32),
+        sub_worker=jnp.zeros((sub_capacity,), i32),
+        sub_credits=jnp.zeros((sub_capacity,), i32),
+        sub_timeout=jnp.zeros((sub_capacity,), i64),
+        sub_valid=jnp.zeros((sub_capacity,), bool),
+        sub_rr=jnp.zeros((), i32),
+        next_wf_key=jnp.array(keyspace.WF_OFFSET, i64),
+        next_job_key=jnp.array(keyspace.JOB_OFFSET, i64),
+    )
