@@ -1,0 +1,307 @@
+"""Snapshot storage + broker restart/recovery tests.
+
+Reference parity: ``qa/integration-tests/.../BrokerReprocessingTest`` (restart
+the broker, state is rebuilt by replay, workflows continue), plus
+``FsSnapshotStorage``/``StateSnapshotController`` unit behavior (checksums,
+commit-rename, stale-snapshot validation against the log).
+"""
+
+import os
+import pickle
+
+import pytest
+
+from zeebe_tpu.gateway import JobWorker, ZeebeClient
+from zeebe_tpu.log.snapshot import (
+    SnapshotController,
+    SnapshotMetadata,
+    SnapshotStorage,
+)
+from zeebe_tpu.models.bpmn.builder import Bpmn
+from zeebe_tpu.protocol.enums import RecordType, ValueType
+from zeebe_tpu.protocol.intents import (
+    JobIntent,
+    MessageIntent,
+    WorkflowInstanceIntent as WI,
+)
+from zeebe_tpu.runtime import Broker, ControlledClock
+
+
+def order_process_model():
+    return (
+        Bpmn.create_process("order-process")
+        .start_event("start")
+        .service_task("collect-money", type="payment-service")
+        .end_event("end")
+        .done()
+    )
+
+
+def wi_events(broker, partition=0):
+    return [
+        (WI(r.metadata.intent).name, r.value.activity_id)
+        for r in broker.records(partition)
+        if r.metadata.value_type == ValueType.WORKFLOW_INSTANCE
+        and r.metadata.record_type == RecordType.EVENT
+    ]
+
+
+# ---------------------------------------------------------------------------
+# snapshot storage unit tests
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotStorage:
+    def test_write_read_roundtrip(self, tmp_path):
+        storage = SnapshotStorage(str(tmp_path))
+        meta = SnapshotMetadata(10, 12, 1)
+        storage.write(meta, b"hello-state")
+        assert storage.list() == [meta]
+        assert storage.read(meta) == b"hello-state"
+
+    def test_newest_first_ordering(self, tmp_path):
+        storage = SnapshotStorage(str(tmp_path))
+        for pos in (5, 20, 10):
+            storage.write(SnapshotMetadata(pos, pos + 1, 0), b"x")
+        assert [m.last_processed_position for m in storage.list()] == [20, 10, 5]
+
+    def test_corrupt_payload_rejected(self, tmp_path):
+        storage = SnapshotStorage(str(tmp_path))
+        meta = SnapshotMetadata(3, 4, 0)
+        storage.write(meta, b"good")
+        with open(os.path.join(str(tmp_path), meta.dirname, "state.bin"), "wb") as f:
+            f.write(b"evil")
+        assert storage.read(meta) is None
+
+    def test_torn_tmp_dir_swept_on_open(self, tmp_path):
+        os.makedirs(tmp_path / "snapshot_1_2_0.tmp")
+        storage = SnapshotStorage(str(tmp_path))
+        assert storage.list() == []
+        assert not (tmp_path / "snapshot_1_2_0.tmp").exists()
+
+    def test_purge_older(self, tmp_path):
+        storage = SnapshotStorage(str(tmp_path))
+        old = SnapshotMetadata(5, 6, 0)
+        new = SnapshotMetadata(9, 11, 0)
+        storage.write(old, b"a")
+        storage.write(new, b"b")
+        storage.purge_older_than(new)
+        assert storage.list() == [new]
+
+    def test_controller_skips_snapshot_ahead_of_log(self, tmp_path):
+        """A snapshot whose written position exceeds the log end is stale
+        (log truncated/diverged) — recovery falls back to an older one."""
+        controller = SnapshotController(SnapshotStorage(str(tmp_path)))
+        controller.take({"v": 1}, SnapshotMetadata(5, 6, 0))
+        # take() purges older snapshots, so write the newer one directly
+        controller.storage.write(
+            SnapshotMetadata(50, 60, 0), pickle.dumps({"v": 2})
+        )
+        state, meta = controller.recover(log_last_position=10)
+        assert state == {"v": 1}
+        assert meta.last_processed_position == 5
+
+    def test_controller_skips_corrupt_falls_back(self, tmp_path):
+        controller = SnapshotController(SnapshotStorage(str(tmp_path)))
+        controller.take({"v": 1}, SnapshotMetadata(5, 6, 0))
+        bad = SnapshotMetadata(9, 9, 0)
+        controller.storage.write(bad, b"not-a-pickle")
+        with open(
+            os.path.join(str(tmp_path), bad.dirname, "checksum.crc32"), "w"
+        ) as f:
+            f.write("0")
+        state, meta = controller.recover(log_last_position=100)
+        assert state == {"v": 1}
+
+    def test_recover_empty(self, tmp_path):
+        controller = SnapshotController(SnapshotStorage(str(tmp_path)))
+        assert controller.recover(100) == (None, None)
+
+
+# ---------------------------------------------------------------------------
+# broker restart / replay tests
+# ---------------------------------------------------------------------------
+
+
+class TestBrokerRecovery:
+    def _restart(self, broker, data_dir, clock):
+        broker.close()
+        return Broker(
+            num_partitions=len(broker.partitions), data_dir=data_dir, clock=clock
+        )
+
+    def test_restart_resumes_mid_workflow(self, tmp_path):
+        """Create an instance, restart before the job completes, then complete
+        it on the restarted broker — the instance finishes (replay rebuilt
+        element-instance + job state)."""
+        clock = ControlledClock(start_ms=1_000_000)
+        data = str(tmp_path / "data")
+        broker = Broker(num_partitions=1, data_dir=data, clock=clock)
+        client = ZeebeClient(broker)
+        client.deploy_model(order_process_model())
+        client.create_instance("order-process", payload={"orderId": 1})
+        broker.run_until_idle()
+        assert ("ELEMENT_ACTIVATED", "collect-money") in wi_events(broker)
+
+        broker = self._restart(broker, data, clock)
+        client = ZeebeClient(broker)
+        worker = JobWorker(broker, "payment-service", lambda ctx: {"paid": True})
+        broker.run_until_idle()
+        assert ("ELEMENT_COMPLETED", "order-process") in wi_events(broker)
+        assert len(worker.handled) == 1
+        broker.close()
+
+    def test_restart_preserves_deployments_and_versions(self, tmp_path):
+        clock = ControlledClock(start_ms=1_000_000)
+        data = str(tmp_path / "data")
+        broker = Broker(num_partitions=1, data_dir=data, clock=clock)
+        client = ZeebeClient(broker)
+        client.deploy_model(order_process_model())
+        client.deploy_model(order_process_model())  # version 2
+
+        broker = self._restart(broker, data, clock)
+        client = ZeebeClient(broker)
+        JobWorker(broker, "payment-service", lambda ctx: None)
+        result = client.create_instance("order-process")
+        assert result.version == 2
+        broker.run_until_idle()
+        assert ("ELEMENT_COMPLETED", "order-process") in wi_events(broker)
+        broker.close()
+
+    def test_replay_rebuilds_identical_state(self, tmp_path):
+        """Replay parity: restarting from the log alone reproduces the exact
+        engine state of the live run (the correctness contract of SURVEY.md
+        §5 — deterministic processing is what makes snapshots optional)."""
+        clock = ControlledClock(start_ms=1_000_000)
+        data = str(tmp_path / "data")
+        broker = Broker(num_partitions=1, data_dir=data, clock=clock)
+        client = ZeebeClient(broker)
+        client.deploy_model(order_process_model())
+        client.create_instance("order-process", payload={"orderId": 7})
+        client.create_instance("order-process", payload={"orderId": 8})
+        broker.run_until_idle()
+        live = broker.partitions[0].engine.snapshot_state()
+
+        broker = self._restart(broker, data, clock)
+        replayed = broker.partitions[0].engine.snapshot_state()
+        assert sorted(replayed["jobs"]) == sorted(live["jobs"])
+        assert sorted(replayed["element_instances"].instances) == sorted(
+            live["element_instances"].instances
+        )
+        assert replayed["wf_keys"].peek == live["wf_keys"].peek
+        assert replayed["job_keys"].peek == live["job_keys"].peek
+        assert replayed["last_processed_position"] == live["last_processed_position"]
+        for key, job in live["jobs"].items():
+            assert replayed["jobs"][key].state == job.state
+            assert replayed["jobs"][key].deadline == job.deadline
+        broker.close()
+
+    def test_snapshot_shortens_replay(self, tmp_path):
+        """With a snapshot, recovery replays only the records after the
+        snapshot position (reference: reprocessing starts at the snapshot's
+        last-processed position)."""
+        clock = ControlledClock(start_ms=1_000_000)
+        data = str(tmp_path / "data")
+        broker = Broker(num_partitions=1, data_dir=data, clock=clock)
+        client = ZeebeClient(broker)
+        client.deploy_model(order_process_model())
+        client.create_instance("order-process")
+        broker.run_until_idle()
+        broker.snapshot()
+        snap_position = broker.partitions[0].next_read_position - 1
+        client.create_instance("order-process")
+        broker.run_until_idle()
+        broker.close()
+
+        processed = []
+        broker = Broker(num_partitions=1, data_dir=data, clock=clock)
+        # the restored engine replayed only positions after the snapshot
+        assert broker.partitions[0].engine.last_processed_position > snap_position
+        # and the state includes BOTH instances (snapshot + replayed)
+        keys = [
+            i.value.workflow_instance_key
+            for i in broker.partitions[0].engine.element_instances.instances.values()
+        ]
+        assert len(set(keys)) == 2
+        broker.close()
+
+    def test_restart_after_snapshot_only_no_tail(self, tmp_path):
+        """Snapshot taken at the log end: recovery restores and replays
+        nothing; processing continues seamlessly."""
+        clock = ControlledClock(start_ms=1_000_000)
+        data = str(tmp_path / "data")
+        broker = Broker(num_partitions=1, data_dir=data, clock=clock)
+        client = ZeebeClient(broker)
+        client.deploy_model(order_process_model())
+        client.create_instance("order-process")
+        broker.run_until_idle()
+        broker.snapshot()
+        broker.close()
+
+        broker = Broker(num_partitions=1, data_dir=data, clock=clock)
+        client = ZeebeClient(broker)
+        JobWorker(broker, "payment-service", lambda ctx: None)
+        broker.run_until_idle()
+        assert ("ELEMENT_COMPLETED", "order-process") in wi_events(broker)
+        broker.close()
+
+    def test_message_ttl_survives_restart_deterministically(self, tmp_path):
+        """A published message's TTL deadline derives from the record
+        timestamp, so a restarted broker expires it at the same absolute
+        time the live broker would have."""
+        clock = ControlledClock(start_ms=1_000_000)
+        data = str(tmp_path / "data")
+        broker = Broker(num_partitions=1, data_dir=data, clock=clock)
+        client = ZeebeClient(broker)
+        client.publish_message("order-shipped", "order-1", time_to_live_ms=5_000)
+        broker.run_until_idle()
+        live_deadline = next(
+            iter(broker.partitions[0].engine.messages.values())
+        ).deadline
+
+        broker = self._restart(broker, data, clock)
+        msg = next(iter(broker.partitions[0].engine.messages.values()))
+        assert msg.deadline == live_deadline
+
+        clock.advance(6_000)
+        broker.tick()
+        broker.run_until_idle()
+        deleted = [
+            r
+            for r in broker.records(0)
+            if r.metadata.value_type == ValueType.MESSAGE
+            and r.metadata.record_type == RecordType.EVENT
+            and r.metadata.intent == int(MessageIntent.DELETED)
+        ]
+        assert deleted
+        assert not broker.partitions[0].engine.messages
+        broker.close()
+
+    def test_multi_partition_restart(self, tmp_path):
+        """Cross-partition message correlation state survives restart on
+        both the message partition and the workflow partition."""
+        clock = ControlledClock(start_ms=1_000_000)
+        data = str(tmp_path / "data")
+        broker = Broker(num_partitions=3, data_dir=data, clock=clock)
+        client = ZeebeClient(broker)
+        model = (
+            Bpmn.create_process("msg-process")
+            .start_event("start")
+            .message_catch_event(
+                "wait", message_name="order-shipped", correlation_key="$.orderId"
+            )
+            .end_event("end")
+            .done()
+        )
+        client.deploy_model(model)
+        client.create_instance(
+            "msg-process", payload={"orderId": "order-77"}, partition_id=1
+        )
+        broker.run_until_idle()
+
+        broker = self._restart(broker, data, clock)
+        client = ZeebeClient(broker)
+        client.publish_message("order-shipped", "order-77", payload={"ok": 1})
+        broker.run_until_idle()
+        assert ("ELEMENT_COMPLETED", "msg-process") in wi_events(broker, 1)
+        broker.close()

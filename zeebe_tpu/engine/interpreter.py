@@ -215,6 +215,15 @@ class WorkflowRepository:
                 return wf
         return None
 
+    def merge(self, workflows: List[ExecutableWorkflow]) -> None:
+        """Idempotent restore-merge (snapshot recovery): register unknown
+        workflows, keeping version lists sorted so ``latest`` stays correct."""
+        for wf in workflows:
+            if wf.key not in self.by_key:
+                self.put(wf)
+        for versions in self.versions.values():
+            versions.sort(key=lambda w: w.version)
+
 
 # ---------------------------------------------------------------------------
 # processing result plumbing
@@ -314,6 +323,53 @@ class PartitionEngine:
     # -- partition routing (reference SubscriptionCommandSender:96-108) ----
     def partition_for_correlation_key(self, correlation_key: str) -> int:
         return _correlation_hash(correlation_key) % self.num_partitions
+
+    # -- snapshot support (reference: ComposedSnapshot of the processor's
+    # state resources — ElementInstanceIndex SerializableWrapper, job RocksDB
+    # checkpoint, incident/message maps; SURVEY.md §5 checkpoint/resume) ----
+    def snapshot_state(self) -> dict:
+        """All log-derived state. Excludes transient client-session state
+        (job subscriptions re-register after failover, as in the reference)
+        and the position→record cache (rebuilt from the log on recovery)."""
+        return {
+            "wf_keys": self.wf_keys,
+            "job_keys": self.job_keys,
+            "incident_keys": self.incident_keys,
+            "deployment_keys": self.deployment_keys,
+            "element_instances": self.element_instances,
+            "jobs": self.jobs,
+            "incidents": self.incidents,
+            "incident_by_activity": self.incident_by_activity,
+            "incident_by_failed_job": self.incident_by_failed_job,
+            "resolving_events": self.resolving_events,
+            "incident_records": self.incident_records,
+            "messages": self.messages,
+            "message_subscriptions": self.message_subscriptions,
+            "timers": self.timers,
+            "last_processed_position": self.last_processed_position,
+            # deployed workflows ride along so a restored partition does not
+            # depend on replaying the deployment partition (reference:
+            # WorkflowCache refetches; here the repository is restored)
+            "workflows": list(self.repository.by_key.values()),
+        }
+
+    def restore_state(self, state: dict) -> None:
+        self.wf_keys = state["wf_keys"]
+        self.job_keys = state["job_keys"]
+        self.incident_keys = state["incident_keys"]
+        self.deployment_keys = state["deployment_keys"]
+        self.element_instances = state["element_instances"]
+        self.jobs = state["jobs"]
+        self.incidents = state["incidents"]
+        self.incident_by_activity = state["incident_by_activity"]
+        self.incident_by_failed_job = state["incident_by_failed_job"]
+        self.resolving_events = state["resolving_events"]
+        self.incident_records = state["incident_records"]
+        self.messages = state["messages"]
+        self.message_subscriptions = state["message_subscriptions"]
+        self.timers = state["timers"]
+        self.last_processed_position = state["last_processed_position"]
+        self.repository.merge(state["workflows"])
 
     # ------------------------------------------------------------------
     # main entry: process one committed record
@@ -939,7 +995,9 @@ class PartitionEngine:
 
     def _h_create_timer(self, record, element, workflow, instance, scope, out):
         # TPU-native: timer catch event
-        due = self.clock() + int(element.timer_duration_ms or 0)
+        # record.timestamp, not clock(): replay must rebuild identical state
+        # (reference reprocessing re-reads due dates from logged records)
+        due = record.timestamp + int(element.timer_duration_ms or 0)
         timer = TimerRecord(
             workflow_instance_key=record.value.workflow_instance_key,
             activity_instance_key=record.key,
@@ -1123,7 +1181,7 @@ class PartitionEngine:
         if subscription is None:
             return
         activated = value.copy()
-        activated.deadline = self.clock() + subscription.timeout
+        activated.deadline = record.timestamp + subscription.timeout
         activated.worker = subscription.worker
         out.written.append(
             _record(
@@ -1153,8 +1211,40 @@ class PartitionEngine:
                 return
 
     # -- host API: subscriptions + deadline checks ------------------------
-    def add_job_subscription(self, subscription: JobSubscription) -> None:
+    def add_job_subscription(self, subscription: JobSubscription) -> List[Record]:
+        """Register a worker subscription and return ACTIVATE commands for the
+        backlog of already-created matching jobs.
+
+        Reference: ActivateJobStreamProcessor is installed on first
+        subscription and reads the log from the start, so pre-existing
+        CREATED (or failed-with-retries / timed-out) jobs get assigned too.
+        The returned commands must be appended to the partition log."""
         self.job_subscriptions.append(subscription)
+        backlog = []
+        activatable = (
+            int(JobIntent.CREATED),
+            int(JobIntent.TIMED_OUT),
+            int(JobIntent.FAILED),
+            int(JobIntent.RETRIES_UPDATED),
+        )
+        for key, job in sorted(self.jobs.items()):
+            if subscription.credits <= 0:
+                break
+            if job.state not in activatable:
+                continue
+            if job.record.type != subscription.job_type or job.record.retries <= 0:
+                continue
+            activated = job.record.copy()
+            activated.deadline = self.clock() + subscription.timeout
+            activated.worker = subscription.worker
+            backlog.append(
+                _record(
+                    RecordType.COMMAND, activated, JobIntent.ACTIVATE, key, -1,
+                    {"request_stream_id": subscription.subscriber_key},
+                )
+            )
+            subscription.credits -= 1
+        return backlog
 
     def remove_job_subscription(self, subscriber_key: int) -> None:
         self.job_subscriptions = [
@@ -1426,7 +1516,7 @@ class PartitionEngine:
                     time_to_live=value.time_to_live,
                     payload=dict(value.payload),
                     message_id=value.message_id,
-                    deadline=self.clock() + value.time_to_live,
+                    deadline=record.timestamp + value.time_to_live,
                 )
             else:
                 out.written.append(

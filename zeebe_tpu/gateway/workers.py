@@ -43,7 +43,7 @@ class JobWorker:
 
         broker.on_push(self.subscriber_key, self._on_push)
         for partition in broker.partitions:
-            partition.engine.add_job_subscription(
+            backlog = partition.engine.add_job_subscription(
                 JobSubscription(
                     subscriber_key=self.subscriber_key,
                     job_type=job_type,
@@ -52,6 +52,10 @@ class JobWorker:
                     credits=credits,
                 )
             )
+            # jobs created before this worker subscribed (e.g. after a broker
+            # restart) are assigned immediately via ACTIVATE commands
+            if backlog:
+                partition.log.append(backlog)
 
     def _on_push(self, partition_id: int, record: Record) -> None:
         self.handled.append(record)

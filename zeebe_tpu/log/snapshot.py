@@ -1,0 +1,159 @@
+"""Snapshot storage + controller: checkpoint/recover partition state.
+
+Reference parity:
+- ``logstreams/.../impl/snapshot/fs/FsSnapshotStorage.java`` /
+  ``FsSnapshotController.java`` — snapshots on disk with checksums,
+  temp-write then commit-rename.
+- ``logstreams/.../state/StateSnapshotController.java`` /
+  ``StateSnapshotMetadata.java`` — checkpoints keyed by
+  (lastProcessedPosition, lastWrittenPosition, term); recovery picks the
+  newest snapshot *valid against the log* (the written position must still
+  exist — guards against a truncated/diverged log, the term check of
+  ``StreamProcessorController.validateSnapshot:177-187``).
+
+Resume contract (SURVEY.md §5 checkpoint/resume): recover best valid
+snapshot, then REPLAY committed records after ``last_processed_position``
+to rebuild state without re-executing side effects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+import re
+import shutil
+import zlib
+from typing import Any, List, Optional
+
+_SNAPSHOT_DIR_RE = re.compile(r"^snapshot_(-?\d+)_(-?\d+)_(-?\d+)$")
+_STATE_FILE = "state.bin"
+_CHECKSUM_FILE = "checksum.crc32"
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class SnapshotMetadata:
+    """Reference: StateSnapshotMetadata.java (ordering = recency)."""
+
+    last_processed_position: int
+    last_written_position: int
+    term: int = 0
+
+    @property
+    def dirname(self) -> str:
+        return (
+            f"snapshot_{self.last_processed_position}"
+            f"_{self.last_written_position}_{self.term}"
+        )
+
+    @staticmethod
+    def parse(dirname: str) -> Optional["SnapshotMetadata"]:
+        m = _SNAPSHOT_DIR_RE.match(dirname)
+        if not m:
+            return None
+        return SnapshotMetadata(int(m.group(1)), int(m.group(2)), int(m.group(3)))
+
+
+class SnapshotStorage:
+    """Directory of committed snapshots for one partition/processor.
+
+    Layout: ``{root}/snapshot_{processed}_{written}_{term}/state.bin`` with a
+    crc32 checksum file; writes go to a ``.tmp`` sibling and are committed by
+    atomic rename (reference FsSnapshotStorage temp-write + commit).
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        # sweep torn temp dirs from a crash mid-write
+        for name in os.listdir(root):
+            if name.endswith(".tmp"):
+                shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+
+    def list(self) -> List[SnapshotMetadata]:
+        """Committed snapshots, newest (highest positions) first."""
+        out = []
+        for name in os.listdir(self.root):
+            meta = SnapshotMetadata.parse(name)
+            if meta is not None:
+                out.append(meta)
+        out.sort(reverse=True)
+        return out
+
+    def write(self, metadata: SnapshotMetadata, payload: bytes) -> None:
+        tmp = os.path.join(self.root, metadata.dirname + ".tmp")
+        final = os.path.join(self.root, metadata.dirname)
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        with open(os.path.join(tmp, _STATE_FILE), "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        with open(os.path.join(tmp, _CHECKSUM_FILE), "w") as f:
+            f.write(str(zlib.crc32(payload)))
+            f.flush()
+            os.fsync(f.fileno())
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)  # the commit point
+
+    def read(self, metadata: SnapshotMetadata) -> Optional[bytes]:
+        """Payload, or None if missing/corrupt (checksum mismatch)."""
+        path = os.path.join(self.root, metadata.dirname)
+        try:
+            with open(os.path.join(path, _STATE_FILE), "rb") as f:
+                payload = f.read()
+            with open(os.path.join(path, _CHECKSUM_FILE)) as f:
+                expected = int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+        if zlib.crc32(payload) != expected:
+            return None
+        return payload
+
+    def delete(self, metadata: SnapshotMetadata) -> None:
+        shutil.rmtree(os.path.join(self.root, metadata.dirname), ignore_errors=True)
+
+    def purge_older_than(self, keep: SnapshotMetadata) -> None:
+        """Reference: FsSnapshotStorage purges obsolete snapshots on commit."""
+        for meta in self.list():
+            if meta < keep:
+                self.delete(meta)
+
+
+class SnapshotController:
+    """Takes/recovers pickled state snapshots for one stream processor.
+
+    The processor supplies ``snapshot_state() -> picklable`` and
+    ``restore_state(obj)`` (the engine's analogue of the reference's
+    ``SnapshotSupport`` composition: ComposedSnapshot over ZbMapSnapshotSupport
+    / SerializableWrapper, FsSnapshotController.java).
+    """
+
+    def __init__(self, storage: SnapshotStorage):
+        self.storage = storage
+
+    def take(self, state: Any, metadata: SnapshotMetadata) -> None:
+        payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+        self.storage.write(metadata, payload)
+        self.storage.purge_older_than(metadata)
+
+    def recover(self, log_last_position: int):
+        """Newest snapshot whose written position is still on the log.
+
+        Returns (state, metadata) or (None, None). Invalid/corrupt snapshots
+        are skipped (and the next older one is tried), mirroring
+        ``StateSnapshotController.recover`` trying metadata candidates.
+        """
+        for meta in self.storage.list():
+            if meta.last_written_position > log_last_position:
+                continue  # log was truncated past this snapshot: stale
+            payload = self.storage.read(meta)
+            if payload is None:
+                continue
+            try:
+                return pickle.loads(payload), meta
+            except Exception:
+                continue
+        return None, None

@@ -21,6 +21,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from zeebe_tpu.engine.interpreter import PartitionEngine, WorkflowRepository
 from zeebe_tpu.log import LogStream, SegmentedLogStorage
+from zeebe_tpu.log.snapshot import SnapshotController, SnapshotMetadata, SnapshotStorage
 from zeebe_tpu.protocol.enums import RecordType, ValueType
 from zeebe_tpu.protocol.records import Record
 from zeebe_tpu.runtime.clock import SystemClock
@@ -29,11 +30,19 @@ from zeebe_tpu.runtime.clock import SystemClock
 class Partition:
     """A partition: log stream + stream processor + reader position."""
 
-    def __init__(self, partition_id: int, log: LogStream, engine: PartitionEngine):
+    def __init__(
+        self,
+        partition_id: int,
+        log: LogStream,
+        engine: PartitionEngine,
+        snapshots: Optional[SnapshotController] = None,
+    ):
         self.partition_id = partition_id
         self.log = log
         self.engine = engine
+        self.snapshots = snapshots
         self.next_read_position = 0
+        self.term = 0  # raft term once replicated; 0 in single-writer mode
 
     def has_backlog(self) -> bool:
         return self.next_read_position <= self.log.commit_position
@@ -68,9 +77,54 @@ class Broker:
             )
         )
         for pid in range(num_partitions):
-            storage = SegmentedLogStorage(os.path.join(self.data_dir, f"partition-{pid}"))
+            pdir = os.path.join(self.data_dir, f"partition-{pid}")
+            storage = SegmentedLogStorage(pdir)
             log = LogStream(storage, partition_id=pid, clock=self.clock)
-            self.partitions.append(Partition(pid, log, factory(pid)))
+            snapshots = SnapshotController(
+                SnapshotStorage(os.path.join(pdir, "snapshots"))
+            )
+            self.partitions.append(Partition(pid, log, factory(pid), snapshots))
+        self._recover_partitions()
+
+    # -- recovery: snapshot + replay (reference StreamProcessorController
+    # recovery :156-211 then reprocessing :213-279) -------------------------
+    def _recover_partitions(self) -> None:
+        """Restore each partition's newest valid snapshot, then replay the
+        committed records after it to rebuild state — without re-executing
+        side effects (no appends, responses, sends, or pushes).
+
+        Partitions replay in id order: deployments commit on their partition
+        before instance commands causally follow on others (the reference's
+        system-partition-first ordering)."""
+        for partition in self.partitions:
+            state, meta = partition.snapshots.recover(partition.log.next_position - 1)
+            if state is not None:
+                partition.engine.restore_state(state)
+                partition.next_read_position = meta.last_processed_position + 1
+            # rebuild the position→record cache for the whole log (reference
+            # TypedStreamReader reads by position during incident resolution)
+            for record in partition.log.reader(0):
+                partition.engine.records_by_position[record.position] = record
+        for partition in self.partitions:
+            self._replay(partition)
+
+    def _replay(self, partition: Partition) -> None:
+        reader = partition.log.reader(partition.next_read_position)
+        for record in reader.read_committed():
+            partition.engine.process(record)  # state updates only
+            partition.next_read_position = record.position + 1
+
+    def snapshot(self) -> None:
+        """Checkpoint every partition (reference: periodic
+        ``actor.runAtFixedRate(snapshotPeriod, createSnapshot)``; here the
+        runtime decides when — tests and the broker's timer loop call it)."""
+        for partition in self.partitions:
+            metadata = SnapshotMetadata(
+                last_processed_position=partition.next_read_position - 1,
+                last_written_position=partition.log.next_position - 1,
+                term=partition.term,
+            )
+            partition.snapshots.take(partition.engine.snapshot_state(), metadata)
 
     # -- client API (reference ClientApiMessageHandler) --------------------
     def write_command(
