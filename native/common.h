@@ -1,0 +1,38 @@
+// Shared helpers for the native runtime layer.
+//
+// The reference's "native-grade" layer is off-heap Java (agrona Unsafe
+// buffers) plus RocksDB via JNI (SURVEY.md §2 "Native / non-Java
+// components"). Here the equivalents are real C++: a lock-free claim/commit
+// ring buffer (dispatcher), segmented log storage (FsLogStorage), frame
+// scanning (LogEntryDescriptor recovery), and a keyed state store.
+#pragma once
+
+#include <cstdint>
+#include <cstddef>
+
+#if defined(_WIN32)
+#define ZB_EXPORT extern "C" __declspec(dllexport)
+#else
+#define ZB_EXPORT extern "C" __attribute__((visibility("default")))
+#endif
+
+namespace zb {
+
+// crc32 (IEEE 802.3, zlib-compatible) — table-based, computed lazily.
+inline uint32_t crc32(const uint8_t* data, size_t len, uint32_t seed = 0) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t c = seed ^ 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+}  // namespace zb

@@ -1,0 +1,40 @@
+// Record-frame scanning: the hot part of the recovery path.
+//
+// Frames are the fixed-layout records documented in
+// zeebe_tpu/protocol/codec.py (SBE-equivalent of the reference's
+// LogEntryDescriptor + protocol.xml message framing):
+//   u32 frame_length | u32 crc32-of-[8:frame_length) | ... body ...
+// The scanner walks a segment buffer, validates lengths + checksums, and
+// reports how many whole valid frames it saw — a torn or corrupt tail stops
+// the scan (the reference's recovery discards the torn tail the same way).
+#include <cstring>
+
+#include "common.h"
+
+// Scan up to `len` bytes. Writes frame start offsets into `offsets_out`
+// (capacity `max_frames`). Returns the number of valid frames. `*valid_len`
+// receives the byte length of the valid prefix.
+ZB_EXPORT int64_t frame_scan(const uint8_t* data, int64_t len,
+                             int64_t* offsets_out, int64_t max_frames,
+                             int64_t* valid_len) {
+  int64_t offset = 0;
+  int64_t count = 0;
+  while (offset + 8 <= len && count < max_frames) {
+    int32_t frame_len;
+    uint32_t crc;
+    std::memcpy(&frame_len, data + offset, 4);
+    std::memcpy(&crc, data + offset + 4, 4);
+    if (frame_len <= 8 || offset + frame_len > len) break;  // torn tail
+    uint32_t actual = zb::crc32(data + offset + 8, static_cast<size_t>(frame_len - 8));
+    if (actual != crc) break;  // corrupt tail
+    if (offsets_out) offsets_out[count] = offset;
+    count++;
+    offset += frame_len;
+  }
+  if (valid_len) *valid_len = offset;
+  return count;
+}
+
+ZB_EXPORT uint32_t zb_crc32(const uint8_t* data, int64_t len, uint32_t seed) {
+  return zb::crc32(data, static_cast<size_t>(len), seed);
+}

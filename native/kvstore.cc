@@ -1,0 +1,285 @@
+// Keyed state store: the native cold-state backend.
+//
+// Equivalent role to the reference's per-processor keyed state — zb-map
+// off-heap hash maps (`zb-map/src/main/java/io/zeebe/map/ZbMap.java`) and
+// the RocksDB StateController (`logstreams/.../state/StateController.java`)
+// — re-designed as a C++ arena + open-addressing index with checkpoint /
+// restore (the StateSnapshotController contract: checkpoint directories
+// recovered on start). Hot state lives in HBM tensors on device; this store
+// holds host-side cold state (payload documents, large records).
+//
+// Layout: one append-only arena of entries {u32 klen, u32 vlen, key, value};
+// an open-addressing power-of-two index of (hash, arena offset). Updates
+// append and repoint; deletes tombstone the index. Checkpoint compacts live
+// entries to a file with a crc32 footer.
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include "common.h"
+
+namespace {
+
+struct Slot {
+  uint64_t hash;    // 0 = empty (hashes are never 0; we force bit 63)
+  int64_t offset;   // arena offset, -1 = tombstone
+};
+
+struct KvStore {
+  uint8_t* arena = nullptr;
+  int64_t arena_size = 0;
+  int64_t arena_cap = 0;
+  Slot* slots = nullptr;
+  int64_t nslots = 0;     // power of two
+  int64_t used = 0;       // live + tombstones
+  int64_t live = 0;
+};
+
+inline uint64_t hash_key(const uint8_t* k, int64_t klen) {
+  // FNV-1a 64, bit 63 forced so 0 never collides with "empty"
+  uint64_t h = 1469598103934665603ull;
+  for (int64_t i = 0; i < klen; i++) h = (h ^ k[i]) * 1099511628211ull;
+  return h | (1ull << 63);
+}
+
+inline const uint8_t* entry_key(const KvStore* kv, int64_t off) {
+  return kv->arena + off + 8;
+}
+inline uint32_t entry_klen(const KvStore* kv, int64_t off) {
+  uint32_t v;
+  std::memcpy(&v, kv->arena + off, 4);
+  return v;
+}
+inline uint32_t entry_vlen(const KvStore* kv, int64_t off) {
+  uint32_t v;
+  std::memcpy(&v, kv->arena + off + 4, 4);
+  return v;
+}
+
+bool arena_reserve(KvStore* kv, int64_t need) {
+  if (kv->arena_size + need <= kv->arena_cap) return true;
+  int64_t cap = kv->arena_cap ? kv->arena_cap : 4096;
+  while (cap < kv->arena_size + need) cap *= 2;
+  auto* p = static_cast<uint8_t*>(std::realloc(kv->arena, static_cast<size_t>(cap)));
+  if (!p) return false;
+  kv->arena = p;
+  kv->arena_cap = cap;
+  return true;
+}
+
+bool grow_index(KvStore* kv);
+
+// find the slot for key; returns insert position if absent
+Slot* probe(KvStore* kv, uint64_t h, const uint8_t* k, int64_t klen, bool* found) {
+  int64_t mask = kv->nslots - 1;
+  int64_t i = static_cast<int64_t>(h) & mask;
+  Slot* first_tomb = nullptr;
+  for (;;) {
+    Slot* s = &kv->slots[i];
+    if (s->hash == 0) {
+      *found = false;
+      return first_tomb ? first_tomb : s;
+    }
+    if (s->offset == -1) {
+      if (!first_tomb) first_tomb = s;
+    } else if (s->hash == h && entry_klen(kv, s->offset) == klen &&
+               std::memcmp(entry_key(kv, s->offset), k, static_cast<size_t>(klen)) == 0) {
+      *found = true;
+      return s;
+    }
+    i = (i + 1) & mask;
+  }
+}
+
+bool grow_index(KvStore* kv) {
+  int64_t n = kv->nslots * 2;
+  auto* slots = static_cast<Slot*>(std::calloc(static_cast<size_t>(n), sizeof(Slot)));
+  if (!slots) return false;
+  Slot* old = kv->slots;
+  int64_t old_n = kv->nslots;
+  kv->slots = slots;
+  kv->nslots = n;
+  kv->used = 0;
+  for (int64_t i = 0; i < old_n; i++) {
+    if (old[i].hash != 0 && old[i].offset != -1) {
+      bool found;
+      const uint8_t* k = entry_key(kv, old[i].offset);
+      Slot* s = probe(kv, old[i].hash, k, entry_klen(kv, old[i].offset), &found);
+      s->hash = old[i].hash;
+      s->offset = old[i].offset;
+      kv->used++;
+    }
+  }
+  std::free(old);
+  return true;
+}
+
+}  // namespace
+
+ZB_EXPORT void* kv_create() {
+  auto* kv = new KvStore();
+  kv->nslots = 1024;
+  kv->slots = static_cast<Slot*>(std::calloc(1024, sizeof(Slot)));
+  return kv;
+}
+
+ZB_EXPORT void kv_destroy(void* handle) {
+  auto* kv = static_cast<KvStore*>(handle);
+  if (!kv) return;
+  std::free(kv->arena);
+  std::free(kv->slots);
+  delete kv;
+}
+
+ZB_EXPORT int kv_put(void* handle, const uint8_t* k, int64_t klen,
+                     const uint8_t* v, int64_t vlen) {
+  auto* kv = static_cast<KvStore*>(handle);
+  if (klen <= 0 || vlen < 0) return -1;
+  if ((kv->used + 1) * 10 >= kv->nslots * 7) {
+    if (!grow_index(kv)) return -1;
+  }
+  int64_t need = 8 + klen + vlen;
+  if (!arena_reserve(kv, need)) return -1;
+  int64_t off = kv->arena_size;
+  uint32_t kl = static_cast<uint32_t>(klen), vl = static_cast<uint32_t>(vlen);
+  std::memcpy(kv->arena + off, &kl, 4);
+  std::memcpy(kv->arena + off + 4, &vl, 4);
+  std::memcpy(kv->arena + off + 8, k, static_cast<size_t>(klen));
+  if (vlen) std::memcpy(kv->arena + off + 8 + klen, v, static_cast<size_t>(vlen));
+  kv->arena_size += need;
+
+  uint64_t h = hash_key(k, klen);
+  bool found;
+  Slot* s = probe(kv, h, k, klen, &found);
+  if (!found) {
+    if (s->hash == 0) kv->used++;  // fresh slot (not a reused tombstone)
+    kv->live++;
+  }
+  s->hash = h;
+  s->offset = off;
+  return 0;
+}
+
+// Returns pointer to the value (valid until next put/compact) or nullptr.
+ZB_EXPORT const uint8_t* kv_get(void* handle, const uint8_t* k, int64_t klen,
+                                int64_t* vlen_out) {
+  auto* kv = static_cast<KvStore*>(handle);
+  bool found;
+  Slot* s = probe(kv, hash_key(k, klen), k, klen, &found);
+  if (!found) return nullptr;
+  *vlen_out = entry_vlen(kv, s->offset);
+  return kv->arena + s->offset + 8 + entry_klen(kv, s->offset);
+}
+
+ZB_EXPORT int kv_del(void* handle, const uint8_t* k, int64_t klen) {
+  auto* kv = static_cast<KvStore*>(handle);
+  bool found;
+  Slot* s = probe(kv, hash_key(k, klen), k, klen, &found);
+  if (!found) return 0;
+  s->offset = -1;  // tombstone
+  kv->live--;
+  return 1;
+}
+
+ZB_EXPORT int64_t kv_count(void* handle) {
+  return static_cast<KvStore*>(handle)->live;
+}
+
+// Iterate live entries: index 0..kv_count-1 is NOT stable across mutation;
+// callers snapshot by walking all slots. Returns vlen or -1 when done.
+// `cursor` is in/out: pass 0 initially; updated to the next slot index.
+ZB_EXPORT int64_t kv_iter_next(void* handle, int64_t* cursor,
+                               const uint8_t** key_out, int64_t* klen_out,
+                               const uint8_t** val_out) {
+  auto* kv = static_cast<KvStore*>(handle);
+  for (int64_t i = *cursor; i < kv->nslots; i++) {
+    Slot* s = &kv->slots[i];
+    if (s->hash != 0 && s->offset != -1) {
+      *cursor = i + 1;
+      *key_out = entry_key(kv, s->offset);
+      *klen_out = entry_klen(kv, s->offset);
+      *val_out = kv->arena + s->offset + 8 + entry_klen(kv, s->offset);
+      return entry_vlen(kv, s->offset);
+    }
+  }
+  *cursor = kv->nslots;
+  return -1;
+}
+
+// Checkpoint live entries (compacted) to `path` with a crc32 footer.
+// Format: u64 count, then {u32 klen, u32 vlen, key, value}*, then u32 crc
+// of everything before it.
+ZB_EXPORT int kv_checkpoint(void* handle, const char* path) {
+  auto* kv = static_cast<KvStore*>(handle);
+  std::FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  uint64_t count = static_cast<uint64_t>(kv->live);
+  uint32_t crc = 0;
+  crc = zb::crc32(reinterpret_cast<uint8_t*>(&count), 8, crc);
+  if (std::fwrite(&count, 8, 1, f) != 1) goto fail;
+  for (int64_t i = 0; i < kv->nslots; i++) {
+    Slot* s = &kv->slots[i];
+    if (s->hash == 0 || s->offset == -1) continue;
+    uint32_t kl = entry_klen(kv, s->offset), vl = entry_vlen(kv, s->offset);
+    const uint8_t* base = kv->arena + s->offset;
+    int64_t n = 8 + kl + vl;
+    crc = zb::crc32(base, static_cast<size_t>(n), crc);
+    if (std::fwrite(base, 1, static_cast<size_t>(n), f) !=
+        static_cast<size_t>(n))
+      goto fail;
+  }
+  if (std::fwrite(&crc, 4, 1, f) != 1) goto fail;
+  std::fclose(f);
+  return 0;
+fail:
+  std::fclose(f);
+  std::remove(path);
+  return -1;
+}
+
+ZB_EXPORT void* kv_restore(const char* path) {
+  std::FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  std::fseek(f, 0, SEEK_END);
+  long fsize = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (fsize < 12) {
+    std::fclose(f);
+    return nullptr;
+  }
+  auto* buf = static_cast<uint8_t*>(std::malloc(static_cast<size_t>(fsize)));
+  if (!buf || std::fread(buf, 1, static_cast<size_t>(fsize), f) !=
+                  static_cast<size_t>(fsize)) {
+    std::free(buf);
+    std::fclose(f);
+    return nullptr;
+  }
+  std::fclose(f);
+
+  uint32_t stored_crc;
+  std::memcpy(&stored_crc, buf + fsize - 4, 4);
+  if (zb::crc32(buf, static_cast<size_t>(fsize - 4)) != stored_crc) {
+    std::free(buf);
+    return nullptr;
+  }
+  uint64_t count;
+  std::memcpy(&count, buf, 8);
+  auto* kv = static_cast<KvStore*>(kv_create());
+  int64_t off = 8;
+  for (uint64_t i = 0; i < count; i++) {
+    if (off + 8 > fsize - 4) goto corrupt;
+    uint32_t kl, vl;
+    std::memcpy(&kl, buf + off, 4);
+    std::memcpy(&vl, buf + off + 4, 4);
+    if (off + 8 + kl + vl > fsize - 4) goto corrupt;
+    if (kv_put(kv, buf + off + 8, kl, buf + off + 8 + kl, vl) != 0) goto corrupt;
+    off += 8 + kl + vl;
+  }
+  std::free(buf);
+  return kv;
+corrupt:
+  std::free(buf);
+  kv_destroy(kv);
+  return nullptr;
+}

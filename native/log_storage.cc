@@ -1,0 +1,211 @@
+// Segmented append-only log storage.
+//
+// TPU-native equivalent of the reference's FsLogStorage
+// (`logstreams/.../impl/log/fs/FsLogStorage.java`: size-bounded segment
+// files, addresses packed as (segmentId << 32) | offset, block append,
+// truncate, recovery scan). Same on-disk format as the Python backend in
+// zeebe_tpu/log/storage.py — the two are interchangeable per partition:
+//   segment file = 16-byte header {u32 magic 'ZLOG', u32 segment_id,
+//   u64 reserved} followed by appended blocks.
+#include <dirent.h>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace {
+
+constexpr uint32_t kMagic = 0x5A4C4F47;  // "ZLOG"
+constexpr int64_t kHeaderSize = 16;
+
+struct Segment {
+  int32_t id;
+  int64_t size;  // file size including header
+};
+
+struct LogStorage {
+  std::string dir;
+  int64_t segment_size;
+  std::vector<Segment> segments;  // sorted by id
+  int fd = -1;                    // tail segment fd
+  int32_t cur_id = -1;
+  int64_t cur_size = 0;
+};
+
+std::string segment_path(const LogStorage* ls, int32_t id) {
+  char name[64];
+  std::snprintf(name, sizeof(name), "segment-%06d.log", id);
+  return ls->dir + "/" + name;
+}
+
+bool roll_segment(LogStorage* ls, int32_t id) {
+  if (ls->fd >= 0) {
+    ::fsync(ls->fd);
+    ::close(ls->fd);
+  }
+  ls->fd = ::open(segment_path(ls, id).c_str(), O_RDWR | O_CREAT | O_TRUNC, 0644);
+  if (ls->fd < 0) return false;
+  uint8_t header[kHeaderSize] = {0};
+  std::memcpy(header, &kMagic, 4);
+  std::memcpy(header + 4, &id, 4);
+  if (::pwrite(ls->fd, header, kHeaderSize, 0) != kHeaderSize) return false;
+  ls->cur_id = id;
+  ls->cur_size = kHeaderSize;
+  ls->segments.push_back({id, kHeaderSize});
+  return true;
+}
+
+}  // namespace
+
+ZB_EXPORT void* ls_open(const char* directory, int64_t segment_size) {
+  auto* ls = new LogStorage();
+  ls->dir = directory;
+  ls->segment_size = segment_size;
+  ::mkdir(directory, 0755);
+
+  std::vector<int32_t> ids;
+  if (DIR* d = ::opendir(directory)) {
+    while (struct dirent* e = ::readdir(d)) {
+      int id;
+      if (std::sscanf(e->d_name, "segment-%d.log", &id) == 1) ids.push_back(id);
+    }
+    ::closedir(d);
+  }
+  std::sort(ids.begin(), ids.end());
+  for (int32_t id : ids) {
+    struct stat st;
+    if (::stat(segment_path(ls, id).c_str(), &st) != 0) continue;
+    ls->segments.push_back({id, static_cast<int64_t>(st.st_size)});
+  }
+  if (ls->segments.empty()) {
+    if (!roll_segment(ls, 0)) {
+      delete ls;
+      return nullptr;
+    }
+  } else {
+    Segment& last = ls->segments.back();
+    ls->fd = ::open(segment_path(ls, last.id).c_str(), O_RDWR, 0644);
+    if (ls->fd < 0) {
+      delete ls;
+      return nullptr;
+    }
+    ls->cur_id = last.id;
+    ls->cur_size = last.size;
+  }
+  return ls;
+}
+
+ZB_EXPORT void ls_close(void* handle) {
+  auto* ls = static_cast<LogStorage*>(handle);
+  if (!ls) return;
+  if (ls->fd >= 0) {
+    ::fsync(ls->fd);
+    ::close(ls->fd);
+  }
+  delete ls;
+}
+
+// Append a block; returns its address ((segment_id << 32) | offset) or -1.
+ZB_EXPORT int64_t ls_append(void* handle, const uint8_t* data, int64_t len) {
+  auto* ls = static_cast<LogStorage*>(handle);
+  if (len <= 0) return -1;
+  if (ls->cur_size + len > ls->segment_size && ls->cur_size > kHeaderSize) {
+    if (!roll_segment(ls, ls->cur_id + 1)) return -1;
+  }
+  int64_t offset = ls->cur_size;
+  int64_t written = 0;
+  while (written < len) {
+    ssize_t n = ::pwrite(ls->fd, data + written, static_cast<size_t>(len - written),
+                         offset + written);
+    if (n <= 0) return -1;
+    written += n;
+  }
+  ls->cur_size += len;
+  ls->segments.back().size = ls->cur_size;
+  return (static_cast<int64_t>(ls->cur_id) << 32) | offset;
+}
+
+ZB_EXPORT int ls_flush(void* handle) {
+  auto* ls = static_cast<LogStorage*>(handle);
+  return ls->fd >= 0 ? ::fsync(ls->fd) : 0;
+}
+
+// Read `len` bytes at `address` into `out`. Returns bytes read (may be
+// short at segment end) or -1.
+ZB_EXPORT int64_t ls_read(void* handle, int64_t address, uint8_t* out, int64_t len) {
+  auto* ls = static_cast<LogStorage*>(handle);
+  int32_t seg = static_cast<int32_t>(address >> 32);
+  int64_t offset = address & 0xFFFFFFFFll;
+  int fd = (seg == ls->cur_id) ? ls->fd
+                               : ::open(segment_path(ls, seg).c_str(), O_RDONLY);
+  if (fd < 0) return -1;
+  int64_t got = 0;
+  while (got < len) {
+    ssize_t n = ::pread(fd, out + got, static_cast<size_t>(len - got), offset + got);
+    if (n < 0) {
+      got = -1;
+      break;
+    }
+    if (n == 0) break;  // segment end
+    got += n;
+  }
+  if (fd != ls->fd) ::close(fd);
+  return got;
+}
+
+ZB_EXPORT int32_t ls_segment_count(void* handle) {
+  return static_cast<int32_t>(static_cast<LogStorage*>(handle)->segments.size());
+}
+
+ZB_EXPORT int32_t ls_segment_id(void* handle, int32_t index) {
+  auto* ls = static_cast<LogStorage*>(handle);
+  if (index < 0 || index >= static_cast<int32_t>(ls->segments.size())) return -1;
+  return ls->segments[index].id;
+}
+
+ZB_EXPORT int64_t ls_segment_data_size(void* handle, int32_t segment_id) {
+  auto* ls = static_cast<LogStorage*>(handle);
+  for (const Segment& s : ls->segments)
+    if (s.id == segment_id) return s.size - kHeaderSize;
+  return -1;
+}
+
+ZB_EXPORT int64_t ls_first_address(void* handle) {
+  auto* ls = static_cast<LogStorage*>(handle);
+  if (ls->segments.empty()) return -1;
+  return (static_cast<int64_t>(ls->segments.front().id) << 32) | kHeaderSize;
+}
+
+// Discard everything at/after `address` (failure injection + raft log
+// truncation on leader change; reference FsLogStorage.truncate).
+ZB_EXPORT int ls_truncate(void* handle, int64_t address) {
+  auto* ls = static_cast<LogStorage*>(handle);
+  int32_t seg = static_cast<int32_t>(address >> 32);
+  int64_t offset = address & 0xFFFFFFFFll;
+  if (offset < kHeaderSize) return -1;
+
+  // delete later segments
+  while (!ls->segments.empty() && ls->segments.back().id > seg) {
+    ::unlink(segment_path(ls, ls->segments.back().id).c_str());
+    ls->segments.pop_back();
+  }
+  if (ls->segments.empty() || ls->segments.back().id != seg) return -1;
+  if (ls->cur_id != seg) {
+    if (ls->fd >= 0) ::close(ls->fd);
+    ls->fd = ::open(segment_path(ls, seg).c_str(), O_RDWR, 0644);
+    if (ls->fd < 0) return -1;
+    ls->cur_id = seg;
+  }
+  if (::ftruncate(ls->fd, offset) != 0) return -1;
+  ls->cur_size = offset;
+  ls->segments.back().size = offset;
+  return 0;
+}

@@ -1,0 +1,198 @@
+// Many-producer / single-consumer claim-commit ring buffer.
+//
+// The broker-internal backbone between the command intake and the log
+// appender, and between transport receive paths and their consumers —
+// the TPU-native equivalent of the reference's Aeron-style dispatcher
+// (`dispatcher/src/main/java/io/zeebe/dispatcher/Dispatcher.java`:
+// producers claim fragments and commit by publishing the frame header;
+// consumers peek contiguous committed blocks). Re-designed, not ported:
+// one power-of-two ring with a single atomic claim head, frame states
+// published with release stores, padding frames at wrap.
+//
+// Frame layout (8-byte aligned):
+//   int32 length  (payload length; whole frame is 8 + align8(length))
+//   int32 state   (0 = claimed/pending, 1 = committed, 2 = padding,
+//                  3 = aborted)
+//
+// Concurrency contract:
+//   - rb_claim: any thread (atomic fetch_add on head)
+//   - rb_commit / rb_abort: the claiming thread
+//   - rb_peek / rb_consume: one consumer thread
+#include <atomic>
+#include <cstdlib>
+#include <cstring>
+#include <new>
+
+#include "common.h"
+
+namespace {
+
+constexpr int32_t kStatePending = 0;
+constexpr int32_t kStateCommitted = 1;
+constexpr int32_t kStatePadding = 2;
+constexpr int32_t kStateAborted = 3;
+constexpr int64_t kHeaderSize = 8;
+constexpr int64_t kAlignment = 8;
+
+inline int64_t align8(int64_t v) { return (v + kAlignment - 1) & ~(kAlignment - 1); }
+
+struct RingBuffer {
+  uint8_t* data;
+  int64_t capacity;          // power of two
+  int64_t mask;
+  std::atomic<int64_t> head; // next claim position (monotonic)
+  std::atomic<int64_t> tail; // consume position (monotonic)
+  // consumer-local scan position within [tail, head]
+  int64_t scan;
+
+  int32_t* header_at(int64_t pos) {
+    return reinterpret_cast<int32_t*>(data + (pos & mask));
+  }
+};
+
+inline std::atomic<int32_t>* state_of(RingBuffer* rb, int64_t pos) {
+  return reinterpret_cast<std::atomic<int32_t>*>(rb->data + ((pos + 4) & rb->mask));
+}
+
+// Zero a frame header before releasing its region to producers: a region
+// that was claimed (head advanced) but whose header is not yet written must
+// read as pending, never as a stale committed frame from the previous lap.
+inline void retire(RingBuffer* rb, int64_t frame_pos, int64_t frame_size) {
+  std::memset(rb->data + (frame_pos & rb->mask), 0, kHeaderSize);
+  rb->scan = frame_pos + frame_size;
+  rb->tail.store(rb->scan, std::memory_order_release);
+}
+
+}  // namespace
+
+ZB_EXPORT void* rb_create(int64_t capacity) {
+  if (capacity < 64 || (capacity & (capacity - 1)) != 0) return nullptr;
+  auto* rb = new (std::nothrow) RingBuffer();
+  if (!rb) return nullptr;
+  rb->data = static_cast<uint8_t*>(std::calloc(1, static_cast<size_t>(capacity)));
+  if (!rb->data) {
+    delete rb;
+    return nullptr;
+  }
+  rb->capacity = capacity;
+  rb->mask = capacity - 1;
+  rb->head.store(0, std::memory_order_relaxed);
+  rb->tail.store(0, std::memory_order_relaxed);
+  rb->scan = 0;
+  return rb;
+}
+
+ZB_EXPORT void rb_destroy(void* handle) {
+  auto* rb = static_cast<RingBuffer*>(handle);
+  if (!rb) return;
+  std::free(rb->data);
+  delete rb;
+}
+
+ZB_EXPORT int64_t rb_capacity(void* handle) {
+  return static_cast<RingBuffer*>(handle)->capacity;
+}
+
+// Claim a frame for `length` payload bytes. Returns the payload's ring
+// position (use rb_buffer_ptr to write), or -1 on backpressure (ring full),
+// -2 on invalid length. The claim appears to the consumer only after
+// rb_commit.
+ZB_EXPORT int64_t rb_claim(void* handle, int32_t length) {
+  auto* rb = static_cast<RingBuffer*>(handle);
+  const int64_t frame = kHeaderSize + align8(length);
+  if (length <= 0 || frame > rb->capacity / 2) return -2;
+
+  for (;;) {
+    int64_t head = rb->head.load(std::memory_order_relaxed);
+    int64_t tail = rb->tail.load(std::memory_order_acquire);
+    int64_t head_idx = head & rb->mask;
+    int64_t to_end = rb->capacity - head_idx;
+    int64_t need = frame;
+    bool pad = false;
+    if (to_end < frame) {  // frame would wrap: claim padding to end first
+      need = to_end + frame;
+      pad = true;
+    }
+    if (head + need - tail > rb->capacity) return -1;  // full
+    if (!rb->head.compare_exchange_weak(head, head + need,
+                                        std::memory_order_acq_rel))
+      continue;
+    if (pad) {
+      // publish the padding frame (committed immediately)
+      int32_t* hdr = rb->header_at(head);
+      hdr[0] = static_cast<int32_t>(to_end - kHeaderSize);
+      state_of(rb, head)->store(kStatePadding, std::memory_order_release);
+      head += to_end;
+    }
+    int32_t* hdr = rb->header_at(head);
+    hdr[0] = length;
+    state_of(rb, head)->store(kStatePending, std::memory_order_release);
+    return head + kHeaderSize;  // payload position
+  }
+}
+
+ZB_EXPORT uint8_t* rb_buffer_ptr(void* handle, int64_t payload_pos) {
+  auto* rb = static_cast<RingBuffer*>(handle);
+  return rb->data + (payload_pos & rb->mask);
+}
+
+ZB_EXPORT void rb_commit(void* handle, int64_t payload_pos) {
+  auto* rb = static_cast<RingBuffer*>(handle);
+  state_of(rb, payload_pos - kHeaderSize)
+      ->store(kStateCommitted, std::memory_order_release);
+}
+
+ZB_EXPORT void rb_abort(void* handle, int64_t payload_pos) {
+  auto* rb = static_cast<RingBuffer*>(handle);
+  state_of(rb, payload_pos - kHeaderSize)
+      ->store(kStateAborted, std::memory_order_release);
+}
+
+// Consumer: peek the next committed frame at/after the scan position.
+// Returns payload length and sets *payload_pos, or 0 if nothing committed
+// yet (including when the next frame is still pending — ordering is
+// preserved, a pending claim blocks later commits from being surfaced,
+// exactly like the dispatcher's block peek).
+ZB_EXPORT int32_t rb_peek(void* handle, int64_t* payload_pos) {
+  auto* rb = static_cast<RingBuffer*>(handle);
+  for (;;) {
+    int64_t pos = rb->scan;
+    if (pos >= rb->head.load(std::memory_order_acquire)) return 0;
+    int32_t state = state_of(rb, pos)->load(std::memory_order_acquire);
+    int32_t length = rb->header_at(pos)[0];
+    if (state == kStatePadding || state == kStateAborted) {
+      retire(rb, pos, kHeaderSize + align8(length));  // consumed immediately
+      continue;
+    }
+    if (state != kStateCommitted) return 0;  // pending claim gates the stream
+    *payload_pos = pos + kHeaderSize;
+    return length;
+  }
+}
+
+// Consume the frame previously returned by rb_peek.
+ZB_EXPORT void rb_consume(void* handle, int64_t payload_pos, int32_t length) {
+  auto* rb = static_cast<RingBuffer*>(handle);
+  retire(rb, payload_pos - kHeaderSize, kHeaderSize + align8(length));
+}
+
+// Convenience for bindings/tests: copy-in publish (claim+memcpy+commit).
+ZB_EXPORT int64_t rb_offer(void* handle, const uint8_t* data, int32_t length) {
+  int64_t pos = rb_claim(handle, length);
+  if (pos < 0) return pos;
+  std::memcpy(rb_buffer_ptr(handle, pos), data, static_cast<size_t>(length));
+  rb_commit(handle, pos);
+  return pos;
+}
+
+// Convenience: copy-out poll. Returns payload length (<= cap bytes copied)
+// or 0 when empty.
+ZB_EXPORT int32_t rb_poll(void* handle, uint8_t* out, int32_t cap) {
+  int64_t pos = 0;
+  int32_t len = rb_peek(handle, &pos);
+  if (len == 0) return 0;
+  int32_t n = len < cap ? len : cap;
+  std::memcpy(out, rb_buffer_ptr(handle, pos), static_cast<size_t>(n));
+  rb_consume(handle, pos, len);
+  return len;
+}

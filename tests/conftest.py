@@ -13,13 +13,22 @@ import os
 # tests must run on the virtual CPU mesh — the real chip is bench-only.
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The env var alone is NOT enough: the runner's sitecustomize re-injects the
+# axon platform, silently routing every test op through the TPU tunnel
+# (orders of magnitude slower). The config update below wins as long as it
+# happens before the backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 # Persistent compile cache: the step kernel is a large jit program; caching
-# makes repeat test runs fast.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
+# makes repeat test runs fast. (Must be config.update, not env vars — this
+# jax build never reads the JAX_COMPILATION_CACHE_DIR env var.)
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".jax_cache")),
 )
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
 
 import pytest  # noqa: E402
 
