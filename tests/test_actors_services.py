@@ -1,0 +1,358 @@
+"""Actor scheduler + service container tests.
+
+Reference parity: ``util/src/test/.../sched`` (actor scheduling, timers,
+conditions, futures, single-writer serialization; ActorSchedulerRule /
+ControlledActorSchedulerRule) and ``service-container/src/test`` (dependency
+start ordering, injection, groups, stop cascades; 2,309 LoC).
+"""
+
+import threading
+import time
+
+import pytest
+
+from zeebe_tpu.runtime.actors import (
+    Actor,
+    ActorFuture,
+    ActorScheduler,
+    ControlledActorScheduler,
+)
+from zeebe_tpu.runtime.clock import ControlledClock
+from zeebe_tpu.runtime.services import Service, ServiceContainer
+
+
+@pytest.fixture
+def scheduler():
+    s = ActorScheduler(cpu_threads=2, io_threads=1).start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture
+def controlled():
+    clock = ControlledClock(start_ms=0)
+    s = ControlledActorScheduler(clock=clock).start()
+    return s, clock
+
+
+class Recorder(Actor):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+        self.started = threading.Event()
+
+    def on_actor_started(self):
+        self.events.append("started")
+        self.started.set()
+
+
+class TestActorScheduler:
+    def test_submit_and_run(self, scheduler):
+        actor = Recorder()
+        scheduler.submit_actor(actor).join(5)
+        assert actor.events == ["started"]
+        done = ActorFuture()
+        actor.actor.run(lambda: (actor.events.append("ran"), done.complete())[-1])
+        done.join(5)
+        assert actor.events == ["started", "ran"]
+
+    def test_call_returns_value(self, scheduler):
+        actor = Recorder()
+        scheduler.submit_actor(actor).join(5)
+        assert actor.actor.call(lambda: 41 + 1).join(5) == 42
+
+    def test_call_propagates_exception(self, scheduler):
+        actor = Recorder()
+        scheduler.submit_actor(actor).join(5)
+
+        def boom():
+            raise ValueError("nope")
+
+        with pytest.raises(ValueError):
+            actor.actor.call(boom).join(5)
+
+    def test_single_writer_serialization(self, scheduler):
+        """Jobs from many threads interleave but never run concurrently on
+        one actor (the core single-writer guarantee)."""
+        actor = Recorder()
+        scheduler.submit_actor(actor).join(5)
+        counter = {"v": 0, "max_in_flight": 0}
+        in_flight = {"n": 0}
+        total = 2000
+        done = ActorFuture()
+
+        def job():
+            in_flight["n"] += 1
+            counter["max_in_flight"] = max(counter["max_in_flight"], in_flight["n"])
+            v = counter["v"]
+            counter["v"] = v + 1  # racy unless serialized
+            in_flight["n"] -= 1
+            if counter["v"] == total:
+                done.complete()
+
+        def submit_many():
+            for _ in range(total // 4):
+                actor.actor.run(job)
+
+        threads = [threading.Thread(target=submit_many) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        done.join(10)
+        assert counter["v"] == total
+        assert counter["max_in_flight"] == 1
+
+    def test_run_delayed(self, scheduler):
+        actor = Recorder()
+        scheduler.submit_actor(actor).join(5)
+        fired = ActorFuture()
+        t0 = time.monotonic()
+        actor.actor.run_delayed(50, lambda: fired.complete(time.monotonic() - t0))
+        elapsed = fired.join(5)
+        assert elapsed >= 0.045
+
+    def test_run_at_fixed_rate_and_cancel(self, scheduler):
+        actor = Recorder()
+        scheduler.submit_actor(actor).join(5)
+        hits = []
+        enough = ActorFuture()
+
+        def tick():
+            hits.append(1)
+            if len(hits) == 3:
+                enough.complete()
+
+        timer = actor.actor.run_at_fixed_rate(10, tick)
+        enough.join(5)
+        timer.cancel()
+        n = len(hits)
+        time.sleep(0.1)
+        assert len(hits) <= n + 1  # at most one in-flight tick after cancel
+
+    def test_condition_signal(self, scheduler):
+        actor = Recorder()
+        scheduler.submit_actor(actor).join(5)
+        fired = ActorFuture()
+        cond = actor.actor.on_condition("data-ready", lambda: fired.complete("ok"))
+        cond.signal()
+        assert fired.join(5) == "ok"
+
+    def test_run_on_completion(self, scheduler):
+        a, b = Recorder(), Recorder()
+        scheduler.submit_actor(a).join(5)
+        scheduler.submit_actor(b).join(5)
+        chained = ActorFuture()
+        f = a.actor.call(lambda: "payload")
+        b.actor.run_on_completion(f, lambda fut: chained.complete(fut.join(0)))
+        assert chained.join(5) == "payload"
+
+    def test_close_actor_stops_jobs(self, scheduler):
+        actor = Recorder()
+        scheduler.submit_actor(actor).join(5)
+        scheduler.close_actor(actor).join(5)
+        actor.actor.run(lambda: actor.events.append("after-close"))
+        time.sleep(0.05)
+        assert "after-close" not in actor.events
+
+
+class TestControlledScheduler:
+    def test_deterministic_draining(self, controlled):
+        scheduler, _clock = controlled
+        actor = Recorder()
+        scheduler.submit_actor(actor)
+        assert actor.events == []  # nothing runs until work_until_done
+        scheduler.work_until_done()
+        assert actor.events == ["started"]
+
+    def test_timers_fire_on_clock_advance(self, controlled):
+        scheduler, clock = controlled
+        actor = Recorder()
+        scheduler.submit_actor(actor)
+        scheduler.work_until_done()
+        actor.actor.run_delayed(1000, lambda: actor.events.append("late"))
+        scheduler.work_until_done()
+        assert "late" not in actor.events
+        clock.advance(999)
+        scheduler.work_until_done()
+        assert "late" not in actor.events
+        clock.advance(1)
+        scheduler.work_until_done()
+        assert "late" in actor.events
+
+    def test_fixed_rate_fires_per_period(self, controlled):
+        scheduler, clock = controlled
+        actor = Recorder()
+        scheduler.submit_actor(actor)
+        scheduler.work_until_done()
+        hits = []
+        actor.actor.run_at_fixed_rate(100, lambda: hits.append(scheduler.now_ms()))
+        for _ in range(3):
+            clock.advance(100)
+            scheduler.work_until_done()
+        assert hits == [100, 200, 300]
+
+    def test_job_exception_does_not_wedge_actor(self, controlled):
+        """A raising job must not leave the actor permanently unschedulable
+        (regression: _running stayed True after an uncaught exception)."""
+        scheduler, _clock = controlled
+        actor = Recorder()
+        scheduler.submit_actor(actor)
+        scheduler.work_until_done()
+
+        def boom():
+            raise RuntimeError("job failed")
+
+        actor.actor.run(boom)
+        scheduler.work_until_done()
+        actor.actor.run(lambda: actor.events.append("alive"))
+        scheduler.work_until_done()
+        assert "alive" in actor.events
+
+
+class Tracked(Service):
+    def __init__(self, log, name):
+        self.log = log
+        self.name = name
+        self.injected = {}
+
+    def start(self, ctx):
+        self.log.append(("start", self.name))
+
+    def stop(self, ctx):
+        self.log.append(("stop", self.name))
+
+
+class TestServiceContainer:
+    @pytest.fixture
+    def container(self, controlled):
+        scheduler, _ = controlled
+        c = ServiceContainer(scheduler)
+        scheduler.work_until_done()
+        return c, scheduler
+
+    def test_start_ordering_follows_dependencies(self, container):
+        c, s = container
+        log = []
+        # install dependent FIRST: must wait for its dependency
+        c.create_service("b", Tracked(log, "b")).dependency("a").install()
+        s.work_until_done()
+        assert log == []
+        c.create_service("a", Tracked(log, "a")).install()
+        s.work_until_done()
+        assert log == [("start", "a"), ("start", "b")]
+
+    def test_injection(self, container):
+        c, s = container
+        log = []
+        a = Tracked(log, "a")
+        b = Tracked(log, "b")
+        c.create_service("a", a).install()
+        c.create_service("b", b).dependency(
+            "a", lambda svc: b.injected.__setitem__("a", svc)
+        ).install()
+        s.work_until_done()
+        assert b.injected["a"] is a
+
+    def test_double_install_fails(self, container):
+        c, s = container
+        log = []
+        f1 = c.create_service("x", Tracked(log, "x1")).install()
+        f2 = c.create_service("x", Tracked(log, "x2")).install()
+        s.work_until_done()
+        assert f1.join(0)
+        with pytest.raises(ValueError):
+            f2.join(0)
+
+    def test_remove_cascades_to_dependents(self, container):
+        c, s = container
+        log = []
+        c.create_service("a", Tracked(log, "a")).install()
+        c.create_service("b", Tracked(log, "b")).dependency("a").install()
+        c.create_service("c", Tracked(log, "c")).dependency("b").install()
+        s.work_until_done()
+        log.clear()
+        c.remove_service("a")
+        s.work_until_done()
+        assert log == [("stop", "c"), ("stop", "b"), ("stop", "a")]
+        assert not c.has_service("a")
+
+    def test_groups_join_leave_listeners(self, container):
+        c, s = container
+        log = []
+        joins, leaves = [], []
+        c.on_group_change(
+            "partitions",
+            on_join=lambda n, svc: joins.append(n),
+            on_leave=lambda n, svc: leaves.append(n),
+        )
+        c.create_service("p-0", Tracked(log, "p-0")).group("partitions").install()
+        s.work_until_done()
+        assert joins == ["p-0"]
+        # late listener sees existing members
+        late_joins = []
+        c.on_group_change("partitions", on_join=lambda n, svc: late_joins.append(n))
+        s.work_until_done()
+        assert late_joins == ["p-0"]
+        c.remove_service("p-0")
+        s.work_until_done()
+        assert leaves == ["p-0"]
+        assert c.group_members("partitions") == []
+
+    def test_composite_install(self, container):
+        c, s = container
+        log = []
+        comp = c.composite()
+        comp.create_service("x", Tracked(log, "x"))
+        comp.create_service("y", Tracked(log, "y")).dependency("x")
+        done = comp.install()
+        s.work_until_done()
+        assert done.is_done()
+        assert ("start", "x") in log and ("start", "y") in log
+
+    def test_circular_dependency_rejected(self, container):
+        c, s = container
+        log = []
+        c.create_service("a", Tracked(log, "a")).dependency("b").install()
+        f = c.create_service("b", Tracked(log, "b")).dependency("a").install()
+        s.work_until_done()
+        with pytest.raises(ValueError, match="circular"):
+            f.join(0)
+
+    def test_composite_install_failure_propagates(self, container):
+        c, s = container
+
+        class Failing(Service):
+            def start(self, ctx):
+                raise RuntimeError("bad service")
+
+        comp = c.composite()
+        comp.create_service("ok", Tracked([], "ok"))
+        comp.create_service("bad", Failing())
+        done = comp.install()
+        s.work_until_done()
+        with pytest.raises(RuntimeError, match="bad service"):
+            done.join(0)
+
+    def test_concurrent_remove_completes_after_stop(self, container):
+        c, s = container
+        log = []
+        c.create_service("x", Tracked(log, "x")).install()
+        s.work_until_done()
+        f1 = c.remove_service("x")
+        f2 = c.remove_service("x")
+        s.work_until_done()
+        assert f1.is_done() and f2.is_done()
+        assert log.count(("stop", "x")) == 1
+
+    def test_close_stops_everything(self, container):
+        c, s = container
+        log = []
+        c.create_service("a", Tracked(log, "a")).install()
+        c.create_service("b", Tracked(log, "b")).dependency("a").install()
+        s.work_until_done()
+        log.clear()
+        c.close()
+        s.work_until_done()
+        assert ("stop", "a") in log and ("stop", "b") in log
+        assert log.index(("stop", "b")) < log.index(("stop", "a"))

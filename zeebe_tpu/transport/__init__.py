@@ -1,0 +1,8 @@
+from zeebe_tpu.transport.transport import (
+    ClientTransport,
+    RemoteAddress,
+    ServerTransport,
+    TransportError,
+)
+
+__all__ = ["ClientTransport", "ServerTransport", "RemoteAddress", "TransportError"]
