@@ -334,6 +334,19 @@ class TestServiceContainer:
         with pytest.raises(RuntimeError, match="bad service"):
             done.join(0)
 
+    def test_remove_pending_service_unblocks_installer(self, container):
+        """Removing a never-started registration must not call stop() and
+        must fail the pending install future (regression)."""
+        c, s = container
+        log = []
+        f = c.create_service("waiting", Tracked(log, "waiting")).dependency("never").install()
+        removed = c.remove_service("waiting")
+        s.work_until_done()
+        assert removed.is_done()
+        with pytest.raises(ValueError, match="removed before start"):
+            f.join(0)
+        assert log == []  # neither start nor stop ran
+
     def test_concurrent_remove_completes_after_stop(self, container):
         c, s = container
         log = []

@@ -1,0 +1,165 @@
+"""Gossip cluster tests: join, dissemination, failure detection, refutation.
+
+Reference parity: ``gossip/src/test`` — GossipJoinTest,
+GossipFailureDetectionTest, custom-event dissemination tests, all running N
+real gossip actors over real loopback transport in one process
+(GossipClusterRule; SURVEY.md §4).
+"""
+
+import time
+
+import pytest
+
+from zeebe_tpu.cluster import Gossip, GossipConfig, MemberStatus
+from zeebe_tpu.runtime.actors import ActorScheduler
+
+FAST = GossipConfig(
+    probe_interval_ms=30,
+    probe_timeout_ms=120,
+    probe_indirect_timeout_ms=240,
+    suspicion_multiplier=3,
+    sync_interval_ms=300,
+)
+
+
+def wait_until(predicate, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def scheduler():
+    s = ActorScheduler(cpu_threads=2, io_threads=2).start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture
+def cluster(scheduler):
+    nodes = []
+
+    def make(n):
+        for i in range(n):
+            nodes.append(Gossip(f"node-{i}", scheduler, config=FAST))
+        # all join via node-0 (the contact point)
+        for node in nodes[1:]:
+            node.join([nodes[0].address]).join(5)
+        return nodes
+
+    yield make
+    for node in nodes:
+        node.close()
+
+
+class TestJoin:
+    def test_three_nodes_converge(self, cluster):
+        nodes = cluster(3)
+        expect = sorted(n.member_id for n in nodes)
+        assert wait_until(
+            lambda: all(n.alive_members() == expect for n in nodes)
+        ), [n.alive_members() for n in nodes]
+
+    def test_late_joiner_learns_members_and_is_learned(self, cluster, scheduler):
+        nodes = cluster(3)
+        late = Gossip("node-late", scheduler, config=FAST)
+        try:
+            late.join([nodes[1].address]).join(5)
+            expect = sorted([n.member_id for n in nodes] + ["node-late"])
+            assert wait_until(
+                lambda: late.alive_members() == expect
+                and all(n.alive_members() == expect for n in nodes)
+            )
+        finally:
+            late.close()
+
+    def test_join_falls_back_to_reachable_contact_point(self, cluster, scheduler):
+        nodes = cluster(2)
+        from zeebe_tpu.transport import RemoteAddress
+
+        late = Gossip("node-x", scheduler, config=FAST)
+        try:
+            late.join([RemoteAddress("127.0.0.1", 1), nodes[0].address]).join(5)
+            assert wait_until(lambda: "node-x" in nodes[0].alive_members())
+        finally:
+            late.close()
+
+    def test_join_no_contact_point_fails(self, scheduler):
+        from zeebe_tpu.transport import RemoteAddress
+
+        node = Gossip("lonely", scheduler, config=FAST)
+        try:
+            with pytest.raises(RuntimeError):
+                node.join([RemoteAddress("127.0.0.1", 1)]).join(5)
+        finally:
+            node.close()
+
+
+class TestFailureDetection:
+    def test_dead_node_is_confirmed_dead(self, cluster):
+        nodes = cluster(3)
+        expect = sorted(n.member_id for n in nodes)
+        assert wait_until(lambda: all(n.alive_members() == expect for n in nodes))
+        victim = nodes[2]
+        victim.close()  # hard kill: no leave broadcast
+        survivors = nodes[:2]
+        assert wait_until(
+            lambda: all(
+                n.members["node-2"].status == MemberStatus.DEAD for n in survivors
+            ),
+            timeout=20,
+        ), [
+            (n.member_id, {m.member_id: m.status for m in n.members.values()})
+            for n in survivors
+        ]
+
+    def test_graceful_leave_spreads(self, cluster):
+        nodes = cluster(3)
+        expect = sorted(n.member_id for n in nodes)
+        assert wait_until(lambda: all(n.alive_members() == expect for n in nodes))
+        nodes[2].leave()
+        time.sleep(0.1)  # let the leave event piggyback out
+        nodes[2].close()
+        assert wait_until(
+            lambda: all(
+                "node-2" not in n.alive_members() for n in nodes[:2]
+            ),
+            timeout=10,
+        )
+
+
+class TestCustomEvents:
+    def test_custom_event_reaches_all_nodes_once(self, cluster):
+        nodes = cluster(3)
+        expect = sorted(n.member_id for n in nodes)
+        assert wait_until(lambda: all(n.alive_members() == expect for n in nodes))
+        received = {n.member_id: [] for n in nodes}
+        for n in nodes:
+            n.on_custom_event(
+                "topology",
+                lambda sender, payload, nid=n.member_id: received[nid].append(
+                    (sender, payload)
+                ),
+            )
+        nodes[0].publish_custom_event("topology", {"partitions": [0, 1]})
+        assert wait_until(
+            lambda: all(len(v) >= 1 for v in received.values()), timeout=10
+        ), received
+        time.sleep(0.3)  # give duplicates a chance to appear
+        for node_id, events in received.items():
+            assert events == [("node-0", {"partitions": [0, 1]})], (node_id, events)
+
+    def test_custom_events_ordered_per_sender(self, cluster):
+        nodes = cluster(2)
+        assert wait_until(
+            lambda: len(nodes[1].alive_members()) == 2
+        )
+        got = []
+        nodes[1].on_custom_event("seq", lambda s, p: got.append(p))
+        for i in range(5):
+            nodes[0].publish_custom_event("seq", i)
+        assert wait_until(lambda: len(got) == 5, timeout=10), got
+        assert got == sorted(got)

@@ -1,0 +1,12 @@
+from zeebe_tpu.cluster.gossip import Gossip, GossipConfig, Member, MemberStatus
+from zeebe_tpu.cluster.raft import Raft, RaftConfig, RaftState
+
+__all__ = [
+    "Gossip",
+    "GossipConfig",
+    "Member",
+    "MemberStatus",
+    "Raft",
+    "RaftConfig",
+    "RaftState",
+]

@@ -1,0 +1,560 @@
+"""Raft consensus: per-partition log replication.
+
+Reference parity: ``raft/`` — one raft actor per partition replicating the
+partition's log stream (``Raft.java:85``), follower/candidate/leader states
+(``raft/.../state/``), poll-before-vote elections (``RaftPollService`` —
+the pre-vote that avoids term inflation from partitioned nodes), leader
+replication via per-member controllers walking the log and shipping
+``AppendRequest``s (``MemberReplicateLogController.java:46-199``), quorum
+commit = sorted match positions at index ``n - quorum``
+(``LeaderState.java:171-199`` keeps ``positions[n+1-quorum]`` of n+1
+members), persistent term/votedFor/members (``RaftPersistentStorage``),
+and membership change via configuration events on the log
+(``RaftConfigurationEvent``; single-step here instead of joint consensus —
+one config change may be in flight at a time).
+
+Re-design: messages are msgpack maps over the shared TCP transport (no SBE
+schema); log entries travel as the codec's record frames. All state
+mutation is single-writer on the raft actor.
+
+Wire (msgpack maps, all request/response):
+  poll / vote: {t, term, candidate, last_position, last_term}
+               → {granted: bool, term}
+  append:      {t: "append", term, leader, prev_position, prev_term,
+                commit, frames: bytes}
+               → {t: "append-rsp", term, success, match_position}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+import random
+from typing import Callable, Dict, List, Optional
+
+from zeebe_tpu.log.logstream import LogStream
+from zeebe_tpu.protocol import codec, msgpack
+from zeebe_tpu.runtime.actors import Actor, ActorFuture, ActorScheduler
+from zeebe_tpu.transport import ClientTransport, RemoteAddress, ServerTransport
+
+
+class RaftState(enum.Enum):
+    FOLLOWER = "follower"
+    CANDIDATE = "candidate"
+    LEADER = "leader"
+
+
+@dataclasses.dataclass
+class RaftConfig:
+    """Reference: the [raft] section of zeebe.cfg.toml (250ms heartbeat,
+    1s election timeout)."""
+
+    heartbeat_interval_ms: int = 100
+    election_timeout_ms: int = 400
+    election_jitter_ms: int = 400
+    replication_batch_records: int = 128
+
+
+class RaftPersistentStorage:
+    """Durable (term, voted_for, members) — reference RaftPersistentStorage
+    writes a small metadata file per partition."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.members: Dict[str, List] = {}  # member id → [host, port]
+        if path and os.path.exists(path):
+            with open(path) as f:
+                data = json.load(f)
+            self.term = data.get("term", 0)
+            self.voted_for = data.get("voted_for")
+            self.members = data.get("members", {})
+
+    def save(self) -> None:
+        if not self.path:
+            return
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"term": self.term, "voted_for": self.voted_for, "members": self.members},
+                f,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+
+class Raft(Actor):
+    """One node's raft endpoint for one partition."""
+
+    def __init__(
+        self,
+        node_id: str,
+        log: LogStream,
+        scheduler: ActorScheduler,
+        config: Optional[RaftConfig] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        storage_path: Optional[str] = None,
+        rng: Optional[random.Random] = None,
+    ):
+        super().__init__(f"raft-{node_id}")
+        self.node_id = node_id
+        self.log = log
+        self.scheduler = scheduler
+        self.config = config or RaftConfig()
+        self.rng = rng or random.Random(hash(node_id) & 0xFFFFFFFF)
+
+        self.persistent = RaftPersistentStorage(storage_path)
+        self.state = RaftState.FOLLOWER
+        self.leader_id: Optional[str] = None
+        self.votes: set = set()
+        self.polls: set = set()
+        # leader replication state: member id → next position to ship
+        self.next_position: Dict[str, int] = {}
+        self.match_position: Dict[str, int] = {}
+        self._last_heartbeat_ms = 0
+        self._election_deadline_ms = 0
+        self._state_listeners: List[Callable[[RaftState, int], None]] = []
+        self._stopped = False
+
+        self.server = ServerTransport(host=host, port=port, request_handler=self._on_request)
+        self.client = ClientTransport(default_timeout_ms=1000)
+        scheduler.submit_actor(self)
+
+    # -- public API --------------------------------------------------------
+    @property
+    def address(self) -> RemoteAddress:
+        return self.server.address
+
+    @property
+    def term(self) -> int:
+        return self.persistent.term
+
+    def bootstrap(self, members: Dict[str, RemoteAddress]) -> None:
+        """Install the initial static membership (reference: persisted
+        configuration from partition creation). Includes self."""
+
+        def do():
+            self.persistent.members = {
+                mid: [a.host, a.port] for mid, a in members.items()
+            }
+            self.persistent.save()
+            self._reset_election_timer()
+
+        self.actor.run(do)
+
+    def on_state_change(self, listener: Callable[[RaftState, int], None]) -> None:
+        """listener(new_state, term); fires on this node's transitions
+        (reference onStateChange → PartitionInstallService)."""
+        self._state_listeners.append(listener)
+
+    def append(self, records: List) -> ActorFuture:
+        """Leader-only: append records to the replicated log. Completes with
+        the last position once durably appended locally (commit follows
+        quorum replication; observe log.commit_position)."""
+        future = ActorFuture()
+
+        def do():
+            if self.state != RaftState.LEADER:
+                future.complete_exceptionally(RuntimeError("not leader"))
+                return
+            for record in records:
+                record.raft_term = self.persistent.term
+            last = self.log.append(records, commit=False)
+            self.log.flush()  # durable before it can count toward quorum
+            self.match_position[self.node_id] = last
+            self._maybe_commit()
+            self._replicate_all()
+            future.complete(last)
+
+        self.actor.run(do)
+        return future
+
+    def close(self) -> None:
+        self._stopped = True
+        self.server.close()
+        self.client.close()
+
+    # -- lifecycle ---------------------------------------------------------
+    def on_actor_started(self) -> None:
+        self._reset_election_timer()
+        self.actor.run_at_fixed_rate(
+            self.config.heartbeat_interval_ms, self._tick
+        )
+
+    def _members(self) -> Dict[str, RemoteAddress]:
+        return {
+            mid: RemoteAddress(a[0], int(a[1]))
+            for mid, a in self.persistent.members.items()
+        }
+
+    def _quorum(self) -> int:
+        return len(self.persistent.members) // 2 + 1
+
+    def _other_members(self) -> Dict[str, RemoteAddress]:
+        members = self._members()
+        members.pop(self.node_id, None)
+        return members
+
+    def _reset_election_timer(self) -> None:
+        self._election_deadline_ms = (
+            self.scheduler.now_ms()
+            + self.config.election_timeout_ms
+            + self.rng.randrange(self.config.election_jitter_ms + 1)
+        )
+
+    def _become(self, state: RaftState) -> None:
+        if self.state == state:
+            return
+        self.state = state
+        for listener in self._state_listeners:
+            listener(state, self.persistent.term)
+
+    def _tick(self) -> None:
+        if self._stopped or not self.persistent.members:
+            return
+        if self.state == RaftState.LEADER:
+            self._replicate_all()
+            return
+        if self.scheduler.now_ms() >= self._election_deadline_ms:
+            self._start_poll()
+
+    # -- election: poll (pre-vote) then vote -------------------------------
+    def _last_entry(self):
+        pos = self.log.next_position - 1
+        if pos < 0:
+            return -1, -1
+        record = self.log._records[pos]
+        return pos, record.raft_term
+
+    def _start_poll(self) -> None:
+        """Reference RaftPollService: ask peers whether they would grant a
+        vote for term+1 WITHOUT bumping terms; only a poll majority starts a
+        real election."""
+        self._reset_election_timer()
+        others = self._other_members()
+        if not others:
+            # single-node partition: immediate self-election
+            self._start_election()
+            return
+        self.polls = {self.node_id}
+        last_position, last_term = self._last_entry()
+        request = msgpack.pack(
+            {
+                "t": "poll",
+                "term": self.persistent.term + 1,
+                "candidate": self.node_id,
+                "last_position": last_position,
+                "last_term": last_term,
+            }
+        )
+        for mid, addr in others.items():
+            self._ask(addr, request, lambda msg, mid=mid: self._on_poll_response(msg))
+
+    def _on_poll_response(self, msg: dict) -> None:
+        if self.state == RaftState.LEADER or msg is None:
+            return
+        if msg.get("granted"):
+            self.polls.add(msg.get("from", len(self.polls)))
+            if len(self.polls) >= self._quorum():
+                self.polls = set()
+                self._start_election()
+
+    def _start_election(self) -> None:
+        self._become(RaftState.CANDIDATE)
+        self.persistent.term += 1
+        self.persistent.voted_for = self.node_id
+        self.persistent.save()
+        self.leader_id = None
+        self.votes = {self.node_id}
+        self._reset_election_timer()
+        if len(self.persistent.members) <= 1 or self._quorum() == 1:
+            self._become_leader()
+            return
+        last_position, last_term = self._last_entry()
+        request = msgpack.pack(
+            {
+                "t": "vote",
+                "term": self.persistent.term,
+                "candidate": self.node_id,
+                "last_position": last_position,
+                "last_term": last_term,
+            }
+        )
+        for mid, addr in self._other_members().items():
+            self._ask(addr, request, lambda msg, mid=mid: self._on_vote_response(mid, msg))
+
+    def _on_vote_response(self, member_id: str, msg: Optional[dict]) -> None:
+        if msg is None or self.state != RaftState.CANDIDATE:
+            return
+        if msg.get("term", 0) > self.persistent.term:
+            self._step_down(msg["term"])
+            return
+        if msg.get("granted") and msg.get("term") == self.persistent.term:
+            self.votes.add(member_id)
+            if len(self.votes) >= self._quorum():
+                self._become_leader()
+
+    def _become_leader(self) -> None:
+        self.leader_id = self.node_id
+        last, _ = self._last_entry()
+        for mid in self._other_members():
+            self.next_position[mid] = last + 1
+            self.match_position[mid] = -1
+        self.match_position[self.node_id] = last
+        self._become(RaftState.LEADER)
+        # initial event: commit an entry of the new term to establish
+        # leadership over prior-term entries (reference
+        # LeaderCommitInitialEvent; raft §5.4.2 no-op entry)
+        from zeebe_tpu.protocol.enums import RecordType, ValueType
+        from zeebe_tpu.protocol.metadata import RecordMetadata
+        from zeebe_tpu.protocol.records import NoopRecord, Record
+
+        initial = Record(
+            metadata=RecordMetadata(
+                record_type=RecordType.EVENT,
+                value_type=ValueType.NOOP,
+                intent=0,
+            ),
+            value=NoopRecord(),
+        )
+        initial.raft_term = self.persistent.term
+        last = self.log.append([initial], commit=False)
+        self.log.flush()
+        self.match_position[self.node_id] = last
+        self._maybe_commit()
+        self._replicate_all()
+
+    def _step_down(self, term: int) -> None:
+        if term > self.persistent.term:
+            self.persistent.term = term
+            self.persistent.voted_for = None
+            self.persistent.save()
+        if self.state != RaftState.FOLLOWER:
+            self._become(RaftState.FOLLOWER)
+        self._reset_election_timer()
+
+    # -- leader replication -------------------------------------------------
+    def _replicate_all(self) -> None:
+        for mid, addr in self._other_members().items():
+            self._replicate_one(mid, addr)
+
+    def _replicate_one(self, member_id: str, addr: RemoteAddress) -> None:
+        next_pos = self.next_position.get(member_id, 0)
+        prev_pos = next_pos - 1
+        prev_term = -1
+        if 0 <= prev_pos < self.log.next_position:
+            prev_term = self.log._records[prev_pos].raft_term
+        frames = b""
+        count = 0
+        for pos in range(
+            next_pos,
+            min(
+                self.log.next_position,
+                next_pos + self.config.replication_batch_records,
+            ),
+        ):
+            frames += codec.encode_record(self.log._records[pos])
+            count += 1
+        request = msgpack.pack(
+            {
+                "t": "append",
+                "term": self.persistent.term,
+                "leader": self.node_id,
+                "prev_position": prev_pos,
+                "prev_term": prev_term,
+                "commit": self.log.commit_position,
+                "frames": frames,
+            }
+        )
+        self._ask(
+            addr,
+            request,
+            lambda msg, mid=member_id, sent=count, base=next_pos: self._on_append_response(
+                mid, base + sent - 1, msg
+            ),
+        )
+
+    def _on_append_response(
+        self, member_id: str, last_sent: int, msg: Optional[dict]
+    ) -> None:
+        if msg is None or self.state != RaftState.LEADER:
+            return
+        term = msg.get("term", 0)
+        if term > self.persistent.term:
+            self._step_down(term)
+            return
+        if msg.get("success"):
+            match = int(msg.get("match_position", -1))
+            self.match_position[member_id] = max(
+                self.match_position.get(member_id, -1), match
+            )
+            self.next_position[member_id] = self.match_position[member_id] + 1
+            self._maybe_commit()
+        else:
+            # follower diverged: back off (follower tells us its log end to
+            # skip the classic one-at-a-time walk)
+            hint = int(msg.get("log_end", self.next_position.get(member_id, 1)))
+            self.next_position[member_id] = max(
+                0, min(hint, self.next_position.get(member_id, 1) - 1)
+            )
+
+    def _maybe_commit(self) -> None:
+        """Quorum commit (reference LeaderState.commit:171-199): sort match
+        positions of all members, take the quorum-th highest — but never
+        commit entries of a previous term (raft §5.4.2)."""
+        positions = sorted(
+            self.match_position.get(mid, -1) for mid in self.persistent.members
+        )
+        candidate = positions[len(positions) - self._quorum()]
+        if candidate <= self.log.commit_position:
+            return
+        if self.log._records[candidate].raft_term != self.persistent.term:
+            return
+        self.log.set_commit_position(candidate)
+
+    # -- request handling (IO thread → actor hop) ---------------------------
+    def _ask(self, addr: RemoteAddress, payload: bytes, callback) -> None:
+        future = self.client.send_request(addr, payload)
+
+        def on_complete(f: ActorFuture):
+            msg = None
+            if f._exception is None:
+                try:
+                    msg = msgpack.unpack(f._value)
+                except Exception:  # noqa: BLE001
+                    msg = None
+            self.actor.run(lambda: callback(msg))
+
+        future.on_complete(on_complete)
+
+    def _on_request(self, payload: bytes):
+        """IO thread: decode only; handlers run on the raft actor and the
+        response future is completed there (the IO loop never blocks behind
+        a slow append — heartbeats and votes keep flowing)."""
+        try:
+            msg = msgpack.unpack(payload)
+        except Exception:  # noqa: BLE001
+            return None
+        t = msg.get("t")
+        if t == "poll":
+            return self.actor.call(lambda: self._handle_poll(msg))
+        if t == "vote":
+            return self.actor.call(lambda: self._handle_vote(msg))
+        if t == "append":
+            return self.actor.call(lambda: self._handle_append(msg))
+        return None
+
+    def _log_up_to_date(self, msg: dict) -> bool:
+        last_position, last_term = self._last_entry()
+        return (msg.get("last_term", -1), msg.get("last_position", -1)) >= (
+            last_term,
+            last_position,
+        )
+
+    def _handle_poll(self, msg: dict) -> bytes:
+        granted = (
+            msg.get("term", 0) > self.persistent.term
+            and self._log_up_to_date(msg)
+            and self.scheduler.now_ms() >= self._last_heartbeat_ms
+            + self.config.election_timeout_ms
+        )
+        return msgpack.pack(
+            {"granted": granted, "term": self.persistent.term, "from": self.node_id}
+        )
+
+    def _handle_vote(self, msg: dict) -> bytes:
+        term = msg.get("term", 0)
+        if term > self.persistent.term:
+            self._step_down(term)
+        granted = (
+            term == self.persistent.term
+            and self.persistent.voted_for in (None, msg.get("candidate"))
+            and self._log_up_to_date(msg)
+        )
+        if granted:
+            self.persistent.voted_for = msg.get("candidate")
+            self.persistent.save()
+            self._reset_election_timer()
+        return msgpack.pack(
+            {"granted": granted, "term": self.persistent.term, "from": self.node_id}
+        )
+
+    def _handle_append(self, msg: dict) -> bytes:
+        term = msg.get("term", 0)
+        if term < self.persistent.term:
+            return msgpack.pack(
+                {"t": "append-rsp", "term": self.persistent.term, "success": False}
+            )
+        if term > self.persistent.term or self.state != RaftState.FOLLOWER:
+            self._step_down(term)
+        self.leader_id = msg.get("leader")
+        self._last_heartbeat_ms = self.scheduler.now_ms()
+        self._reset_election_timer()
+
+        prev_position = int(msg.get("prev_position", -1))
+        prev_term = int(msg.get("prev_term", -1))
+        if prev_position >= 0:
+            if prev_position >= self.log.next_position:
+                return msgpack.pack(
+                    {
+                        "t": "append-rsp",
+                        "term": self.persistent.term,
+                        "success": False,
+                        "log_end": self.log.next_position,
+                    }
+                )
+            if self.log._records[prev_position].raft_term != prev_term:
+                # conflicting suffix: truncate it (uncommitted by definition)
+                self.log.truncate(prev_position)
+                return msgpack.pack(
+                    {
+                        "t": "append-rsp",
+                        "term": self.persistent.term,
+                        "success": False,
+                        "log_end": self.log.next_position,
+                    }
+                )
+
+        frames = msg.get("frames", b"") or b""
+        offset = 0
+        records = []
+        while offset < len(frames):
+            record, offset = codec.decode_record(frames, offset)
+            records.append(record)
+        appended = False
+        for record in records:
+            if record.position < self.log.next_position:
+                existing = self.log._records[record.position]
+                if existing.raft_term == record.raft_term:
+                    continue  # duplicate delivery
+                self.log.truncate(record.position)
+            if record.position != self.log.next_position:
+                return msgpack.pack(
+                    {
+                        "t": "append-rsp",
+                        "term": self.persistent.term,
+                        "success": False,
+                        "log_end": self.log.next_position,
+                    }
+                )
+            self.log.append_replicated(record)
+            appended = True
+        if appended:
+            self.log.flush()  # durable before acking (commit-is-final)
+
+        commit = int(msg.get("commit", -1))
+        if commit > self.log.commit_position:
+            self.log.set_commit_position(min(commit, self.log.next_position - 1))
+        return msgpack.pack(
+            {
+                "t": "append-rsp",
+                "term": self.persistent.term,
+                "success": True,
+                "match_position": self.log.next_position - 1,
+            }
+        )

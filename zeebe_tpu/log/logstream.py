@@ -31,11 +31,19 @@ class LogStream:
         partition_id: int = 0,
         topic_name: str = "default-topic",
         clock: Optional[Callable[[], int]] = None,
+        recover_commit: bool = True,
     ):
+        """``recover_commit``: in single-writer mode (True) recovery marks
+        the whole recovered log committed. Under raft (False) the commit
+        position is the LEADER's to advance — a restarted follower's
+        unreplicated tail must not be exposed as committed, or a later
+        conflict truncation would rewind the commit position (commit is
+        final)."""
         self.storage = storage
         self.partition_id = partition_id
         self.topic_name = topic_name
         self.clock = clock or (lambda: int(time.time() * 1000))
+        self.recover_commit = recover_commit
 
         self._next_position = 0
         self._commit_position = -1
@@ -72,9 +80,10 @@ class LogStream:
                 last_position = record.position
                 offset = next_offset
         self._next_position = last_position + 1
-        # Recovered records were durably written; commit position resumes at
-        # the log end (single-writer mode; raft replication moves this).
-        self._commit_position = last_position
+        # Single-writer mode: recovered records were durably written, commit
+        # resumes at the log end. Raft mode: stay at -1 until the leader
+        # advances it (see __init__).
+        self._commit_position = last_position if self.recover_commit else -1
 
     # -- write path --------------------------------------------------------
     @property
@@ -107,6 +116,23 @@ class LogStream:
             self.set_commit_position(self._next_position - 1)
         return self._next_position - 1
 
+    def append_replicated(self, record: Record) -> int:
+        """Follower append: the record keeps its leader-assigned position,
+        timestamp and raft term (reference: follower writes the
+        AppendRequest's serialized entries verbatim). The record's position
+        must equal ``next_position``."""
+        if record.position != self._next_position:
+            raise ValueError(
+                f"replicated append at {record.position}, expected {self._next_position}"
+            )
+        frame = codec.encode_record(record)
+        address = self.storage.append(frame)
+        self._records.append(record)
+        if record.position % BLOCK_INDEX_DENSITY == 0:
+            self._block_index.append((record.position, address))
+        self._next_position += 1
+        return record.position
+
     def set_commit_position(self, position: int) -> None:
         if position > self._commit_position:
             self._commit_position = position
@@ -124,7 +150,14 @@ class LogStream:
 
     # -- failure injection (reference StreamProcessorRule.truncateLog) ------
     def truncate(self, position: int) -> None:
-        """Discard records with position >= ``position`` (test harness)."""
+        """Discard records with position >= ``position`` (failure injection;
+        raft follower conflict resolution). In raft mode committed records
+        are final — truncating them is a protocol violation and raises."""
+        if not self.recover_commit and position <= self._commit_position:
+            raise RuntimeError(
+                f"refusing to truncate at {position}: commit position is "
+                f"{self._commit_position} (commit is final)"
+            )
         address = None
         for record, addr in _iter_disk_frames(self, 0):
             if record.position >= position:

@@ -244,7 +244,16 @@ class TimerRecord(RecordValue):
     handler_element_id: str = _f("handlerElementId", "")
 
 
+@dataclasses.dataclass
+class NoopRecord(RecordValue):
+    """Empty value — raft initial/no-op entries (reference
+    LeaderCommitInitialEvent appends a NOOP record on leader election)."""
+
+    VALUE_TYPE: ClassVar[ValueType] = ValueType.NOOP
+
+
 VALUE_CLASS_BY_TYPE = {
+    ValueType.NOOP: NoopRecord,
     ValueType.WORKFLOW_INSTANCE: WorkflowInstanceRecord,
     ValueType.JOB: JobRecord,
     ValueType.INCIDENT: IncidentRecord,

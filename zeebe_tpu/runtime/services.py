@@ -288,6 +288,14 @@ class ServiceContainer(Actor):
             # an in-flight removal owns the stop: park this caller on it
             reg.stop_future.on_complete(lambda _f: done.complete())
             return
+        if not reg.started:
+            # never started: no stop() to run; unblock anyone awaiting install
+            self._registry.pop(name, None)
+            reg.start_future.complete_exceptionally(
+                ValueError(f"service {name!r} removed before start")
+            )
+            done.complete()
+            return
         reg.stopping = True
         reg.stop_future = done
         dependents = self._dependents_of(name)

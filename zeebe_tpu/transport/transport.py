@@ -109,10 +109,12 @@ class _IoLoop:
             pass
 
     def stop(self):
+        if not self._running:
+            return  # idempotent: close() may be called by owner and teardown
         self._running = False
         self.wake()
         self.thread.join(timeout=5)
-        for key in list(self.selector.get_map().values()):
+        for key in list((self.selector.get_map() or {}).values()):
             try:
                 key.fileobj.close()
             except OSError:
@@ -222,10 +224,10 @@ class _IoLoop:
 
 class ServerTransport:
     """Accepts connections; dispatches REQUEST frames to ``request_handler``
-    (payload -> response payload | None) and MESSAGE frames to
-    ``message_handler``. Handlers run on the IO thread — keep them short or
-    hand off to an actor (the reference dispatches into actor mailboxes the
-    same way)."""
+    and MESSAGE frames to ``message_handler``. Handlers run on the IO
+    thread — keep them short, or return an ``ActorFuture`` (async response:
+    the reply is sent when the future completes, without blocking the IO
+    loop — the reference's actor-dispatched request handling)."""
 
     def __init__(
         self,
@@ -271,7 +273,11 @@ class ServerTransport:
 
                     traceback.print_exc()
                     response = None
-                if response is not None:
+                if isinstance(response, ActorFuture):
+                    response.on_complete(
+                        lambda f, c=conn, i=cid: self._send_async_response(c, i, f)
+                    )
+                elif response is not None:
                     self._loop.send(conn, _encode(RESPONSE, cid, response))
             elif ftype == MESSAGE:
                 try:
@@ -280,6 +286,12 @@ class ServerTransport:
                     import traceback
 
                     traceback.print_exc()
+
+    def _send_async_response(self, conn: _Conn, cid: int, future: ActorFuture):
+        if future._exception is not None or future._value is None:
+            return  # no response (caller times out, like a handler returning None)
+        if conn.open:
+            self._loop.send(conn, _encode(RESPONSE, cid, future._value))
 
     def _on_close(self, conn: _Conn):
         self._conns.pop(conn.sock, None)
@@ -307,9 +319,10 @@ class ClientTransport:
         self._loop = _IoLoop("zb-client").start()
         self._conns: Dict[RemoteAddress, _Conn] = {}
         self._by_sock: Dict[socket.socket, Tuple[RemoteAddress, _Conn]] = {}
-        self._pending: Dict[int, Tuple[ActorFuture, float]] = {}
+        self._pending: Dict[int, Tuple[ActorFuture, float, "_Conn"]] = {}
         self._correlation = itertools.count(1)
         self._lock = threading.Lock()
+        self._dialing: Dict[RemoteAddress, threading.Lock] = {}
         self.default_timeout_ms = default_timeout_ms
         self._timeout_thread = threading.Thread(
             target=self._expire_loop, name="zb-client-timeouts", daemon=True
@@ -323,14 +336,21 @@ class ClientTransport:
             conn = self._conns.get(addr)
             if conn is not None and conn.open:
                 return conn
-        sock = socket.create_connection((addr.host, addr.port), timeout=2.0)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        conn = _Conn(sock)
-        with self._lock:
-            self._conns[addr] = conn
-            self._by_sock[sock] = (addr, conn)
-        self._loop.register_conn(conn, self._on_event)
-        return conn
+            dial_lock = self._dialing.setdefault(addr, threading.Lock())
+        # serialize dials per address so concurrent callers share one socket
+        with dial_lock:
+            with self._lock:
+                conn = self._conns.get(addr)
+                if conn is not None and conn.open:
+                    return conn
+            sock = socket.create_connection((addr.host, addr.port), timeout=2.0)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = _Conn(sock)
+            with self._lock:
+                self._conns[addr] = conn
+                self._by_sock[sock] = (addr, conn)
+            self._loop.register_conn(conn, self._on_event)
+            return conn
 
     def _on_event(self, sock, mask):
         entry = self._by_sock.get(sock)
