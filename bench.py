@@ -74,6 +74,13 @@ def stage_creates(meta, wave, num_vars, interns):
 
 
 def main():
+    import os
+    import sys
+
+    def _progress(msg):
+        if os.environ.get("BENCH_PROGRESS"):
+            print(msg, file=sys.stderr, flush=True)
+
     from zeebe_tpu import tpu as _tpu  # noqa: F401  (enables x64)
     import jax
     import jax.numpy as jnp
@@ -82,8 +89,12 @@ def main():
 
     backend = jax.default_backend()
     accel = backend not in ("cpu",)
+    # wave sizing: the drive loop runs entirely on device (lax.while_loop),
+    # so throughput saturates well below huge waves; 2^14 keeps XLA's
+    # compile of the loop program fast (~40s) — larger waves blow up the
+    # TPU backend's compile time on the in-loop compaction scans
     total_instances = 1 << 20 if accel else 1 << 12
-    wave = 1 << 17 if accel else 1 << 10
+    wave = 1 << 14 if accel else 1 << 10
     batch_size = wave
     capacity = 4 * wave
 
@@ -141,18 +152,23 @@ def main():
         )
 
     # warmup wave: compiles the kernel, populates caches
+    _progress("compiling warmup wave...")
     state, queue, warm = run_wave(state, queue)
+    _progress("warmup wave done; compiling rebuild...")
     state = rebuild_jit(state)
+    _progress("rebuild done; timing waves...")
 
     waves = max(total_instances // wave - 1, 1)
     processed = 0
     completed = 0
     t0 = time.perf_counter()
-    for _ in range(waves):
+    for i in range(waves):
         state, queue, totals = run_wave(state, queue)
         processed += totals["processed"]
         completed += totals["completed_roots"]
         state = rebuild_jit(state)
+        if i % 8 == 0:
+            _progress(f"wave {i}/{waves} processed={processed}")
     jax.block_until_ready(state.ei_state)
     elapsed = time.perf_counter() - t0
 

@@ -1,0 +1,293 @@
+"""Clustered-broker integration tests: 3 real brokers in one process.
+
+Reference parity: ``qa/integration-tests/.../clustering/ClusteringRule``
+(3 brokers from configs in temp dirs + a real client over real sockets;
+BrokerLeaderChangeTest kills the leader and the cluster continues;
+DeploymentClusteredTest deploys on one broker and runs instances on
+partitions led by others).
+"""
+
+import time
+
+import pytest
+
+from zeebe_tpu.gateway.cluster_client import ClusterClient
+from zeebe_tpu.models.bpmn.builder import Bpmn
+from zeebe_tpu.runtime.cluster_broker import ClusterBroker
+from zeebe_tpu.runtime.config import BrokerCfg
+
+
+def wait_until(predicate, timeout=20.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def order_process():
+    return (
+        Bpmn.create_process("order-process")
+        .start_event("start")
+        .service_task("collect-money", type="payment-service")
+        .end_event("end")
+        .done()
+    )
+
+
+def make_cfg(node_id, partitions=1):
+    cfg = BrokerCfg()
+    cfg.cluster.node_id = node_id
+    cfg.cluster.partitions = partitions
+    cfg.raft.heartbeat_interval_ms = 30
+    cfg.raft.election_timeout_ms = 200
+    cfg.gossip.probe_interval_ms = 50
+    cfg.gossip.probe_timeout_ms = 250
+    cfg.gossip.sync_interval_ms = 500
+    cfg.metrics.enabled = False
+    return cfg
+
+
+class ClusterUnderTest:
+    """ClusteringRule analogue."""
+
+    def __init__(self, tmp_path, n_brokers=3, partitions=1):
+        self.brokers = {}
+        self.partitions = partitions
+        for i in range(n_brokers):
+            node_id = f"b{i}"
+            self.brokers[node_id] = ClusterBroker(
+                make_cfg(node_id, partitions), str(tmp_path / node_id)
+            )
+        nodes = list(self.brokers.values())
+        for broker in nodes[1:]:
+            broker.join([nodes[0].gossip_address]).join(10)
+        # every partition replicated on every broker (replication factor n)
+        for pid in range(partitions):
+            addrs = {
+                node_id: broker.open_partition(pid).join(10)
+                for node_id, broker in self.brokers.items()
+            }
+            for node_id, broker in self.brokers.items():
+                members = {nid: a for nid, a in addrs.items() if nid != node_id}
+                broker.bootstrap_partition(pid, members)
+
+    def await_leaders(self, timeout=30):
+        def all_led():
+            return all(
+                any(
+                    pid in b.partitions and b.partitions[pid].is_leader
+                    for b in self.brokers.values()
+                )
+                for pid in range(self.partitions)
+            )
+
+        assert wait_until(all_led, timeout), {
+            nid: {pid: p.is_leader for pid, p in b.partitions.items()}
+            for nid, b in self.brokers.items()
+        }
+
+    def leader_of(self, pid):
+        for broker in self.brokers.values():
+            server = broker.partitions.get(pid)
+            if server is not None and server.is_leader:
+                return broker
+        return None
+
+    def client(self):
+        return ClusterClient(
+            [b.client_address for b in self.brokers.values()],
+            num_partitions=self.partitions,
+        )
+
+    def close(self):
+        for broker in self.brokers.values():
+            broker.close()
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    c = ClusterUnderTest(tmp_path, n_brokers=3, partitions=1)
+    yield c
+    c.close()
+
+
+class TestClusterHappyPath:
+    def test_deploy_and_complete_instance_through_the_wire(self, cluster3):
+        cluster3.await_leaders()
+        client = cluster3.client()
+        try:
+            deployed = client.deploy_model(order_process())
+            assert deployed.value.deployed_workflows[0].bpmn_process_id == "order-process"
+
+            done = []
+            worker = client.open_job_worker(
+                "payment-service", lambda pid, rec: done.append(rec.key) or {"paid": True}
+            )
+            created = client.create_instance("order-process", {"orderId": 42})
+            assert created.value.workflow_instance_key > 0
+            assert wait_until(lambda: len(done) == 1, timeout=20), done
+            worker.close()
+        finally:
+            client.close()
+
+    def test_all_brokers_replicate_the_log(self, cluster3):
+        cluster3.await_leaders()
+        client = cluster3.client()
+        try:
+            client.deploy_model(order_process())
+            client.create_instance("order-process", partition_id=0)
+            leader = cluster3.leader_of(0)
+            target = leader.partitions[0].log.next_position
+            assert wait_until(
+                lambda: all(
+                    b.partitions[0].log.next_position >= target
+                    for b in cluster3.brokers.values()
+                ),
+                timeout=20,
+            ), {
+                nid: b.partitions[0].log.next_position
+                for nid, b in cluster3.brokers.items()
+            }
+        finally:
+            client.close()
+
+    def test_topology_request_names_the_leader(self, cluster3):
+        cluster3.await_leaders()
+        client = cluster3.client()
+        try:
+            # topology is gossip-disseminated, i.e. eventually consistent —
+            # poll until the reported leader matches the actual one
+            def topology_converged():
+                leaders = client.refresh_topology()
+                leader_broker = cluster3.leader_of(0)
+                return (
+                    0 in leaders
+                    and leader_broker is not None
+                    and leaders[0].port == leader_broker.client_address.port
+                )
+
+            assert wait_until(topology_converged, timeout=20)
+        finally:
+            client.close()
+
+
+class TestLeaderChange:
+    def test_cluster_survives_leader_kill(self, cluster3, tmp_path):
+        """BrokerLeaderChangeTest: kill the partition leader; a new leader
+        takes over and clients keep working (state rebuilt by replay on the
+        new leader)."""
+        cluster3.await_leaders()
+        client = cluster3.client()
+        try:
+            client.deploy_model(order_process())
+            client.create_instance("order-process")
+
+            old_leader = cluster3.leader_of(0)
+            old_id = old_leader.node_id
+            old_leader.close()
+            del cluster3.brokers[old_id]
+
+            assert wait_until(
+                lambda: cluster3.leader_of(0) is not None, timeout=30
+            ), "no new leader elected"
+
+            # the new leader replayed the log: deployment + first instance
+            new_leader = cluster3.leader_of(0)
+            assert wait_until(
+                lambda: new_leader.repository.latest("order-process") is not None,
+                timeout=10,
+            )
+
+            done = []
+            worker = client.open_job_worker(
+                "payment-service", lambda pid, rec: done.append(rec.key)
+            )
+            client.create_instance("order-process")
+            # both instances' jobs eventually reach the worker (the first
+            # job was CREATED before the failover, rebuilt by replay)
+            assert wait_until(lambda: len(done) >= 2, timeout=20), done
+            worker.close()
+        finally:
+            client.close()
+
+
+class TestWorkerDisconnect:
+    def test_dead_worker_connection_tears_down_subscription(self, cluster3):
+        """A worker whose connection dies (no clean 'remove') must not keep
+        holding credits — the broker removes the subscription on connection
+        close so jobs re-route to live workers."""
+        cluster3.await_leaders()
+
+        def sub_count():
+            # query the CURRENT leader: a re-election installs a fresh engine
+            leader = cluster3.leader_of(0)
+            if leader is None or leader.partitions[0].engine is None:
+                return -1
+            return len(leader.partitions[0].engine.job_subscriptions)
+
+        dead_client = cluster3.client()
+        dead_client.deploy_model(order_process())
+        dead_client.open_job_worker("payment-service", lambda pid, rec: None)
+        assert wait_until(lambda: sub_count() >= 1, timeout=10)
+        # abrupt close: transport goes away without an explicit remove
+        dead_client.close()
+        assert wait_until(lambda: sub_count() == 0, timeout=10)
+
+        # a live worker now receives the work
+        client = cluster3.client()
+        try:
+            done = []
+            worker = client.open_job_worker(
+                "payment-service", lambda pid, rec: done.append(rec.key) or {}
+            )
+            client.create_instance("order-process")
+            assert wait_until(lambda: len(done) == 1, timeout=20), done
+            worker.close()
+        finally:
+            client.close()
+
+
+class TestMultiPartition:
+    def test_cross_partition_message_correlation(self, tmp_path):
+        """Message published on its hash-routed partition correlates to a
+        workflow instance waiting on another partition, over the
+        subscription transport between leader brokers."""
+        cluster = ClusterUnderTest(tmp_path, n_brokers=3, partitions=3)
+        try:
+            cluster.await_leaders()
+            client = cluster.client()
+            try:
+                model = (
+                    Bpmn.create_process("msg-flow")
+                    .start_event()
+                    .message_catch_event(
+                        "wait", message_name="order-paid", correlation_key="$.orderId"
+                    )
+                    .end_event("end")
+                    .done()
+                )
+                client.deploy_model(model)
+                created = client.create_instance(
+                    "msg-flow", {"orderId": "order-9"}, partition_id=1
+                )
+                instance_key = created.value.workflow_instance_key
+                # give the subscription a moment to open on the message partition
+                time.sleep(0.5)
+                client.publish_message("order-paid", "order-9", {"paid": True})
+
+                def instance_completed():
+                    leader = cluster.leader_of(1)
+                    if leader is None or leader.partitions[1].engine is None:
+                        return False
+                    return (
+                        leader.partitions[1].engine.element_instances.get(instance_key)
+                        is None
+                    )
+
+                assert wait_until(instance_completed, timeout=30)
+            finally:
+                client.close()
+        finally:
+            cluster.close()

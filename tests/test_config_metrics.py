@@ -1,0 +1,117 @@
+"""Config system + metrics tests.
+
+Reference parity: ``broker-core`` configuration tests (TOML parse, env
+override, port offset) and ``util`` metrics tests (registry allocate +
+prometheus dump; MetricsFileWriter flush).
+"""
+
+import pytest
+
+from zeebe_tpu.runtime.actors import ControlledActorScheduler
+from zeebe_tpu.runtime.clock import ControlledClock
+from zeebe_tpu.runtime.config import BrokerCfg, load_config
+from zeebe_tpu.runtime.metrics import MetricsFileWriter, MetricsRegistry
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = load_config(env={})
+        assert cfg.network.client_port == 26501
+        assert cfg.cluster.partitions == 1
+        assert cfg.threads.cpu_thread_count == 2
+
+    def test_parse_sections_camel_case(self):
+        cfg = load_config(
+            toml_text="""
+[network]
+host = "10.0.0.5"
+portOffset = 2
+
+[cluster]
+nodeId = "broker-7"
+initialContactPoints = ["10.0.0.1:26502"]
+
+[[topics]]
+name = "orders"
+partitions = 4
+replicationFactor = 3
+""",
+            env={},
+        )
+        assert cfg.network.host == "10.0.0.5"
+        # port offset shifts every binding by offset * 10
+        assert cfg.network.client_port == 26501 + 20
+        assert cfg.network.gateway_port == 26500 + 20
+        assert cfg.cluster.node_id == "broker-7"
+        assert cfg.cluster.initial_contact_points == ["10.0.0.1:26502"]
+        assert len(cfg.topics) == 1
+        assert cfg.topics[0].partitions == 4
+
+    def test_env_overrides_win(self):
+        cfg = load_config(
+            toml_text="[cluster]\nnodeId = 'from-file'\n",
+            env={
+                "ZEEBE_NODE_ID": "from-env",
+                "ZEEBE_PORT_OFFSET": "1",
+                "ZEEBE_CONTACT_POINTS": "a:1, b:2",
+            },
+        )
+        assert cfg.cluster.node_id == "from-env"
+        assert cfg.network.client_port == 26511
+        assert cfg.cluster.initial_contact_points == ["a:1", "b:2"]
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ValueError, match="unknown config key"):
+            load_config(toml_text="[network]\nbogusKnob = 1\n", env={})
+
+    def test_unknown_section_rejected(self):
+        with pytest.raises(ValueError, match="unknown config section"):
+            load_config(toml_text="[nonsense]\nx = 1\n", env={})
+
+    def test_default_config_file_parses(self):
+        import os
+
+        path = os.path.join(os.path.dirname(__file__), "..", "dist", "zeebe.cfg.toml")
+        cfg = load_config(path=path, env={})
+        assert isinstance(cfg, BrokerCfg)
+        assert cfg.data.segment_size_bytes == 64 * 1024 * 1024
+
+
+class TestMetrics:
+    def test_counter_and_dump(self):
+        reg = MetricsRegistry()
+        c = reg.counter("records_processed", "Records processed", partition="0")
+        c.inc()
+        c.inc(2)
+        out = reg.dump(now_ms=123)
+        assert "# HELP zb_records_processed Records processed" in out
+        assert "# TYPE zb_records_processed counter" in out
+        assert 'zb_records_processed{partition="0"} 3 123' in out
+
+    def test_same_name_labels_reuses_metric(self):
+        reg = MetricsRegistry()
+        a = reg.counter("x", partition="0")
+        b = reg.counter("x", partition="0")
+        c = reg.counter("x", partition="1")
+        assert a is b and a is not c
+        a.inc()
+        assert b.value == 1
+
+    def test_gauge_set(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("backlog", "")
+        g.set(17)
+        assert "zb_backlog 17" in reg.dump(now_ms=1)
+
+    def test_file_writer_flushes_atomically(self, tmp_path):
+        clock = ControlledClock()
+        scheduler = ControlledActorScheduler(clock=clock).start()
+        reg = MetricsRegistry()
+        reg.counter("up").inc()
+        path = str(tmp_path / "metrics" / "zeebe.prom")
+        writer = MetricsFileWriter(reg, path, scheduler, flush_period_ms=5000)
+        scheduler.work_until_done()
+        clock.advance(5000)
+        scheduler.work_until_done()
+        with open(path) as f:
+            assert "zb_up 1" in f.read()

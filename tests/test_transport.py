@@ -163,3 +163,94 @@ class TestRobustness:
         with pytest.raises(TransportError):
             future.join(10)
         assert time.monotonic() - t0 < 5  # failed fast, not via the 30s timeout
+
+
+class TestCloseListeners:
+    def test_listener_fires_when_client_disconnects(self, client):
+        """Server-side close listeners are the teardown hook for per-
+        connection state (job subscriptions); they must fire when the peer
+        goes away."""
+        handles = []
+        fired = threading.Event()
+
+        def handler(payload, conn):
+            handles.append(conn)
+            conn.on_close(fired.set)
+            return b"ok"
+
+        server = ServerTransport(request_handler=handler)
+        try:
+            addr = server.address
+            assert client.send_request(addr, b"hi").join(5) == b"ok"
+            client.close()
+            assert fired.wait(5), "close listener did not fire on disconnect"
+            assert not handles[0].open
+        finally:
+            server.close()
+
+    def test_listener_fires_on_server_shutdown(self):
+        """Shutting the server down must also run close listeners and flip
+        handles to closed — retained handles must not silently buffer."""
+        c = ClientTransport(default_timeout_ms=2000)
+        handles = []
+        fired = threading.Event()
+
+        def handler(payload, conn):
+            handles.append(conn)
+            conn.on_close(fired.set)
+            return b"ok"
+
+        server = ServerTransport(request_handler=handler)
+        try:
+            assert c.send_request(server.address, b"hi").join(5) == b"ok"
+        finally:
+            server.close()
+        assert fired.wait(5), "close listener did not fire on server close"
+        assert not handles[0].open
+        assert handles[0].push(b"data") is False
+        c.close()
+
+    def test_listener_registered_after_close_fires_immediately(self, client):
+        done = threading.Event()
+        captured = []
+
+        def handler(payload, conn):
+            captured.append(conn)
+            return b"ok"
+
+        server = ServerTransport(request_handler=handler)
+        try:
+            assert client.send_request(server.address, b"hi").join(5) == b"ok"
+        finally:
+            server.close()
+        captured[0].on_close(done.set)
+        assert done.wait(1)
+
+    def test_keyword_only_handler_gets_no_conn(self):
+        """Arity detection must count only positional parameters: a handler
+        with keyword-only extras is a one-arg handler."""
+        c = ClientTransport(default_timeout_ms=2000)
+        server = ServerTransport(
+            request_handler=lambda payload, *, log=None: b"kw:" + payload
+        )
+        try:
+            assert c.send_request(server.address, b"x").join(5) == b"kw:x"
+        finally:
+            server.close()
+            c.close()
+
+    def test_varargs_handler_gets_conn(self):
+        c = ClientTransport(default_timeout_ms=2000)
+        seen = []
+
+        def handler(*args):
+            seen.append(len(args))
+            return b"ok"
+
+        server = ServerTransport(request_handler=handler)
+        try:
+            assert c.send_request(server.address, b"x").join(5) == b"ok"
+            assert seen == [2]
+        finally:
+            server.close()
+            c.close()

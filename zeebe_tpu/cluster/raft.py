@@ -340,6 +340,8 @@ class Raft(Actor):
 
     # -- leader replication -------------------------------------------------
     def _replicate_all(self) -> None:
+        if self._stopped:
+            return
         for mid, addr in self._other_members().items():
             self._replicate_one(mid, addr)
 

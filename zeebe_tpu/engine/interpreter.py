@@ -465,7 +465,10 @@ class PartitionEngine:
                 errors = validate_model(model)
                 if errors:
                     raise ValueError("; ".join(str(e) for e in errors))
-                deployed.extend(transform_model(model))
+                for wf in transform_model(model):
+                    wf.source_resource = data
+                    wf.source_type = resource.resource_type
+                    deployed.append(wf)
         except Exception as e:  # malformed resource → rejection
             out.written.append(
                 _record(
@@ -1218,7 +1221,10 @@ class PartitionEngine:
         Reference: ActivateJobStreamProcessor is installed on first
         subscription and reads the log from the start, so pre-existing
         CREATED (or failed-with-retries / timed-out) jobs get assigned too.
-        The returned commands must be appended to the partition log."""
+        The returned commands must be appended to the partition log.
+        Idempotent per subscriber key: a re-subscribe (client recovering
+        from a leader change) replaces the previous registration."""
+        self.remove_job_subscription(subscription.subscriber_key)
         self.job_subscriptions.append(subscription)
         backlog = []
         activatable = (

@@ -1,0 +1,394 @@
+"""Cluster client: topology-aware command routing over the client API.
+
+Reference parity: ``gateway/`` client impl — commands serialized to the
+wire, routed to the current partition leader with NOT_LEADER retry +
+topology refresh (``ClientTopologyManager`` + request retries), round-robin
+partition selection for instance creation, and job workers receiving
+push records down their own connection (``JobSubscriber`` with credits).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from zeebe_tpu.gateway.client import ClientException
+from zeebe_tpu.models.bpmn.model import BpmnModel
+from zeebe_tpu.models.bpmn.xml import write_model
+from zeebe_tpu.protocol import codec, msgpack
+from zeebe_tpu.protocol.enums import RecordType
+from zeebe_tpu.protocol.intents import (
+    DeploymentIntent,
+    JobIntent,
+    MessageIntent,
+    WorkflowInstanceIntent,
+)
+from zeebe_tpu.protocol.metadata import RecordMetadata
+from zeebe_tpu.protocol.records import (
+    DeploymentRecord,
+    DeploymentResource,
+    JobRecord,
+    MessageRecord,
+    Record,
+    WorkflowInstanceRecord,
+)
+from zeebe_tpu.transport import ClientTransport, RemoteAddress, TransportError
+
+_subscriber_keys = itertools.count(1_000)
+
+
+class ClusterClient:
+    """Client bound to a cluster via one or more bootstrap broker client
+    addresses."""
+
+    def __init__(
+        self,
+        contact_points: List[RemoteAddress],
+        request_timeout_ms: int = 10_000,
+        num_partitions: int = 1,
+    ):
+        self.contact_points = list(contact_points)
+        self.request_timeout_ms = request_timeout_ms
+        self.num_partitions = num_partitions
+        self.transport = ClientTransport(
+            default_timeout_ms=request_timeout_ms,
+            message_handler=self._on_push,
+        )
+        # partition id → leader client address
+        self._leaders: Dict[int, RemoteAddress] = {}
+        self._rr = itertools.count()
+        self._push_handlers: Dict[int, Callable[[int, Record], None]] = {}
+        self._lock = threading.Lock()
+        # pushed records are dispatched off the transport IO thread: worker
+        # handlers issue blocking requests (complete/fail) whose responses
+        # arrive on that same IO loop (reference: JobSubscriber poll loop
+        # runs on its own executor)
+        import queue
+
+        self._push_queue: "queue.Queue" = queue.Queue()
+        self._push_thread = threading.Thread(
+            target=self._push_dispatch_loop, name="zb-client-push", daemon=True
+        )
+        self._closing = False
+        self._push_thread.start()
+
+    # -- topology ----------------------------------------------------------
+    def refresh_topology(self) -> Dict[int, RemoteAddress]:
+        request = msgpack.pack({"t": "topology"})
+        for addr in list(self._leaders.values()) + self.contact_points:
+            try:
+                payload = self.transport.send_request(addr, request, timeout_ms=2000).join(5)
+                msg = msgpack.unpack(payload)
+            except (TransportError, ValueError, TimeoutError):
+                continue
+            leaders = {}
+            for pid, entry in msg.get("leaders", {}).items():
+                a = entry.get("addr", ["", 0])
+                leaders[int(pid)] = RemoteAddress(a[0], int(a[1]))
+            if leaders:
+                with self._lock:
+                    self._leaders = leaders
+                return leaders
+        return {}
+
+    def _leader_for(self, partition: int) -> Optional[RemoteAddress]:
+        with self._lock:
+            addr = self._leaders.get(partition)
+        if addr is None:
+            self.refresh_topology()
+            with self._lock:
+                addr = self._leaders.get(partition)
+        return addr
+
+    def next_partition(self) -> int:
+        return next(self._rr) % self.num_partitions
+
+    # -- command plumbing --------------------------------------------------
+    def send_command(
+        self, partition: int, value, intent: int, key: int = -1
+    ) -> Record:
+        record = Record(
+            key=key,
+            metadata=RecordMetadata(
+                record_type=RecordType.COMMAND,
+                value_type=value.VALUE_TYPE,
+                intent=int(intent),
+            ),
+            value=value,
+        )
+        request = msgpack.pack(
+            {
+                "t": "command",
+                "partition": partition,
+                "frame": codec.encode_record(record),
+            }
+        )
+        deadline = time.monotonic() + self.request_timeout_ms / 1000.0
+        last_error = "no leader known"
+        while time.monotonic() < deadline:
+            addr = self._leader_for(partition)
+            if addr is None:
+                time.sleep(0.05)
+                continue
+            try:
+                payload = self.transport.send_request(
+                    addr, request, timeout_ms=self.request_timeout_ms
+                ).join(self.request_timeout_ms / 1000.0 + 1)
+                msg = msgpack.unpack(payload)
+            except (TransportError, ValueError, TimeoutError) as e:
+                last_error = str(e)
+                with self._lock:
+                    self._leaders.pop(partition, None)
+                time.sleep(0.05)
+                continue
+            if msg.get("t") == "command-rsp":
+                response, _ = codec.decode_record(bytes(msg["frame"]))
+                if response.metadata.record_type == RecordType.COMMAND_REJECTION:
+                    raise ClientException(
+                        response.metadata.rejection_type,
+                        response.metadata.rejection_reason,
+                    )
+                return response
+            if msg.get("t") == "error" and msg.get("code") == "NOT_LEADER":
+                last_error = "NOT_LEADER"
+                with self._lock:
+                    self._leaders.pop(partition, None)
+                time.sleep(0.05)
+                continue
+            last_error = str(msg)
+            time.sleep(0.05)
+        raise TransportError(f"command failed: {last_error}")
+
+    # -- commands (reference WorkflowClient / JobClient / TopicClient) -----
+    def deploy_model(self, model: BpmnModel, resource_name: str = "process.bpmn") -> Record:
+        deployment = DeploymentRecord(
+            resources=[
+                DeploymentResource(resource=write_model(model), resource_name=resource_name)
+            ]
+        )
+        return self.send_command(0, deployment, DeploymentIntent.CREATE)
+
+    def create_instance(
+        self,
+        bpmn_process_id: str,
+        payload: Optional[Dict[str, Any]] = None,
+        partition_id: Optional[int] = None,
+    ) -> Record:
+        value = WorkflowInstanceRecord(
+            bpmn_process_id=bpmn_process_id, payload=dict(payload or {})
+        )
+        pid = partition_id if partition_id is not None else self.next_partition()
+        return self.send_command(pid, value, WorkflowInstanceIntent.CREATE)
+
+    def cancel_instance(self, partition_id: int, workflow_instance_key: int) -> Record:
+        value = WorkflowInstanceRecord(workflow_instance_key=workflow_instance_key)
+        return self.send_command(
+            partition_id, value, WorkflowInstanceIntent.CANCEL, key=workflow_instance_key
+        )
+
+    def publish_message(
+        self,
+        name: str,
+        correlation_key: str,
+        payload: Optional[Dict[str, Any]] = None,
+        time_to_live_ms: int = 0,
+    ) -> Record:
+        value = MessageRecord(
+            name=name,
+            correlation_key=correlation_key,
+            time_to_live=time_to_live_ms,
+            payload=dict(payload or {}),
+        )
+        # hash-routed to the message partition (engine routing contract)
+        partition = _correlation_hash(correlation_key) % self.num_partitions
+        return self.send_command(partition, value, MessageIntent.PUBLISH)
+
+    def complete_job(self, partition_id: int, job_key: int, payload: Optional[dict] = None) -> Record:
+        value = JobRecord(payload=dict(payload or {}))
+        return self.send_command(partition_id, value, JobIntent.COMPLETE, key=job_key)
+
+    def fail_job(self, partition_id: int, job_key: int, retries: int) -> Record:
+        value = JobRecord(retries=retries)
+        return self.send_command(partition_id, value, JobIntent.FAIL, key=job_key)
+
+    # -- job workers over the wire -----------------------------------------
+    def _on_push(self, payload: bytes) -> None:
+        # transport IO thread: decode + enqueue only
+        try:
+            msg = msgpack.unpack(payload)
+        except ValueError:
+            return
+        if msg.get("t") != "pushed-record":
+            return
+        self._push_queue.put(msg)
+
+    def _push_dispatch_loop(self) -> None:
+        import queue
+
+        while not self._closing:
+            try:
+                msg = self._push_queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            handler = self._push_handlers.get(int(msg.get("subscriber_key", -1)))
+            if handler is None:
+                continue
+            try:
+                record, _ = codec.decode_record(bytes(msg["frame"]))
+                handler(int(msg.get("partition", 0)), record)
+            except Exception:  # noqa: BLE001
+                import traceback
+
+                traceback.print_exc()
+
+    def open_job_worker(
+        self,
+        job_type: str,
+        handler: Callable[[int, Record], Optional[dict]],
+        worker_name: str = "remote-worker",
+        credits: int = 32,
+        timeout_ms: int = 300_000,
+        partitions: Optional[List[int]] = None,
+    ) -> "RemoteJobWorker":
+        return RemoteJobWorker(
+            self, job_type, handler, worker_name, credits, timeout_ms,
+            partitions if partitions is not None else list(range(self.num_partitions)),
+        )
+
+    def close(self) -> None:
+        self._closing = True
+        self._push_thread.join(timeout=2)
+        self.transport.close()
+
+
+class RemoteJobWorker:
+    """Wire-level worker: subscribes on each partition leader, handles
+    pushes, completes jobs, replenishes credits (reference JobSubscriber)."""
+
+    def __init__(self, client, job_type, handler, worker_name, credits, timeout_ms, partitions):
+        self.client = client
+        self.job_type = job_type
+        self.handler = handler
+        self.worker_name = worker_name
+        self.credits = credits
+        self.timeout_ms = timeout_ms
+        self.subscriber_key = next(_subscriber_keys)
+        self.partitions = partitions
+        self.handled: List[Record] = []
+        self._subscribed_addr: Dict[int, RemoteAddress] = {}
+        self._closed = False
+        client._push_handlers[self.subscriber_key] = self._on_record
+        for pid in partitions:
+            self._subscribe(pid, worker_name, credits, timeout_ms)
+        # reference: the client's subscription manager reopens subscriptions
+        # when a partition's leader changes (topology listener); without
+        # this a failover strands the worker on the old leader
+        self._monitor = threading.Thread(
+            target=self._monitor_leaders, name="zb-worker-monitor", daemon=True
+        )
+        self._monitor.start()
+
+    def _monitor_leaders(self) -> None:
+        while not self._closed and not self.client._closing:
+            time.sleep(0.25)
+            try:
+                leaders = self.client.refresh_topology()
+            except Exception:  # noqa: BLE001 - keep probing through outages
+                continue
+            for pid in self.partitions:
+                addr = leaders.get(pid)
+                if addr is None or self._closed:
+                    continue
+                if self._subscribed_addr.get(pid) != addr:
+                    try:
+                        self._subscribe(
+                            pid, self.worker_name, self.credits, self.timeout_ms
+                        )
+                    except TransportError:
+                        pass  # retried next tick
+
+    def _subscribe(self, partition: int, worker_name: str, credits: int, timeout_ms: int) -> None:
+        request = msgpack.pack(
+            {
+                "t": "job-subscription",
+                "action": "add",
+                "partition": partition,
+                "subscriber_key": self.subscriber_key,
+                "job_type": self.job_type,
+                "worker": worker_name,
+                "credits": credits,
+                "timeout": timeout_ms,
+            }
+        )
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            addr = self.client._leader_for(partition)
+            if addr is None:
+                time.sleep(0.05)
+                continue
+            try:
+                payload = self.client.transport.send_request(addr, request, timeout_ms=2000).join(5)
+                if msgpack.unpack(payload).get("t") == "ok":
+                    self._subscribed_addr[partition] = addr
+                    return
+            except (TransportError, ValueError, TimeoutError):
+                pass
+            with self.client._lock:
+                self.client._leaders.pop(partition, None)
+            time.sleep(0.05)
+        raise TransportError(f"could not subscribe on partition {partition}")
+
+    def _on_record(self, partition: int, record: Record) -> None:
+        self.handled.append(record)
+        try:
+            result = self.handler(partition, record)
+        except Exception:  # noqa: BLE001 - worker handler errors fail the job
+            self.client.fail_job(partition, record.key, record.value.retries - 1)
+            return
+        self.client.complete_job(
+            partition, record.key, result if isinstance(result, dict) else None
+        )
+        # replenish the consumed credit
+        addr = self.client._leader_for(partition)
+        if addr is not None:
+            self.client.transport.send_request(
+                addr,
+                msgpack.pack(
+                    {
+                        "t": "job-subscription",
+                        "action": "credits",
+                        "partition": partition,
+                        "subscriber_key": self.subscriber_key,
+                        "credits": 1,
+                    }
+                ),
+                timeout_ms=2000,
+            )
+
+    def close(self) -> None:
+        self._closed = True
+        self.client._push_handlers.pop(self.subscriber_key, None)
+        for pid, addr in list(self._subscribed_addr.items()):
+            try:
+                self.client.transport.send_request(
+                    addr,
+                    msgpack.pack(
+                        {
+                            "t": "job-subscription",
+                            "action": "remove",
+                            "partition": pid,
+                            "subscriber_key": self.subscriber_key,
+                        }
+                    ),
+                    timeout_ms=1000,
+                )
+            except TransportError:
+                pass
+
+
+def _correlation_hash(key: str) -> int:
+    from zeebe_tpu.engine.interpreter import _correlation_hash as impl
+
+    return impl(key)

@@ -78,6 +78,11 @@ class ExecutableWorkflow:
     by_id: Dict[str, ExecutableFlowElement] = dataclasses.field(default_factory=dict)
     version: int = -1
     key: int = -1
+    # deployed source, retained so the system partition can serve
+    # fetch-workflow requests (reference WorkflowRepositoryIndex keeps the
+    # resource for FetchWorkflowRequest responses)
+    source_resource: bytes = b""
+    source_type: str = "BPMN_XML"
 
     def add(self, element: ExecutableFlowElement) -> None:
         self.elements.append(element)

@@ -305,3 +305,13 @@ class Record:
             metadata=self.metadata.copy(),
             value=self.value.copy() if self.value is not None else None,
         )
+
+
+def stamp_source_positions(records: List["Record"], source_position: int) -> None:
+    """Fill in the source position on follow-up records that don't carry one.
+    Recovery's replay boundary is ``max(source_record_position)`` over the
+    log (reference lastSourceEventPosition) — every written follow-up must
+    link back to the record whose processing produced it."""
+    for record in records:
+        if record.source_record_position < 0:
+            record.source_record_position = source_position

@@ -23,7 +23,7 @@ from zeebe_tpu.engine.interpreter import PartitionEngine, WorkflowRepository
 from zeebe_tpu.log import LogStream, SegmentedLogStorage
 from zeebe_tpu.log.snapshot import SnapshotController, SnapshotMetadata, SnapshotStorage
 from zeebe_tpu.protocol.enums import RecordType, ValueType
-from zeebe_tpu.protocol.records import Record
+from zeebe_tpu.protocol.records import Record, stamp_source_positions
 from zeebe_tpu.runtime.clock import SystemClock
 
 
@@ -88,7 +88,7 @@ class Broker:
 
     # -- recovery: snapshot + replay (reference StreamProcessorController
     # recovery :156-211 then reprocessing :213-279) -------------------------
-    def _recover_partitions(self) -> None:
+    def _recover_partitions(self) -> None:  # noqa: D401
         """Restore each partition's newest valid snapshot, then replay the
         committed records after it to rebuild state — without re-executing
         side effects (no appends, responses, sends, or pushes).
@@ -96,21 +96,34 @@ class Broker:
         Partitions replay in id order: deployments commit on their partition
         before instance commands causally follow on others (the reference's
         system-partition-first ordering)."""
+        boundaries = {}
         for partition in self.partitions:
             state, meta = partition.snapshots.recover(partition.log.next_position - 1)
             if state is not None:
                 partition.engine.restore_state(state)
                 partition.next_read_position = meta.last_processed_position + 1
-            # rebuild the position→record cache for the whole log (reference
-            # TypedStreamReader reads by position during incident resolution)
+            # single pass over the log: rebuild the position→record cache
+            # (reference TypedStreamReader reads by position during incident
+            # resolution) and find the replay boundary
+            last_source = -1
             for record in partition.log.reader(0):
                 partition.engine.records_by_position[record.position] = record
+                last_source = max(last_source, record.source_record_position)
+            boundaries[partition.partition_id] = last_source
         for partition in self.partitions:
-            self._replay(partition)
+            self._replay(partition, boundaries[partition.partition_id])
 
-    def _replay(self, partition: Partition) -> None:
+    def _replay(self, partition: Partition, last_source: int) -> None:
+        # Reprocess only up to the last source event position — the highest
+        # position whose follow-ups are already in the log. Records after it
+        # were appended but never processed (crash between append and
+        # process); they are processed normally, WITH side effects, by the
+        # regular loop (reference StreamProcessorController:189-279:
+        # lastSourceEventPosition bounds reprocessing).
         reader = partition.log.reader(partition.next_read_position)
         for record in reader.read_committed():
+            if record.position > last_source:
+                break
             partition.engine.process(record)  # state updates only
             partition.next_read_position = record.position + 1
 
@@ -201,16 +214,23 @@ class Broker:
     def _process_one(self, partition: Partition, record: Record) -> None:
         result = partition.engine.process(record)
         partition.next_read_position = record.position + 1
+        for target_pid, send in result.sends:
+            # reference: subscription transport → command on the target log.
+            # Sends go BEFORE the local follow-up append: once the follow-ups
+            # are durable this record is inside the replay boundary and its
+            # side effects never re-run, so a crash in between must lose the
+            # (reprocessable) follow-ups, not the send. Duplicate sends after
+            # a crash are fine — subscription open/correlate are idempotent
+            # (dead activity ⇒ rejection; CLOSE removes all matches).
+            self.partitions[target_pid].log.append([send])
         if result.written:
+            stamp_source_positions(result.written, record.position)
             partition.log.append(result.written)
             for written in result.written:
                 partition.engine.records_by_position[written.position] = written
         for response in result.responses:
             if response.metadata.request_id >= 0:
                 self._responses[response.metadata.request_id] = response
-        for target_pid, send in result.sends:
-            # reference: subscription transport → command on the target log
-            self.partitions[target_pid].log.append([send])
         for subscriber_key, push in result.pushes:
             listener = self._push_listeners.get(subscriber_key)
             if listener is not None:

@@ -1,0 +1,779 @@
+"""Clustered broker: gossip topology + raft partitions + leader processing.
+
+Reference parity (broker-core clustering base + orchestration):
+- ``ClusterComponent``: gossip service + join, topology manager aggregating
+  partition/leader info from gossip custom events
+  (``TopologyManagerImpl``, ``GossipCustomEventEncoding``).
+- ``PartitionInstallService``: per partition, install log + raft; when this
+  node becomes raft leader, install the leader partition services (stream
+  processor + client command handling); on follower, just replicate
+  (``PartitionInstallService.onStateChange:213-264``).
+- ``BootstrapExpectNodes`` / ``BootstrapSystemTopic`` /
+  ``BootstrapDefaultTopicsService``: await the configured node count, then
+  create the system partition (0) and configured topics.
+- Topic orchestration: partition creation requests sent to selected nodes
+  over the management API (``TopicCreationService``, ``NodeSelector`` by
+  load, ``CreatePartitionRequest`` → ``ManagementApiRequestHandler``).
+- Client API: commands appended to the leader partition's log with request
+  metadata; responses sent after processing (``ClientApiMessageHandler``).
+- Cross-partition subscription commands routed to the target partition's
+  leader over the subscription transport
+  (``SubscriptionApiCommandMessageHandler``).
+
+Processing model: the raft leader runs the engine. On leadership it
+recovers (snapshot + replay with suppressed side effects, exactly like the
+single-node broker), then processes newly committed records, appending
+follow-ups through raft. Wire messages are msgpack maps; records travel as
+codec frames.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from zeebe_tpu.cluster.gossip import Gossip, GossipConfig
+from zeebe_tpu.cluster.raft import Raft, RaftConfig, RaftState
+from zeebe_tpu.engine.interpreter import JobSubscription, PartitionEngine, WorkflowRepository
+from zeebe_tpu.log import LogStream, SegmentedLogStorage
+from zeebe_tpu.log.snapshot import SnapshotController, SnapshotMetadata, SnapshotStorage
+from zeebe_tpu.protocol import codec, msgpack
+from zeebe_tpu.protocol.records import Record, stamp_source_positions
+from zeebe_tpu.runtime.actors import Actor, ActorFuture, ActorScheduler
+from zeebe_tpu.runtime.clock import SystemClock
+from zeebe_tpu.runtime.config import BrokerCfg
+from zeebe_tpu.runtime.metrics import MetricsFileWriter, MetricsRegistry
+from zeebe_tpu.transport import ClientTransport, RemoteAddress, ServerTransport
+
+
+class Topology:
+    """Queryable cluster view (reference ``Topology`` aggregated by the
+    topology manager from gossip custom events)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # partition id → (leader node id, client addr [h,p], sub addr [h,p], term)
+        self.partition_leaders: Dict[int, Tuple[str, list, list, int]] = {}
+        # node id → management address
+        self.members: Dict[str, list] = {}
+
+    def update_leader(
+        self, partition: int, node_id: str, addr: list, sub_addr: list, term: int
+    ) -> None:
+        with self._lock:
+            current = self.partition_leaders.get(partition)
+            if current is None or term >= current[3]:
+                self.partition_leaders[partition] = (node_id, addr, sub_addr, term)
+
+    def leader_address(self, partition: int) -> Optional[RemoteAddress]:
+        with self._lock:
+            entry = self.partition_leaders.get(partition)
+        if entry is None:
+            return None
+        return RemoteAddress(entry[1][0], int(entry[1][1]))
+
+    def leader_subscription_address(self, partition: int) -> Optional[RemoteAddress]:
+        with self._lock:
+            entry = self.partition_leaders.get(partition)
+        if entry is None:
+            return None
+        return RemoteAddress(entry[2][0], int(entry[2][1]))
+
+    def leader_node(self, partition: int) -> Optional[str]:
+        with self._lock:
+            entry = self.partition_leaders.get(partition)
+        return entry[0] if entry else None
+
+    def partitions(self) -> List[int]:
+        with self._lock:
+            return sorted(self.partition_leaders)
+
+
+class PartitionServer:
+    """One partition on one broker: log + raft + (on leadership) engine."""
+
+    def __init__(self, broker: "ClusterBroker", partition_id: int):
+        self.broker = broker
+        self.partition_id = partition_id
+        pdir = os.path.join(broker.data_dir, f"partition-{partition_id}")
+        self.storage = SegmentedLogStorage(
+            pdir, segment_size=broker.cfg.data.segment_size_bytes
+        )
+        self.log = LogStream(
+            self.storage,
+            partition_id=partition_id,
+            clock=broker.clock,
+            recover_commit=False,
+        )
+        self.snapshots = SnapshotController(
+            SnapshotStorage(os.path.join(pdir, "snapshots"))
+        )
+        self.raft = Raft(
+            broker.node_id,
+            self.log,
+            broker.scheduler,
+            config=RaftConfig(
+                heartbeat_interval_ms=broker.cfg.raft.heartbeat_interval_ms,
+                election_timeout_ms=broker.cfg.raft.election_timeout_ms,
+                election_jitter_ms=broker.cfg.raft.election_timeout_ms,
+            ),
+            host=broker.cfg.network.host,
+            storage_path=os.path.join(pdir, "raft.meta"),
+        )
+        self.engine: Optional[PartitionEngine] = None
+        self.next_read_position = 0
+        self.is_leader = False
+        self._processing_scheduled = False
+        self._fetch_attempted = False  # one fetch try per parked record
+        self.raft.on_state_change(self._on_raft_state_change)
+        self.log.on_commit(lambda _pos: self._schedule_processing())
+
+    # -- leadership transitions (reference PartitionInstallService) --------
+    def _on_raft_state_change(self, state: RaftState, term: int) -> None:
+        if state == RaftState.LEADER:
+            self.broker.actor_control.run(lambda: self._install_leader(term))
+        elif self.is_leader:
+            self.broker.actor_control.run(self._uninstall_leader)
+
+    def _install_leader(self, term: int) -> None:
+        self.engine = PartitionEngine(
+            partition_id=self.partition_id,
+            num_partitions=self.broker.cfg.cluster.partitions,
+            repository=self.broker.repository,
+            clock=self.broker.clock,
+        )
+        # recovery: snapshot + replay of the committed log, side effects
+        # suppressed (same contract as the single-node broker)
+        state, meta = self.snapshots.recover(self.log.next_position - 1)
+        self.next_read_position = 0
+        if state is not None:
+            self.engine.restore_state(state)
+            self.next_read_position = meta.last_processed_position + 1
+        last_source = -1
+        for record in self.log.reader(0):
+            self.engine.records_by_position[record.position] = record
+            last_source = max(last_source, record.source_record_position)
+        # replay bounded by the last source event position: tail records
+        # (appended by the old leader but never processed) are handled by
+        # the normal loop below, with side effects — else their follow-ups
+        # are lost and the instances wedge (reference
+        # StreamProcessorController:189-279 lastSourceEventPosition)
+        reader = self.log.reader(self.next_read_position)
+        for record in reader.read_committed():
+            if record.position > last_source:
+                break
+            self.engine.process(record)
+            self.next_read_position = record.position + 1
+        self.is_leader = True
+        self.broker.on_partition_leader(self.partition_id, term)
+        self._schedule_processing()
+
+    def _uninstall_leader(self) -> None:
+        self.is_leader = False
+        self.engine = None
+
+    # -- the processing loop (StreamProcessorController hot loop) ----------
+    def _schedule_processing(self) -> None:
+        if not self.is_leader or self._processing_scheduled:
+            return
+        self._processing_scheduled = True
+        self.broker.actor_control.run(self._process_committed)
+
+    def _process_committed(self) -> None:
+        self._processing_scheduled = False
+        if not self.is_leader or self.engine is None:
+            return
+        reader = self.log.reader(self.next_read_position)
+        for record in reader.read_committed():
+            if self._needs_workflow_fetch(record):
+                # park processing; resume once the workflow arrives from the
+                # system partition (reference WorkflowCache async fetch —
+                # EventLifecycleContext.async restructured as pause/resume)
+                self.broker.fetch_workflow(
+                    record.value.bpmn_process_id,
+                    record.value.workflow_key,
+                    on_done=self._schedule_processing_after_fetch,
+                )
+                return
+            result = self.engine.process(record)
+            self.next_read_position = record.position + 1
+            self._fetch_attempted = False
+            if result.written:
+                stamp_source_positions(result.written, record.position)
+                # positions are assigned on the raft actor at append time;
+                # the records register into records_by_position when the
+                # processing loop reads them back as committed
+                self.raft.append(result.written)
+            for response in result.responses:
+                self.broker.send_client_response(response)
+            for target_pid, send in result.sends:
+                self.broker.send_subscription_command(target_pid, send)
+            for subscriber_key, push in result.pushes:
+                self.broker.push_to_subscriber(subscriber_key, self.partition_id, push)
+            self.broker.metrics_events_processed.inc()
+
+    def _needs_workflow_fetch(self, record) -> bool:
+        from zeebe_tpu.protocol.enums import RecordType, ValueType
+        from zeebe_tpu.protocol.intents import WorkflowInstanceIntent as WI
+
+        if self.partition_id == 0 or self._fetch_attempted:
+            return False
+        md = record.metadata
+        if (
+            md.value_type != ValueType.WORKFLOW_INSTANCE
+            or md.record_type != RecordType.COMMAND
+            or md.intent != int(WI.CREATE)
+        ):
+            return False
+        repo = self.broker.repository
+        value = record.value
+        if value.workflow_key >= 0 and value.workflow_key in repo.by_key:
+            return False
+        if value.bpmn_process_id and repo.latest(value.bpmn_process_id) is not None:
+            return False
+        return True
+
+    def _schedule_processing_after_fetch(self) -> None:
+        # one attempt per parked record: if the fetch produced nothing the
+        # engine now processes the command and rejects it (workflow not
+        # found), instead of fetch-looping forever
+        self._fetch_attempted = True
+        self._schedule_processing()
+
+    def snapshot(self) -> None:
+        if not self.is_leader or self.engine is None:
+            return
+        self.snapshots.take(
+            self.engine.snapshot_state(),
+            SnapshotMetadata(
+                last_processed_position=self.next_read_position - 1,
+                last_written_position=self.log.next_position - 1,
+                term=self.raft.term,
+            ),
+        )
+
+    def close(self) -> None:
+        self.raft.close()
+        self.storage.close()
+
+
+class ClusterBroker(Actor):
+    """A broker node: gossip + topology + partitions + client/management
+    APIs. Create several in one process for a cluster (the reference's
+    ClusteringRule runs 3 real brokers in one JVM)."""
+
+    def __init__(
+        self,
+        cfg: BrokerCfg,
+        data_dir: str,
+        scheduler: Optional[ActorScheduler] = None,
+        clock: Optional[Callable[[], int]] = None,
+    ):
+        super().__init__(f"broker-{cfg.cluster.node_id}")
+        self.cfg = cfg
+        self.node_id = cfg.cluster.node_id
+        self.data_dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self.clock = clock or SystemClock()
+        self._own_scheduler = scheduler is None
+        self._closing = False
+        self.scheduler = scheduler or ActorScheduler(
+            cpu_threads=cfg.threads.cpu_thread_count,
+            io_threads=cfg.threads.io_thread_count,
+        ).start()
+
+        self.metrics = MetricsRegistry()
+        self.metrics_events_processed = self.metrics.counter(
+            "stream_processor_events_processed", "Committed records processed"
+        )
+        if cfg.metrics.enabled:
+            self.metrics_writer = MetricsFileWriter(
+                self.metrics,
+                os.path.join(data_dir, cfg.metrics.file),
+                self.scheduler,
+                cfg.metrics.flush_period_ms,
+            )
+
+        self.repository = WorkflowRepository()
+        self.topology = Topology()
+        self.partitions: Dict[int, PartitionServer] = {}
+        self._pending_responses: Dict[int, ActorFuture] = {}
+        self._next_request_id = 0
+        self._push_listeners: Dict[int, Callable[[int, Record], None]] = {}
+        self._request_lock = threading.Lock()
+
+        # gossip (management-plane membership + topology dissemination)
+        self.gossip = Gossip(
+            self.node_id,
+            self.scheduler,
+            config=GossipConfig(
+                probe_interval_ms=cfg.gossip.probe_interval_ms,
+                probe_timeout_ms=cfg.gossip.probe_timeout_ms,
+                probe_indirect_nodes=cfg.gossip.probe_indirect_nodes,
+                suspicion_multiplier=cfg.gossip.suspicion_multiplier,
+                sync_interval_ms=cfg.gossip.sync_interval_ms,
+            ),
+            host=cfg.network.host,
+        )
+        self.gossip.on_custom_event("partition-leader", self._on_leader_event)
+
+        # client + subscription servers
+        self.client_server = ServerTransport(
+            host=cfg.network.host, request_handler=self._on_client_request
+        )
+        self.subscription_server = ServerTransport(
+            host=cfg.network.host,
+            request_handler=self._on_subscription_request,
+            message_handler=self._on_subscription_message,
+        )
+        self.client_transport = ClientTransport()
+
+        self.scheduler.submit_actor(self)
+        self.actor_control = None  # set in on_actor_started
+
+        # periodic snapshotting (reference snapshotPeriod)
+        self._snapshot_period_ms = cfg.data.snapshot_period_ms
+
+    # -- lifecycle ---------------------------------------------------------
+    def on_actor_started(self) -> None:
+        self.actor_control = self.actor
+        self.actor.run_at_fixed_rate(self._snapshot_period_ms, self.snapshot_all)
+        self.actor.run_at_fixed_rate(100, self._tick_engines)
+
+    @property
+    def gossip_address(self) -> RemoteAddress:
+        return self.gossip.address
+
+    @property
+    def client_address(self) -> RemoteAddress:
+        return self.client_server.address
+
+    def join(self, contact_points: List[RemoteAddress]) -> ActorFuture:
+        return self.gossip.join(contact_points)
+
+    def open_partition(self, partition_id: int) -> ActorFuture:
+        """Create/open a partition (log + raft endpoint, not yet clustered);
+        completes with the local raft address. Reference:
+        CreatePartitionRequest → PartitionInstallService composite install."""
+
+        def do():
+            if partition_id not in self.partitions:
+                self.partitions[partition_id] = PartitionServer(self, partition_id)
+            return self.partitions[partition_id].raft.address
+
+        return self.actor.call(do)
+
+    def bootstrap_partition(
+        self, partition_id: int, members: Dict[str, RemoteAddress]
+    ) -> None:
+        """Install the raft membership (self included) and start the
+        election clock."""
+
+        def do():
+            server = self.partitions[partition_id]
+            raft_members = dict(members)
+            raft_members[self.node_id] = server.raft.address
+            server.raft.bootstrap(raft_members)
+
+        self.actor.run(do)
+
+    def close(self) -> None:
+        self._closing = True
+        for server in self.partitions.values():
+            server.close()
+        self.gossip.close()
+        self.client_server.close()
+        self.subscription_server.close()
+        self.client_transport.close()
+        if self._own_scheduler:
+            self.scheduler.stop()
+
+    # -- topology dissemination (gossip custom events) ----------------------
+    def on_partition_leader(self, partition_id: int, term: int) -> None:
+        """Called when THIS node becomes a partition's leader: update the
+        local view and broadcast (reference: leadership broadcast as gossip
+        custom event)."""
+        addr = [self.client_address.host, self.client_address.port]
+        sub = [self.subscription_server.address.host, self.subscription_server.address.port]
+        self.topology.update_leader(partition_id, self.node_id, addr, sub, term)
+        self.gossip.publish_custom_event(
+            "partition-leader",
+            {
+                "partition": partition_id,
+                "node": self.node_id,
+                "addr": addr,
+                "sub": sub,
+                "term": term,
+            },
+        )
+
+    def _on_leader_event(self, _sender: str, payload) -> None:
+        if not isinstance(payload, dict):
+            return
+        self.topology.update_leader(
+            int(payload.get("partition", -1)),
+            payload.get("node", ""),
+            payload.get("addr", ["", 0]),
+            payload.get("sub", ["", 0]),
+            int(payload.get("term", 0)),
+        )
+
+    # -- client API (reference ClientApiMessageHandler) ---------------------
+    def _on_client_request(self, payload: bytes, conn):
+        try:
+            msg = msgpack.unpack(payload)
+        except Exception:  # noqa: BLE001
+            return None
+        t = msg.get("t")
+        if t == "command":
+            result = ActorFuture()
+            self.actor.run(lambda: self._handle_command(msg, result))
+            return result
+        if t == "topology":
+            return self.actor.call(self._handle_topology_request)
+        if t == "job-subscription":
+            result = ActorFuture()
+            self.actor.run(lambda: self._handle_job_subscription(msg, conn, result))
+            return result
+        if t == "fetch-workflow":
+            return self.actor.call(lambda: self._handle_fetch_workflow(msg))
+        return None
+
+    # -- deployment distribution (reference FetchWorkflowRequest served by
+    # the system partition's WorkflowRepositoryService; WorkflowCache on the
+    # requesting side) ------------------------------------------------------
+    def _handle_fetch_workflow(self, msg: dict) -> bytes:
+        process_id = msg.get("process_id") or ""
+        workflow_key = int(msg.get("workflow_key", -1))
+        workflows = []
+        if workflow_key >= 0:
+            wf = self.repository.by_key.get(workflow_key)
+            workflows = [wf] if wf else []
+        elif process_id:
+            workflows = list(self.repository.versions.get(process_id, []))
+        return msgpack.pack(
+            {
+                "t": "fetch-workflow-rsp",
+                "workflows": [
+                    {
+                        "id": wf.id,
+                        "version": wf.version,
+                        "key": wf.key,
+                        "resource": wf.source_resource,
+                        "resource_type": wf.source_type,
+                    }
+                    for wf in workflows
+                ],
+            }
+        )
+
+    def fetch_workflow(
+        self, process_id: str, workflow_key: int, on_done: Callable[[], None]
+    ) -> None:
+        """Fetch a workflow from the system partition leader and register it
+        locally; ``on_done`` fires (on the broker actor) regardless of
+        outcome — the caller re-processes and lets the engine reject if the
+        workflow truly does not exist."""
+        addr = self.topology.leader_address(0)
+        if addr is None:
+            self.actor.run_delayed(100, on_done)
+            return
+        request = msgpack.pack(
+            {
+                "t": "fetch-workflow",
+                "process_id": process_id,
+                "workflow_key": workflow_key,
+            }
+        )
+        future = self.client_transport.send_request(addr, request, timeout_ms=2000)
+
+        def on_response(f: ActorFuture):
+            def apply():
+                if f._exception is None:
+                    try:
+                        self._register_fetched_workflows(msgpack.unpack(f._value))
+                    except ValueError:
+                        pass
+                on_done()
+
+            self.actor.run(apply)
+
+        future.on_complete(on_response)
+
+    def _register_fetched_workflows(self, msg: dict) -> None:
+        from zeebe_tpu.models.bpmn.xml import read_model
+        from zeebe_tpu.models.bpmn.yaml_front import read_yaml_workflow
+        from zeebe_tpu.models.transform.transformer import transform_model
+
+        for entry in msg.get("workflows", []):
+            if int(entry.get("key", -1)) in self.repository.by_key:
+                continue
+            data = bytes(entry.get("resource", b""))
+            if not data:
+                continue
+            try:
+                if entry.get("resource_type") == "YAML_WORKFLOW":
+                    model = read_yaml_workflow(data.decode("utf-8"))
+                else:
+                    model = read_model(data)
+                for wf in transform_model(model):
+                    if wf.id != entry.get("id"):
+                        continue
+                    wf.version = int(entry.get("version", 1))
+                    wf.key = int(entry.get("key", -1))
+                    wf.source_resource = data
+                    wf.source_type = entry.get("resource_type", "BPMN_XML")
+                    self.repository.merge([wf])
+            except Exception:  # noqa: BLE001 - a bad resource only skips
+                continue
+
+    def _handle_topology_request(self) -> bytes:
+        leaders = {
+            str(pid): {"node": entry[0], "addr": entry[1], "term": entry[2]}
+            for pid, entry in self.topology.partition_leaders.items()
+        }
+        return msgpack.pack({"t": "topology-rsp", "leaders": leaders})
+
+    def _handle_command(self, msg: dict, result: ActorFuture) -> None:
+        partition_id = int(msg.get("partition", 0))
+        server = self.partitions.get(partition_id)
+        if server is None or not server.is_leader:
+            leader = self.topology.leader_node(partition_id)
+            result.complete(
+                msgpack.pack(
+                    {"t": "error", "code": "NOT_LEADER", "leader": leader or ""}
+                )
+            )
+            return
+        try:
+            record, _ = codec.decode_record(bytes(msg.get("frame", b"")))
+        except ValueError:
+            result.complete(msgpack.pack({"t": "error", "code": "MALFORMED"}))
+            return
+        with self._request_lock:
+            request_id = self._next_request_id
+            self._next_request_id += 1
+        record.metadata.request_id = request_id
+        record.position = -1  # assigned on append
+        record.timestamp = -1
+
+        response_future = ActorFuture()
+        self._pending_responses[request_id] = response_future
+
+        def on_response(f: ActorFuture):
+            if f._exception is not None:
+                result.complete(
+                    msgpack.pack({"t": "error", "code": "INTERNAL", "message": str(f._exception)})
+                )
+            else:
+                result.complete(
+                    msgpack.pack({"t": "command-rsp", "frame": codec.encode_record(f._value)})
+                )
+
+        response_future.on_complete(on_response)
+
+        append = server.raft.append([record])
+
+        def on_append(f: ActorFuture):
+            if f._exception is not None:
+                self._pending_responses.pop(request_id, None)
+                result.complete(
+                    msgpack.pack({"t": "error", "code": "NOT_LEADER", "leader": ""})
+                )
+
+        append.on_complete(on_append)
+
+    def send_client_response(self, response: Record) -> None:
+        request_id = response.metadata.request_id
+        if request_id < 0:
+            return
+        future = self._pending_responses.pop(request_id, None)
+        if future is not None:
+            future.complete(response)
+
+    # -- job subscriptions over the client API ------------------------------
+    def _handle_job_subscription(self, msg: dict, conn, result: ActorFuture) -> None:
+        """reference: AddJobSubscriptionHandler /
+        IncreaseJobSubscriptionCreditsHandler control messages; ACTIVATED
+        records are pushed down the subscriber's own connection
+        (SubscribedRecordWriter)."""
+        action = msg.get("action")
+        partition_id = int(msg.get("partition", 0))
+        server = self.partitions.get(partition_id)
+        if server is None or not server.is_leader or server.engine is None:
+            result.complete(msgpack.pack({"t": "error", "code": "NOT_LEADER"}))
+            return
+        if action == "add":
+            subscriber_key = int(msg["subscriber_key"])
+            if conn is not None:
+                self.on_push(
+                    subscriber_key,
+                    lambda pid, rec: conn.push(
+                        msgpack.pack(
+                            {
+                                "t": "pushed-record",
+                                "partition": pid,
+                                "subscriber_key": subscriber_key,
+                                "frame": codec.encode_record(rec),
+                            }
+                        )
+                    ),
+                )
+                # tear the subscription down when the worker's connection
+                # dies, else activated jobs black-hole into dead credits
+                # (reference: transport channel close listeners)
+                conn.on_close(
+                    lambda: self._drop_job_subscription(partition_id, subscriber_key)
+                )
+            backlog = server.engine.add_job_subscription(
+                JobSubscription(
+                    subscriber_key=subscriber_key,
+                    job_type=msg["job_type"],
+                    worker=msg.get("worker", "worker"),
+                    timeout=int(msg.get("timeout", 300_000)),
+                    credits=int(msg.get("credits", 32)),
+                )
+            )
+            if backlog:
+                server.raft.append(backlog)
+        elif action == "credits":
+            server.engine.increase_job_credits(
+                int(msg["subscriber_key"]), int(msg.get("credits", 1))
+            )
+        elif action == "remove":
+            self._drop_job_subscription(partition_id, int(msg["subscriber_key"]))
+        result.complete(msgpack.pack({"t": "ok"}))
+
+    def _drop_job_subscription(self, partition_id: int, subscriber_key: int) -> None:
+        self._push_listeners.pop(subscriber_key, None)
+        server = self.partitions.get(partition_id)
+        if server is not None and server.engine is not None:
+            server.engine.remove_job_subscription(subscriber_key)
+
+    def on_push(self, subscriber_key: int, listener: Callable[[int, Record], None]) -> None:
+        self._push_listeners[subscriber_key] = listener
+
+    def push_to_subscriber(self, subscriber_key: int, partition_id: int, record: Record) -> None:
+        listener = self._push_listeners.get(subscriber_key)
+        if listener is not None:
+            listener(partition_id, record)
+
+    # -- cross-partition subscription commands ------------------------------
+    def send_subscription_command(self, target_partition: int, record: Record) -> None:
+        """Route to the target partition's leader over the subscription
+        transport (reference SubscriptionCommandSender hash routing; the
+        partition choice already happened in the engine). Remote sends are
+        acked and retried until a leader accepts them (the reference's
+        subscription command resend loop) — topology may lag an election."""
+        server = self.partitions.get(target_partition)
+        if server is not None and server.is_leader:
+            server.raft.append([record])  # local fast path
+            return
+        request = msgpack.pack(
+            {
+                "t": "subscription-cmd",
+                "partition": target_partition,
+                "frame": codec.encode_record(record),
+            }
+        )
+
+        def retry_loop():
+            import time as _time
+
+            deadline = _time.monotonic() + 30.0
+            while _time.monotonic() < deadline and not self._closing:
+                # leadership may have landed here meanwhile
+                local = self.partitions.get(target_partition)
+                if local is not None and local.is_leader:
+                    local.raft.append([record])
+                    return
+                addr = self.topology.leader_subscription_address(target_partition)
+                if addr is not None:
+                    try:
+                        payload = self.client_transport.send_request(
+                            addr, request, timeout_ms=2000
+                        ).join(3)
+                        if msgpack.unpack(payload).get("t") == "ok":
+                            return
+                    except Exception:  # noqa: BLE001 - retry through outages
+                        pass
+                _time.sleep(0.1)
+
+        threading.Thread(target=retry_loop, daemon=True).start()
+
+    def _on_subscription_request(self, payload: bytes, conn=None):
+        """Acked subscription command (REQUEST frame): append on the leader,
+        tell the sender to retry elsewhere otherwise."""
+        try:
+            msg = msgpack.unpack(payload)
+        except Exception:  # noqa: BLE001
+            return msgpack.pack({"t": "error", "code": "BAD_REQUEST"})
+        if msg.get("t") != "subscription-cmd":
+            return msgpack.pack({"t": "error", "code": "BAD_REQUEST"})
+        result = ActorFuture()
+
+        def do():
+            partition_id = int(msg.get("partition", 0))
+            server = self.partitions.get(partition_id)
+            if server is None or not server.is_leader:
+                result.complete(msgpack.pack({"t": "error", "code": "NOT_LEADER"}))
+                return
+            try:
+                record, _ = codec.decode_record(bytes(msg.get("frame", b"")))
+            except ValueError:
+                result.complete(msgpack.pack({"t": "error", "code": "BAD_REQUEST"}))
+                return
+            record.position = -1
+            record.timestamp = -1
+            server.raft.append([record])
+            result.complete(msgpack.pack({"t": "ok"}))
+
+        self.actor.run(do)
+        return result
+
+    def _on_subscription_message(self, payload: bytes) -> None:
+        try:
+            msg = msgpack.unpack(payload)
+        except Exception:  # noqa: BLE001
+            return
+        if msg.get("t") != "subscription-cmd":
+            return
+
+        def do():
+            partition_id = int(msg.get("partition", 0))
+            server = self.partitions.get(partition_id)
+            if server is None or not server.is_leader:
+                return
+            try:
+                record, _ = codec.decode_record(bytes(msg.get("frame", b"")))
+            except ValueError:
+                return
+            record.position = -1
+            record.timestamp = -1
+            server.raft.append([record])
+
+        self.actor.run(do)
+
+    # -- client command entry used by the in-process gateway ----------------
+    def subscription_address(self) -> RemoteAddress:
+        return self.subscription_server.address
+
+    # -- periodic work -------------------------------------------------------
+    def snapshot_all(self) -> None:
+        for server in self.partitions.values():
+            server.snapshot()
+
+    def _tick_engines(self) -> None:
+        """Timer/TTL sweeps on leader partitions (reference periodic actor
+        jobs: JobTimeOutStreamProcessor, MessageTimeToLiveChecker)."""
+        for server in self.partitions.values():
+            if not server.is_leader or server.engine is None:
+                continue
+            commands = (
+                server.engine.check_job_deadlines()
+                + server.engine.check_timer_deadlines()
+                + server.engine.check_message_ttls()
+            )
+            if commands:
+                server.raft.append(commands)

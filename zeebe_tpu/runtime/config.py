@@ -1,0 +1,185 @@
+"""Broker configuration: TOML file → typed config tree + env overrides.
+
+Reference parity: ``broker-core/.../system/configuration/`` —
+``TomlConfigurationReader`` parses ``zeebe.cfg.toml`` into the ``BrokerCfg``
+bean tree (network with port offset, data, cluster, threads, metrics,
+gossip, raft, bootstrap topics), and ``Environment`` applies env-var
+overrides (e.g. ``ZEEBE_PORT_OFFSET`` in ``NetworkCfg``). The canonical
+commented default file lives at ``dist/zeebe.cfg.toml`` (reference
+``dist/src/main/config/zeebe.cfg.toml``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tomllib
+from typing import Any, Dict, List, Optional
+
+# default ports mirror the reference layout (client 26501, management 26502,
+# replication 26503, subscription 26504; gateway 26500)
+DEFAULT_GATEWAY_PORT = 26500
+DEFAULT_CLIENT_PORT = 26501
+DEFAULT_MANAGEMENT_PORT = 26502
+DEFAULT_REPLICATION_PORT = 26503
+DEFAULT_SUBSCRIPTION_PORT = 26504
+
+
+@dataclasses.dataclass
+class NetworkCfg:
+    host: str = "127.0.0.1"
+    port_offset: int = 0
+    gateway_port: int = DEFAULT_GATEWAY_PORT
+    client_port: int = DEFAULT_CLIENT_PORT
+    management_port: int = DEFAULT_MANAGEMENT_PORT
+    replication_port: int = DEFAULT_REPLICATION_PORT
+    subscription_port: int = DEFAULT_SUBSCRIPTION_PORT
+
+    def apply_offset(self) -> None:
+        # reference: portOffset shifts every socket binding by offset * 10
+        shift = self.port_offset * 10
+        self.gateway_port += shift
+        self.client_port += shift
+        self.management_port += shift
+        self.replication_port += shift
+        self.subscription_port += shift
+
+
+@dataclasses.dataclass
+class DataCfg:
+    directory: str = "data"
+    segment_size_bytes: int = 64 * 1024 * 1024
+    snapshot_period_ms: int = 15 * 60 * 1000
+    snapshot_replication_period_ms: int = 5 * 60 * 1000
+
+
+@dataclasses.dataclass
+class ClusterCfg:
+    node_id: str = "node-0"
+    initial_contact_points: List[str] = dataclasses.field(default_factory=list)
+    bootstrap_expect: int = 1
+    replication_factor: int = 1
+    partitions: int = 1
+
+
+@dataclasses.dataclass
+class ThreadsCfg:
+    cpu_thread_count: int = 2
+    io_thread_count: int = 2
+
+
+@dataclasses.dataclass
+class MetricsCfg:
+    enabled: bool = True
+    file: str = "metrics/zeebe.prom"
+    flush_period_ms: int = 5_000
+
+
+@dataclasses.dataclass
+class GossipCfg:
+    probe_interval_ms: int = 250
+    probe_timeout_ms: int = 500
+    probe_indirect_nodes: int = 2
+    suspicion_multiplier: int = 5
+    sync_interval_ms: int = 10_000
+
+
+@dataclasses.dataclass
+class RaftCfg:
+    heartbeat_interval_ms: int = 250
+    election_timeout_ms: int = 1_000
+
+
+@dataclasses.dataclass
+class TopicCfg:
+    name: str = "default-topic"
+    partitions: int = 1
+    replication_factor: int = 1
+
+
+@dataclasses.dataclass
+class BrokerCfg:
+    network: NetworkCfg = dataclasses.field(default_factory=NetworkCfg)
+    data: DataCfg = dataclasses.field(default_factory=DataCfg)
+    cluster: ClusterCfg = dataclasses.field(default_factory=ClusterCfg)
+    threads: ThreadsCfg = dataclasses.field(default_factory=ThreadsCfg)
+    metrics: MetricsCfg = dataclasses.field(default_factory=MetricsCfg)
+    gossip: GossipCfg = dataclasses.field(default_factory=GossipCfg)
+    raft: RaftCfg = dataclasses.field(default_factory=RaftCfg)
+    topics: List[TopicCfg] = dataclasses.field(default_factory=list)
+
+
+_SECTION_KEYS = {
+    "network": NetworkCfg,
+    "data": DataCfg,
+    "cluster": ClusterCfg,
+    "threads": ThreadsCfg,
+    "metrics": MetricsCfg,
+    "gossip": GossipCfg,
+    "raft": RaftCfg,
+}
+
+# env overrides (reference Environment: ZEEBE_* wins over the file)
+_ENV_OVERRIDES = {
+    "ZEEBE_HOST": ("network", "host", str),
+    "ZEEBE_PORT_OFFSET": ("network", "port_offset", int),
+    "ZEEBE_NODE_ID": ("cluster", "node_id", str),
+    "ZEEBE_PARTITIONS": ("cluster", "partitions", int),
+    "ZEEBE_REPLICATION_FACTOR": ("cluster", "replication_factor", int),
+    "ZEEBE_BOOTSTRAP_EXPECT": ("cluster", "bootstrap_expect", int),
+    "ZEEBE_CONTACT_POINTS": (
+        "cluster",
+        "initial_contact_points",
+        lambda v: [p.strip() for p in v.split(",") if p.strip()],
+    ),
+    "ZEEBE_DATA_DIR": ("data", "directory", str),
+}
+
+
+def _apply_section(cfg_obj: Any, table: Dict[str, Any], path: str) -> None:
+    fields = {f.name: f for f in dataclasses.fields(cfg_obj)}
+    for key, value in table.items():
+        # accept camelCase (reference TOML style) and snake_case
+        snake = "".join(
+            "_" + c.lower() if c.isupper() else c for c in key
+        ).lstrip("_")
+        if snake not in fields:
+            raise ValueError(f"unknown config key [{path}] {key!r}")
+        setattr(cfg_obj, snake, value)
+
+
+def load_config(
+    path: Optional[str] = None,
+    toml_text: Optional[str] = None,
+    env: Optional[Dict[str, str]] = None,
+) -> BrokerCfg:
+    """Parse config (file path or literal text), then apply env overrides.
+    Missing file/sections keep defaults (the reference ships a fully
+    commented default file; every knob is optional)."""
+    cfg = BrokerCfg()
+    data: Dict[str, Any] = {}
+    if toml_text is not None:
+        data = tomllib.loads(toml_text)
+    elif path is not None and os.path.exists(path):
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+
+    for section, table in data.items():
+        if section == "topics":
+            for entry in table:
+                topic = TopicCfg()
+                _apply_section(topic, entry, "topics")
+                cfg.topics.append(topic)
+            continue
+        target_cls = _SECTION_KEYS.get(section)
+        if target_cls is None:
+            raise ValueError(f"unknown config section [{section}]")
+        _apply_section(getattr(cfg, section), table, section)
+
+    environment = env if env is not None else os.environ
+    for var, (section, attr, conv) in _ENV_OVERRIDES.items():
+        if var in environment:
+            setattr(getattr(cfg, section), attr, conv(environment[var]))
+
+    cfg.network.apply_offset()
+    return cfg

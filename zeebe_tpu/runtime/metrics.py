@@ -1,0 +1,125 @@
+"""Metrics: registry + prometheus text rendering + periodic file writer.
+
+Reference parity: ``util/.../metrics/MetricsManager.java`` (allocate
+counters with name + labels, ``dump`` renders Prometheus text format) and
+``broker-core/.../system/metrics/MetricsFileWriter.java:34-90`` (an actor
+flushes the registry to ``metrics/zeebe.prom`` every 5s; scraped via node
+exporter). Counters are used throughout the broker: records processed /
+skipped / written per stream processor (``StreamProcessorMetrics``),
+workflow-instance counts (``WorkflowInstanceMetrics``), transport and
+scheduler internals.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from zeebe_tpu.runtime.actors import Actor, ActorScheduler
+
+
+class Metric:
+    """A counter/gauge with fixed labels. Increment-only use makes it a
+    counter; ``set`` makes it a gauge — prometheus typing is emitted from
+    ``kind``."""
+
+    __slots__ = ("name", "labels", "kind", "_value", "_lock")
+
+    def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...], kind: str):
+        self.name = name
+        self.labels = labels
+        self.kind = kind
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, delta: float = 1.0) -> None:
+        with self._lock:
+            self._value += delta
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class MetricsRegistry:
+    """Reference MetricsManager: allocate once, render many."""
+
+    def __init__(self, prefix: str = "zb_"):
+        self.prefix = prefix
+        self._metrics: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Metric] = {}
+        self._help: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_text: str = "", **labels: str) -> Metric:
+        return self._allocate(name, "counter", help_text, labels)
+
+    def gauge(self, name: str, help_text: str = "", **labels: str) -> Metric:
+        return self._allocate(name, "gauge", help_text, labels)
+
+    def _allocate(self, name: str, kind: str, help_text: str, labels: Dict[str, str]) -> Metric:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            metric = self._metrics.get(key)
+            if metric is None:
+                metric = Metric(name, key[1], kind)
+                self._metrics[key] = metric
+            if help_text:
+                self._help[name] = help_text
+            return metric
+
+    def dump(self, now_ms: Optional[int] = None) -> str:
+        """Prometheus text format (reference MetricsManager.dump renders
+        `name{label="v",...} value timestamp`)."""
+        ts = now_ms if now_ms is not None else int(time.time() * 1000)
+        by_name: Dict[str, List[Metric]] = {}
+        with self._lock:
+            for metric in self._metrics.values():
+                by_name.setdefault(metric.name, []).append(metric)
+        lines: List[str] = []
+        for name in sorted(by_name):
+            full = self.prefix + name
+            if name in self._help:
+                lines.append(f"# HELP {full} {self._help[name]}")
+            lines.append(f"# TYPE {full} {by_name[name][0].kind}")
+            for metric in by_name[name]:
+                if metric.labels:
+                    label_str = ",".join(f'{k}="{v}"' for k, v in metric.labels)
+                    lines.append(f"{full}{{{label_str}}} {metric.value:g} {ts}")
+                else:
+                    lines.append(f"{full} {metric.value:g} {ts}")
+        return "\n".join(lines) + "\n"
+
+
+class MetricsFileWriter(Actor):
+    """Periodically dumps the registry to a file (reference
+    MetricsFileWriter: temp-write then rename so scrapers never see a torn
+    file)."""
+
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        path: str,
+        scheduler: ActorScheduler,
+        flush_period_ms: int = 5_000,
+    ):
+        super().__init__("metrics-file-writer")
+        self.registry = registry
+        self.path = path
+        self.flush_period_ms = flush_period_ms
+        scheduler.submit_actor(self, io_bound=True)
+
+    def on_actor_started(self) -> None:
+        self.actor.run_at_fixed_rate(self.flush_period_ms, self.flush)
+
+    def flush(self) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.registry.dump())
+        os.replace(tmp, self.path)

@@ -67,12 +67,16 @@ def _store_rows(store: RecordBatch, idx, rows: RecordBatch, mask) -> RecordBatch
 
 
 def enqueue(queue: RecordQueue, batch: RecordBatch) -> RecordQueue:
-    """Append the valid rows of ``batch`` (already compacted: valid rows form
-    a prefix) to the queue."""
+    """Append the valid rows of ``batch`` to the queue, in row order. The
+    mask may be arbitrary (not just a compacted prefix): each valid row is
+    scattered to its prefix-sum slot, preserving record order — the
+    determinism contract replay depends on."""
     cap = queue.capacity
-    n = batch.size
-    add = jnp.sum(batch.valid, dtype=jnp.int32)
-    idx = (queue.head + queue.count + jnp.arange(n, dtype=jnp.int32)) % cap
+    valid = batch.valid.astype(jnp.int32)
+    add = jnp.sum(valid, dtype=jnp.int32)
+    # rank of each valid row among valid rows
+    offs = jnp.cumsum(valid, dtype=jnp.int32) - 1
+    idx = (queue.head + queue.count + offs) % cap
     rows = _store_rows(queue.rows, idx, batch, batch.valid)
     return RecordQueue(rows=rows, head=queue.head, count=queue.count + add)
 
@@ -144,6 +148,73 @@ drive_jit = jax.jit(
 )
 
 
+@partial(
+    jax.jit,
+    static_argnames=("batch_size", "synthetic_workers", "max_rounds"),
+    donate_argnums=(1, 2),
+)
+def _quiesce_device(graph, state, queue, now, batch_size, synthetic_workers, max_rounds):
+    """The whole drive-to-quiescence loop as ONE device program
+    (``lax.while_loop``): no host round-trips between rounds. Off a local
+    chip every per-round scalar sync is a full network round trip (the
+    broker may sit across a tunnel/DCN from the device), and even locally
+    dispatch latency dwarfs the sub-ms step kernel."""
+    totals0 = {
+        "processed": jnp.zeros((), jnp.int64),
+        "emitted": jnp.zeros((), jnp.int64),
+        "completed_roots": jnp.zeros((), jnp.int64),
+        "rounds": jnp.zeros((), jnp.int32),
+        "overflow": jnp.zeros((), bool),
+    }
+
+    def cond(carry):
+        _, q, t = carry
+        return (q.count > 0) & (t["rounds"] < max_rounds) & (~t["overflow"])
+
+    def body(carry):
+        s, q, t = carry
+        q, batch = dequeue(q, batch_size)
+        s, out, stats = step_kernel(graph, s, batch, now)
+        q = enqueue(q, out)
+        if synthetic_workers:
+            q = enqueue(q, _synthetic_complete(out))
+        t = {
+            "processed": t["processed"] + stats["processed"].astype(jnp.int64),
+            "emitted": t["emitted"] + stats["emitted"].astype(jnp.int64),
+            "completed_roots": t["completed_roots"]
+            + stats["completed_roots"].astype(jnp.int64),
+            "rounds": t["rounds"] + 1,
+            "overflow": t["overflow"] | stats["overflow"].astype(bool),
+        }
+        return s, q, t
+
+    return jax.lax.while_loop(cond, body, (state, queue, totals0))
+
+
+# XLA's TPU backend lowers the in-loop compaction cumsums to reduce-window
+# programs whose scoped vmem exceeds the default 16M limit (a compiler
+# allocation quirk, not real memory pressure); raise the limit for this one
+# program. CPU/GPU ignore the issue entirely.
+_TPU_COMPILER_OPTIONS = {"xla_tpu_scoped_vmem_limit_kib": "65536"}
+_quiesce_cache: dict = {}
+
+
+def _quiesce_executable(graph, state, queue, now, batch_size, synthetic_workers, max_rounds):
+    shapes = tuple(
+        (tuple(leaf.shape), str(leaf.dtype))
+        for leaf in jax.tree.leaves((graph, state, queue, now))
+    )
+    key = (shapes, batch_size, synthetic_workers, max_rounds)
+    compiled = _quiesce_cache.get(key)
+    if compiled is None:
+        lowered = _quiesce_device.lower(
+            graph, state, queue, now, batch_size, synthetic_workers, max_rounds
+        )
+        compiled = lowered.compile(compiler_options=_TPU_COMPILER_OPTIONS)
+        _quiesce_cache[key] = compiled
+    return compiled
+
+
 def run_to_quiescence(
     graph: DeviceGraph,
     state: EngineState,
@@ -153,22 +224,24 @@ def run_to_quiescence(
     synthetic_workers: bool = False,
     max_rounds: int = 10_000,
 ):
-    """Host loop: drive rounds until the queue drains. Returns
-    (state, queue, totals dict)."""
-    totals = {"processed": 0, "emitted": 0, "completed_roots": 0, "rounds": 0}
-    for _ in range(max_rounds):
-        if int(queue.count) == 0:
-            break
-        state, queue, stats = drive_jit(
-            graph, state, queue, jnp.asarray(now, jnp.int64),
-            batch_size, synthetic_workers,
+    """Drive rounds until the queue drains — one device dispatch, one host
+    sync for the totals. Returns (state, queue, totals dict)."""
+    now = jnp.asarray(now, jnp.int64)
+    if jax.default_backend() == "tpu":
+        compiled = _quiesce_executable(
+            graph, state, queue, now, batch_size, synthetic_workers, max_rounds
         )
-        if bool(stats["overflow"]):
-            raise RuntimeError("device table overflow during drive loop")
-        totals["processed"] += int(stats["processed"])
-        totals["emitted"] += int(stats["emitted"])
-        totals["completed_roots"] += int(stats["completed_roots"])
-        totals["rounds"] += 1
+        state, queue, dev_totals = compiled(graph, state, queue, now)
     else:
+        state, queue, dev_totals = _quiesce_device(
+            graph, state, queue, now, batch_size, synthetic_workers, max_rounds
+        )
+    # ONE host transfer for all scalars — per-scalar syncs each cost a full
+    # round trip to the device (networked tunnel: ~150ms apiece)
+    host_totals = jax.device_get(dev_totals)
+    if bool(host_totals.pop("overflow")):
+        raise RuntimeError("device table overflow during drive loop")
+    totals = {k: int(v) for k, v in host_totals.items()}
+    if totals["rounds"] >= max_rounds and int(queue.count) > 0:
         raise RuntimeError("drive loop did not quiesce")
     return state, queue, totals

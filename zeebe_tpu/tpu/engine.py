@@ -150,6 +150,10 @@ class TpuPartitionEngine:
 
     # -- worker subscriptions (host-managed device table) ------------------
     def add_job_subscription(self, sub: JobSubscription) -> None:
+        """Idempotent per subscriber key (same contract as the interpreter
+        engine): a re-subscribe replaces the previous slot rather than
+        double-registering it."""
+        self.remove_job_subscription(sub.subscriber_key)
         s = self.state
         valid = np.asarray(s.sub_valid)
         free = int(np.argmin(valid)) if not valid.all() else -1

@@ -66,6 +66,7 @@ class _Conn:
         self.wbuf = bytearray()
         self.lock = threading.Lock()
         self.open = True
+        self.close_listeners: list = []
 
     MAX_FRAME = 64 * 1024 * 1024
 
@@ -166,8 +167,8 @@ class _IoLoop:
                 conn.sock, events, self.selector.get_key(conn.sock).data
             )
             self.wake()
-        except (KeyError, ValueError, OSError):
-            pass
+        except (KeyError, ValueError, OSError, RuntimeError):
+            pass  # RuntimeError: selector closed during shutdown
 
     def send(self, conn: _Conn, data: bytes):
         with conn.lock:
@@ -222,21 +223,73 @@ class _IoLoop:
         on_close(conn)
 
 
+class ConnectionHandle:
+    """Server-side handle to one client connection: lets handlers push
+    MESSAGE frames back to that client later (reference: the broker's
+    ``SubscribedRecordWriter`` pushes job/topic subscription records down
+    the client's own socket)."""
+
+    def __init__(self, loop: _IoLoop, conn: _Conn):
+        self._loop = loop
+        self._conn = conn
+
+    @property
+    def open(self) -> bool:
+        return self._conn.open
+
+    def push(self, payload: bytes) -> bool:
+        if not self._conn.open:
+            return False
+        self._loop.send(self._conn, _encode(MESSAGE, 0, payload))
+        return True
+
+    def on_close(self, listener: Callable[[], None]) -> None:
+        """Run ``listener`` when this connection closes (reference: channel
+        close listeners, used to tear down the peer's subscriptions). Fires
+        immediately if the connection is already closed. The registration is
+        atomic w.r.t. the IO thread's close path (conn.lock), so a listener
+        cannot fall between the open-check and the close sweep."""
+        with self._conn.lock:
+            if self._conn.open:
+                self._conn.close_listeners.append(listener)
+                return
+        listener()
+
+
 class ServerTransport:
     """Accepts connections; dispatches REQUEST frames to ``request_handler``
     and MESSAGE frames to ``message_handler``. Handlers run on the IO
     thread — keep them short, or return an ``ActorFuture`` (async response:
     the reply is sent when the future completes, without blocking the IO
-    loop — the reference's actor-dispatched request handling)."""
+    loop — the reference's actor-dispatched request handling).
+
+    ``request_handler`` may take ``(payload)`` or ``(payload, conn)`` — the
+    two-argument form receives a :class:`ConnectionHandle` for later pushes.
+    """
 
     def __init__(
         self,
         host: str = "127.0.0.1",
         port: int = 0,
-        request_handler: Optional[Callable[[bytes], Optional[bytes]]] = None,
+        request_handler: Optional[Callable] = None,
         message_handler: Optional[Callable[[bytes], None]] = None,
     ):
-        self.request_handler = request_handler or (lambda payload: None)
+        import inspect
+
+        handler = request_handler or (lambda payload: None)
+        try:
+            params = inspect.signature(handler).parameters.values()
+            positional = sum(
+                1
+                for p in params
+                if p.kind
+                in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+            )
+            takes_varargs = any(p.kind == p.VAR_POSITIONAL for p in params)
+            self._handler_wants_conn = positional >= 2 or takes_varargs
+        except (TypeError, ValueError):
+            self._handler_wants_conn = False
+        self.request_handler = handler
         self.message_handler = message_handler or (lambda payload: None)
         self._listener = socket.create_server((host, port))
         self._listener.setblocking(False)
@@ -267,7 +320,12 @@ class ServerTransport:
         for ftype, cid, payload in conn.frames():
             if ftype == REQUEST:
                 try:
-                    response = self.request_handler(payload)
+                    if self._handler_wants_conn:
+                        response = self.request_handler(
+                            payload, ConnectionHandle(self._loop, conn)
+                        )
+                    else:
+                        response = self.request_handler(payload)
                 except Exception as e:  # noqa: BLE001
                     import traceback
 
@@ -295,6 +353,16 @@ class ServerTransport:
 
     def _on_close(self, conn: _Conn):
         self._conns.pop(conn.sock, None)
+        with conn.lock:
+            conn.open = False
+            listeners, conn.close_listeners = conn.close_listeners, []
+        for listener in listeners:
+            try:
+                listener()
+            except Exception:  # noqa: BLE001
+                import traceback
+
+                traceback.print_exc()
 
     def close(self):
         try:
@@ -302,6 +370,11 @@ class ServerTransport:
         except OSError:
             pass
         self._loop.stop()
+        # fire close listeners for connections the loop never got to close —
+        # retained ConnectionHandles must observe open == False and owners
+        # (e.g. job subscriptions) must tear down
+        for conn in list(self._conns.values()):
+            self._on_close(conn)
 
 
 class ClientTransport:
@@ -315,7 +388,12 @@ class ClientTransport:
     connection on the next send. ``send_message`` is fire-and-forget.
     """
 
-    def __init__(self, default_timeout_ms: int = 5000):
+    def __init__(
+        self,
+        default_timeout_ms: int = 5000,
+        message_handler: Optional[Callable[[bytes], None]] = None,
+    ):
+        self.message_handler = message_handler
         self._loop = _IoLoop("zb-client").start()
         self._conns: Dict[RemoteAddress, _Conn] = {}
         self._by_sock: Dict[socket.socket, Tuple[RemoteAddress, _Conn]] = {}
@@ -361,6 +439,16 @@ class ClientTransport:
 
     def _on_frames(self, conn: _Conn):
         for ftype, cid, payload in conn.frames():
+            if ftype == MESSAGE:
+                # server-initiated push (subscription records)
+                if self.message_handler is not None:
+                    try:
+                        self.message_handler(payload)
+                    except Exception:  # noqa: BLE001
+                        import traceback
+
+                        traceback.print_exc()
+                continue
             if ftype != RESPONSE:
                 continue
             with self._lock:
