@@ -223,9 +223,14 @@ def run_to_quiescence(
     batch_size: int,
     synthetic_workers: bool = False,
     max_rounds: int = 10_000,
+    sync: bool = True,
 ):
     """Drive rounds until the queue drains — one device dispatch, one host
-    sync for the totals. Returns (state, queue, totals dict)."""
+    sync for the totals. Returns (state, queue, totals dict).
+
+    ``sync=False`` returns the totals as device scalars without any host
+    round trip (callers accumulating across many waves fetch once at the
+    end; overflow/quiescence checking is then the caller's job)."""
     now = jnp.asarray(now, jnp.int64)
     if jax.default_backend() == "tpu":
         compiled = _quiesce_executable(
@@ -236,6 +241,8 @@ def run_to_quiescence(
         state, queue, dev_totals = _quiesce_device(
             graph, state, queue, now, batch_size, synthetic_workers, max_rounds
         )
+    if not sync:
+        return state, queue, dev_totals
     # ONE host transfer for all scalars — per-scalar syncs each cost a full
     # round trip to the device (networked tunnel: ~150ms apiece)
     host_totals = jax.device_get(dev_totals)

@@ -91,7 +91,7 @@ _DATA = [
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=_DATA,
-    meta_fields=["num_vars", "emit_width", "max_join_in"],
+    meta_fields=["num_vars", "emit_width", "max_join_in", "has_conditions"],
 )
 @dataclasses.dataclass
 class DeviceGraph:
@@ -126,6 +126,10 @@ class DeviceGraph:
     num_vars: int
     emit_width: int                  # max emissions per record (≥2)
     max_join_in: int
+    # deploy-time kernel specialization: with no conditioned flows anywhere
+    # in the deployed set, the predicate stack machine is omitted from the
+    # compiled step entirely (tri defaults to 'no condition')
+    has_conditions: bool = True
 
 
 @dataclasses.dataclass
@@ -334,6 +338,7 @@ def compile_graph(
         num_vars=max(len(varspace), 1),
         emit_width=emit_width,
         max_join_in=join_in,
+        has_conditions=bool((cond_prog >= 0).any()),
     )
     meta = GraphMeta(
         workflows=list(workflows),

@@ -64,8 +64,14 @@ def lookup(table: HashTable, keys: jax.Array, valid: jax.Array):
     table_size = table.keys.shape[0]
     h0 = _hash(keys, table_size)
 
-    def body(i, carry):
-        found, vals, done = carry
+    def cond(carry):
+        i, _, _, done = carry
+        # early exit: at sane load factors chains are 1-3 buckets long, and
+        # each probe round is a full gather pass — don't run all MAX_PROBES
+        return (i < MAX_PROBES) & jnp.any(~done)
+
+    def body(carry):
+        i, found, vals, done = carry
         idx = (h0 + i) & (table_size - 1)
         k = table.keys[idx]
         hit = (~done) & (k == keys)
@@ -73,12 +79,14 @@ def lookup(table: HashTable, keys: jax.Array, valid: jax.Array):
         vals = jnp.where(hit, table.vals[idx], vals)
         # an EMPTY bucket terminates the chain; TOMBSTONE does not
         done = done | hit | (k == EMPTY)
-        return found, vals, done
+        return i + 1, found, vals, done
 
     found = jnp.zeros(keys.shape, dtype=bool)
     vals = jnp.full(keys.shape, -1, dtype=jnp.int32)
     done = ~valid
-    found, vals, _ = lax.fori_loop(0, MAX_PROBES, body, (found, vals, done))
+    _, found, vals, _ = lax.while_loop(
+        cond, body, (jnp.zeros((), jnp.int32), found, vals, done)
+    )
     return found, vals
 
 
@@ -94,8 +102,12 @@ def insert(table: HashTable, keys: jax.Array, vals: jax.Array, valid: jax.Array)
     h0 = _hash(keys, table_size)
     rank = jnp.arange(batch, dtype=jnp.int32)
 
-    def body(_, carry):
-        tkeys, tvals, pending, probe = carry
+    def cond(carry):
+        i, _, _, pending, _ = carry
+        return (i < MAX_PROBES) & jnp.any(pending)
+
+    def body(carry):
+        i, tkeys, tvals, pending, probe = carry
         idx = (h0 + probe) & (table_size - 1)
         free = tkeys[idx] == EMPTY
         attempt = pending & free
@@ -110,11 +122,12 @@ def insert(table: HashTable, keys: jax.Array, vals: jax.Array, valid: jax.Array)
         tvals = tvals.at[widx].set(vals, mode="drop")
         pending = pending & ~win
         probe = jnp.where(pending, probe + 1, probe)
-        return tkeys, tvals, pending, probe
+        return i + 1, tkeys, tvals, pending, probe
 
     probe = jnp.zeros((batch,), dtype=jnp.int32)
-    tkeys, tvals, pending, _ = lax.fori_loop(
-        0, MAX_PROBES, body, (table.keys, table.vals, valid, probe)
+    _, tkeys, tvals, pending, _ = lax.while_loop(
+        cond, body,
+        (jnp.zeros((), jnp.int32), table.keys, table.vals, valid, probe),
     )
     return HashTable(tkeys, tvals), valid & ~pending
 
@@ -124,17 +137,23 @@ def delete(table: HashTable, keys: jax.Array, valid: jax.Array) -> HashTable:
     table_size = table.keys.shape[0]
     h0 = _hash(keys, table_size)
 
-    def body(i, carry):
-        slot, done = carry
+    def cond(carry):
+        i, _, done = carry
+        return (i < MAX_PROBES) & jnp.any(~done)
+
+    def body(carry):
+        i, slot, done = carry
         idx = (h0 + i) & (table_size - 1)
         k = table.keys[idx]
         hit = (~done) & (k == keys)
         slot = jnp.where(hit, idx, slot)
         done = done | hit | (k == EMPTY)
-        return slot, done
+        return i + 1, slot, done
 
     slot = jnp.full(keys.shape, table_size, dtype=jnp.int32)
-    slot, _ = lax.fori_loop(0, MAX_PROBES, body, (slot, ~valid))
+    _, slot, _ = lax.while_loop(
+        cond, body, (jnp.zeros((), jnp.int32), slot, ~valid)
+    )
     tkeys = table.keys.at[slot].set(TOMBSTONE, mode="drop")
     return HashTable(tkeys, table.vals)
 

@@ -296,15 +296,20 @@ def step_kernel(
     cflow = graph.cond_flows[wf_c, el_c]          # [B, F]
     cprog = graph.cond_prog[wf_c, el_c]           # [B, F]
     has_cond = cprog >= 0
-    tri = eval_programs(
-        graph.progs,
-        graph.lit_nums,
-        cprog,
-        jnp.broadcast_to(batch.v_vt[:, None, :], (b, fan, v)),
-        jnp.broadcast_to(batch.v_num[:, None, :], (b, fan, v)),
-        jnp.broadcast_to(batch.v_str[:, None, :], (b, fan, v)),
-    )
-    tri = jnp.where(has_cond, tri, -1)
+    if graph.has_conditions:
+        tri = eval_programs(
+            graph.progs,
+            graph.lit_nums,
+            cprog,
+            jnp.broadcast_to(batch.v_vt[:, None, :], (b, fan, v)),
+            jnp.broadcast_to(batch.v_num[:, None, :], (b, fan, v)),
+            jnp.broadcast_to(batch.v_str[:, None, :], (b, fan, v)),
+        )
+        tri = jnp.where(has_cond, tri, -1)
+    else:
+        # deploy-time specialization: no conditioned flow in the whole
+        # deployed set — the predicate machine is compiled out
+        tri = jnp.full((b, fan), -1, jnp.int32)
     is_true = tri == TRI_TRUE
     is_err = tri == TRI_ERROR
     fidx = jnp.arange(fan, dtype=jnp.int32)

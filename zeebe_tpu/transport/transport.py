@@ -195,6 +195,7 @@ class _IoLoop:
                     self._close(conn, on_close)
                     return
         if mask & selectors.EVENT_WRITE:
+            broken = False
             with conn.lock:
                 if conn.wbuf:
                     try:
@@ -203,10 +204,12 @@ class _IoLoop:
                     except (BlockingIOError, InterruptedError):
                         pass
                     except OSError:
-                        self._close(conn, on_close)
-                        return
-                if not conn.wbuf:
+                        broken = True
+                if not broken and not conn.wbuf:
                     self.want_write(conn, False)
+            if broken:
+                # outside conn.lock: close listeners re-take it (_on_close)
+                self._close(conn, on_close)
 
     def _close(self, conn: _Conn, on_close):
         if not conn.open:
