@@ -249,6 +249,69 @@ class TestWorkerDisconnect:
             client.close()
 
 
+class TestTopicSubscriptions:
+    def test_push_over_the_wire_with_acks(self, cluster3):
+        """Records stream to the subscriber over its own connection; acks
+        persist in the log (TopicSubscriptionPushProcessor parity)."""
+        from zeebe_tpu.protocol.enums import ValueType
+
+        cluster3.await_leaders()
+        client = cluster3.client()
+        try:
+            sub = client.open_topic_subscription("audit", lambda pid, r: None)
+            client.deploy_model(order_process())
+            client.create_instance("order-process")
+            assert wait_until(
+                lambda: any(
+                    r.metadata.value_type == ValueType.WORKFLOW_INSTANCE
+                    for r in sub.records
+                ),
+                timeout=20,
+            ), [r.metadata.value_type for r in sub.records]
+            assert any(
+                r.metadata.value_type == ValueType.DEPLOYMENT for r in sub.records
+            )
+            sub.close()
+        finally:
+            client.close()
+
+    def test_resumes_after_leader_change(self, cluster3):
+        """After a leader kill the subscriber reopens on the new leader and
+        resumes from its last logged ack — no duplicate deliveries of acked
+        records (modulo the unacked in-flight window, which re-delivers)."""
+        cluster3.await_leaders()
+        client = cluster3.client()
+        try:
+            sub = client.open_topic_subscription("resume", lambda pid, r: None, ack_batch=1)
+            client.deploy_model(order_process())
+            client.create_instance("order-process")
+            assert wait_until(lambda: len(sub.records) >= 5, timeout=20)
+            assert wait_until(lambda: sub._since_ack == 0, timeout=10)
+            acked_through = sub.records[-1].position
+
+            old = cluster3.leader_of(0)
+            old_id = old.node_id
+            old.close()
+            del cluster3.brokers[old_id]
+            assert wait_until(lambda: cluster3.leader_of(0) is not None, timeout=30)
+
+            before = len(sub.records)
+            client.create_instance("order-process")
+            assert wait_until(lambda: len(sub.records) > before, timeout=30)
+            fresh = sub.records[before:]
+            # acks are at-least-once: the in-flight tail (acks not yet
+            # committed when the leader died) may re-deliver, but the
+            # subscription must RESUME near its progress, not rewind to the
+            # log start, and must deliver the new instance's records
+            assert fresh[0].position > 0, "subscription rewound to log start"
+            positions = [r.position for r in fresh]
+            assert positions == sorted(positions)
+            assert any(r.position > acked_through for r in fresh)
+            sub.close()
+        finally:
+            client.close()
+
+
 class TestMultiPartition:
     def test_cross_partition_message_correlation(self, tmp_path):
         """Message published on its hash-routed partition correlates to a

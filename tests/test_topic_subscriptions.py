@@ -1,0 +1,140 @@
+"""Topic subscription tests: durable server-push of a partition's records.
+
+Reference parity: ``broker-core/.../event/processor/
+TopicSubscriptionManagementProcessor`` (SUBSCRIBE/SUBSCRIBED lifecycle),
+``TopicSubscriptionPushProcessor:36`` (per-subscriber push with credit flow
+control), and ack records persisting consumer progress in the log
+(``TopicSubscriberState``). Tests mirror broker-core's
+TopicSubscriptionTest: open, receive all records, ack, reopen resumes,
+force-start rewinds.
+"""
+
+import tempfile
+
+import pytest
+
+from zeebe_tpu.gateway import JobWorker, TopicSubscriber, ZeebeClient
+from zeebe_tpu.models.bpmn.builder import Bpmn
+from zeebe_tpu.protocol.enums import ValueType
+from zeebe_tpu.protocol.intents import WorkflowInstanceIntent
+from zeebe_tpu.runtime import Broker, ControlledClock
+
+
+def order_process():
+    return (
+        Bpmn.create_process("order-process")
+        .start_event("start")
+        .service_task("collect-money", type="payment-service")
+        .end_event("end")
+        .done()
+    )
+
+
+@pytest.fixture
+def broker(tmp_path):
+    b = Broker(num_partitions=1, data_dir=str(tmp_path / "data"),
+               clock=ControlledClock())
+    yield b
+    b.close()
+
+
+class TestTopicSubscription:
+    def test_receives_all_records_of_the_partition(self, broker):
+        client = ZeebeClient(broker)
+        sub = TopicSubscriber(broker, "all-records")
+        client.deploy_model(order_process())
+        JobWorker(broker, "payment-service", lambda ctx: {"paid": True})
+        client.create_instance("order-process", {"orderId": 1})
+        broker.run_until_idle()
+
+        value_types = {r.metadata.value_type for r in sub.records}
+        assert ValueType.DEPLOYMENT in value_types
+        assert ValueType.WORKFLOW_INSTANCE in value_types
+        assert ValueType.JOB in value_types
+        # matches the log (minus subscription-admin records)
+        log_records = [
+            r for r in broker.records(0)
+            if r.metadata.value_type not in (ValueType.SUBSCRIBER, ValueType.SUBSCRIPTION)
+        ]
+        assert [r.position for r in sub.records] == [r.position for r in log_records]
+        sub.close()
+
+    def test_credit_flow_control_pauses_delivery(self, broker):
+        client = ZeebeClient(broker)
+        received = []
+        # no auto-ack: delivery must stall at the credit limit
+        handle = broker.open_topic_subscription(
+            "limited", lambda pid, r: received.append(r), credits=4
+        )
+        client.deploy_model(order_process())
+        client.create_instance("order-process")
+        broker.run_until_idle()
+        assert len(received) == 4, "delivery must stop at the credit limit"
+        # acking frees credits and delivery resumes
+        handle.ack(received[-1].position)
+        broker.run_until_idle()
+        assert len(received) > 4
+        handle.close()
+
+    def test_reopen_resumes_after_last_ack(self, tmp_path):
+        clock = ControlledClock()
+        data = str(tmp_path / "data")
+        broker = Broker(num_partitions=1, data_dir=data, clock=clock)
+        client = ZeebeClient(broker)
+        client.deploy_model(order_process())
+        client.create_instance("order-process")
+        sub = TopicSubscriber(broker, "resume-me", ack_batch=1)
+        broker.run_until_idle()
+        seen = len(sub.records)
+        assert seen > 0
+        last = sub.records[-1].position
+        sub.ack_all()
+        broker.run_until_idle()
+        sub.close()
+
+        # restart the broker: the ack survives in the log; a reopened
+        # subscription with the same name resumes AFTER the acked position
+        broker.close()
+        broker = Broker(num_partitions=1, data_dir=data, clock=clock)
+        client = ZeebeClient(broker)
+        sub2 = TopicSubscriber(broker, "resume-me")
+        client.create_instance("order-process")
+        broker.run_until_idle()
+        assert sub2.records, "new records must still arrive"
+        assert all(r.position > last for r in sub2.records), (
+            "resumed subscription must not re-deliver acked records"
+        )
+        sub2.close()
+        broker.close()
+
+    def test_force_start_rewinds_to_the_beginning(self, broker):
+        client = ZeebeClient(broker)
+        client.deploy_model(order_process())
+        sub = TopicSubscriber(broker, "rewind", ack_batch=1)
+        broker.run_until_idle()
+        sub.ack_all()
+        broker.run_until_idle()
+        sub.close()
+
+        sub2 = TopicSubscriber(broker, "rewind", force_start=True)
+        broker.run_until_idle()
+        assert sub2.records and sub2.records[0].position == 0
+        sub2.close()
+
+    def test_start_position_skips_history(self, broker):
+        client = ZeebeClient(broker)
+        client.deploy_model(order_process())
+        broker.run_until_idle()
+        cut = broker.partitions[0].log.next_position
+        sub = TopicSubscriber(broker, "tail-only", start_position=cut)
+        client.create_instance("order-process")
+        broker.run_until_idle()
+        assert sub.records
+        assert all(r.position >= cut for r in sub.records)
+        intents = [
+            WorkflowInstanceIntent(r.metadata.intent)
+            for r in sub.records
+            if r.metadata.value_type == ValueType.WORKFLOW_INSTANCE
+        ]
+        assert WorkflowInstanceIntent.CREATED in intents
+        sub.close()

@@ -46,6 +46,8 @@ from zeebe_tpu.protocol.intents import (
     JobIntent,
     MessageIntent,
     MessageSubscriptionIntent,
+    SubscriberIntent,
+    SubscriptionIntent,
     TimerIntent,
     WorkflowInstanceIntent as WI,
     WorkflowInstanceSubscriptionIntent,
@@ -315,6 +317,11 @@ class PartitionEngine:
         # timers (TPU-native)
         self.timers: Dict[int, TimerState] = {}
 
+        # topic subscription ack state (reference TopicSubscriberState:
+        # per-subscription last acked position, persisted in the log)
+        self.topic_sub_acks: Dict[str, int] = {}
+        self.topic_sub_keys = keyspace.topic_subscriber_keys()
+
         # log access for position-based reads (reference TypedStreamReader)
         self.records_by_position: Dict[int, Record] = {}
 
@@ -346,6 +353,7 @@ class PartitionEngine:
             "messages": self.messages,
             "message_subscriptions": self.message_subscriptions,
             "timers": self.timers,
+            "topic_sub_acks": self.topic_sub_acks,
             "last_processed_position": self.last_processed_position,
             # deployed workflows ride along so a restored partition does not
             # depend on replaying the deployment partition (reference:
@@ -368,6 +376,7 @@ class PartitionEngine:
         self.messages = state["messages"]
         self.message_subscriptions = state["message_subscriptions"]
         self.timers = state["timers"]
+        self.topic_sub_acks = state.get("topic_sub_acks", {})
         self.last_processed_position = state["last_processed_position"]
         self.repository.merge(state["workflows"])
 
@@ -403,9 +412,47 @@ class PartitionEngine:
             self._process_wi_subscription(record, out)
         elif vt == ValueType.TIMER and rt == RecordType.COMMAND:
             self._process_timer(record, out)
+        elif vt == ValueType.SUBSCRIBER and rt == RecordType.COMMAND:
+            self._process_topic_subscriber(record, out)
+        elif vt == ValueType.SUBSCRIPTION and rt == RecordType.COMMAND:
+            self._process_topic_subscription_ack(record, out)
 
         self.last_processed_position = record.position
         return out
+
+    # -- topic subscriptions (reference TopicSubscriptionManagementProcessor)
+    def _process_topic_subscriber(self, record: Record, out: ProcessingResult) -> None:
+        intent = SubscriberIntent(record.metadata.intent)
+        if intent != SubscriberIntent.SUBSCRIBE:
+            return
+        value = record.value
+        key = self.topic_sub_keys.next_key()
+        if value.force_start:
+            # reference: forceStart resets persisted progress
+            self.topic_sub_acks.pop(value.name, None)
+        subscribed = _record(
+            RecordType.EVENT, value.copy(), SubscriberIntent.SUBSCRIBED, key,
+            record.position,
+            {
+                "request_id": record.metadata.request_id,
+                "request_stream_id": record.metadata.request_stream_id,
+            },
+        )
+        out.written.append(subscribed)
+        out.responses.append(subscribed)
+
+    def _process_topic_subscription_ack(self, record: Record, out: ProcessingResult) -> None:
+        intent = SubscriptionIntent(record.metadata.intent)
+        if intent != SubscriptionIntent.ACKNOWLEDGE:
+            return
+        value = record.value
+        prior = self.topic_sub_acks.get(value.name, -1)
+        if value.ack_position > prior:
+            self.topic_sub_acks[value.name] = value.ack_position
+        out.written.append(
+            _record(RecordType.EVENT, value.copy(), SubscriptionIntent.ACKNOWLEDGED,
+                    record.key, record.position)
+        )
 
     # ------------------------------------------------------------------
     # writers (reference TypedStreamWriter / ElementInstanceWriter)

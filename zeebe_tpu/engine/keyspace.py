@@ -53,3 +53,10 @@ def deployment_keys() -> KeyGenerator:
 
 def topic_keys() -> KeyGenerator:
     return KeyGenerator(TOPIC_OFFSET)
+
+
+def topic_subscriber_keys() -> KeyGenerator:
+    """Reference: TopicSubscriptionManagementProcessor's own key space —
+    per-processor generators may overlap numerically across entity families
+    (keys are unique per (partition, processor), KeyGenerator.java:23)."""
+    return KeyGenerator(TOPIC_OFFSET)

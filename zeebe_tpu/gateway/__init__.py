@@ -1,6 +1,6 @@
 """Client API + job workers (reference: ``gateway/``, ``clients/``)."""
 
-from zeebe_tpu.gateway.client import ZeebeClient, ClientException
+from zeebe_tpu.gateway.client import ZeebeClient, ClientException, TopicSubscriber
 from zeebe_tpu.gateway.workers import JobWorker
 
-__all__ = ["ZeebeClient", "ClientException", "JobWorker"]
+__all__ = ["ZeebeClient", "ClientException", "JobWorker", "TopicSubscriber"]

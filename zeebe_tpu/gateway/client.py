@@ -215,3 +215,54 @@ class ZeebeClient:
             with_response=False,
         )
         self.broker.run_until_idle()
+
+
+class TopicSubscriber:
+    """Managed topic subscription (reference ``gateway/.../impl/subscription``
+    ``SubscriberGroup`` with credit acking): receives every committed record
+    of a partition, auto-acknowledges in batches, resumes from the persisted
+    ack position after reopen/restart."""
+
+    def __init__(
+        self,
+        broker,
+        name: str,
+        handler=None,
+        partition_id: int = 0,
+        start_position=None,
+        credits: int = 32,
+        force_start: bool = False,
+        ack_batch: int = 0,
+    ):
+        self.records = []
+        self._user_handler = handler
+        self._ack_batch = ack_batch or max(credits // 2, 1)
+        self._since_ack = 0
+        # pushes can arrive while open_topic_subscription is still running
+        # (the broker pumps synchronously); auto-acks wait for the handle
+        self.handle = None
+        self.handle = broker.open_topic_subscription(
+            name,
+            self._on_record,
+            partition_id=partition_id,
+            start_position=start_position,
+            credits=credits,
+            force_start=force_start,
+        )
+
+    def _on_record(self, partition_id: int, record) -> None:
+        self.records.append(record)
+        if self._user_handler is not None:
+            self._user_handler(partition_id, record)
+        self._since_ack += 1
+        if self.handle is not None and self._since_ack >= self._ack_batch:
+            self.handle.ack(record.position)
+            self._since_ack = 0
+
+    def ack_all(self) -> None:
+        if self.records:
+            self.handle.ack(self.records[-1].position)
+            self._since_ack = 0
+
+    def close(self) -> None:
+        self.handle.close()

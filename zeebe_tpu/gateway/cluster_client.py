@@ -257,6 +257,21 @@ class ClusterClient:
             partitions if partitions is not None else list(range(self.num_partitions)),
         )
 
+    def open_topic_subscription(
+        self,
+        name: str,
+        handler: Callable[[int, Record], None],
+        partition_id: int = 0,
+        start_position: Optional[int] = None,
+        credits: int = 32,
+        force_start: bool = False,
+        ack_batch: int = 0,
+    ) -> "RemoteTopicSubscriber":
+        return RemoteTopicSubscriber(
+            self, name, handler, partition_id, start_position, credits,
+            force_start, ack_batch,
+        )
+
     def close(self) -> None:
         self._closing = True
         self._push_thread.join(timeout=2)
@@ -392,3 +407,119 @@ def _correlation_hash(key: str) -> int:
     from zeebe_tpu.engine.interpreter import _correlation_hash as impl
 
     return impl(key)
+
+
+class RemoteTopicSubscriber:
+    """Wire-level topic subscription (reference SubscriberGroup): receives
+    pushed records down its own connection, auto-acks in batches, and
+    reopens on the new leader after a failover — resuming from the ack
+    position persisted in the partition log."""
+
+    def __init__(self, client, name, handler, partition_id, start_position,
+                 credits, force_start, ack_batch):
+        self.client = client
+        self.name = name
+        self.handler = handler
+        self.partition_id = partition_id
+        self.start_position = start_position
+        self.credits = credits
+        self.subscriber_key = next(_subscriber_keys)
+        self.records: List[Record] = []
+        self._ack_batch = ack_batch or max(credits // 2, 1)
+        self._since_ack = 0
+        self._subscribed_addr: Optional[RemoteAddress] = None
+        self._closed = False
+        client._push_handlers[self.subscriber_key] = self._on_record
+        self._open(force_start=force_start)
+        self._monitor = threading.Thread(
+            target=self._monitor_leader, name="zb-topic-sub-monitor", daemon=True
+        )
+        self._monitor.start()
+
+    def _request(self, body: dict, timeout_s: float = 5.0) -> bool:
+        addr = self.client._leader_for(self.partition_id)
+        if addr is None:
+            return False
+        try:
+            payload = self.client.transport.send_request(
+                addr, msgpack.pack(body), timeout_ms=int(timeout_s * 1000)
+            ).join(timeout_s + 1)
+            if msgpack.unpack(payload).get("t") == "ok":
+                self._subscribed_addr = addr
+                return True
+        except (TransportError, ValueError, TimeoutError):
+            pass
+        with self.client._lock:
+            self.client._leaders.pop(self.partition_id, None)
+        return False
+
+    def _open(self, force_start: bool = False) -> None:
+        deadline = time.monotonic() + 10
+        body = {
+            "t": "topic-subscription",
+            "action": "open",
+            "partition": self.partition_id,
+            "subscriber_key": self.subscriber_key,
+            "name": self.name,
+            "start_position": -1 if self.start_position is None else self.start_position,
+            "credits": self.credits,
+            "force_start": force_start,
+        }
+        while time.monotonic() < deadline and not self._closed:
+            if self._request(body):
+                return
+            time.sleep(0.05)
+        if not self._closed:
+            raise TransportError(f"could not open topic subscription {self.name!r}")
+
+    def _monitor_leader(self) -> None:
+        # reference: the client subscription manager reopens subscriptions on
+        # partition leader change; resumption point comes from logged acks
+        while not self._closed and not self.client._closing:
+            time.sleep(0.25)
+            try:
+                leaders = self.client.refresh_topology()
+            except Exception:  # noqa: BLE001
+                continue
+            addr = leaders.get(self.partition_id)
+            if addr is not None and addr != self._subscribed_addr and not self._closed:
+                try:
+                    self._open()
+                except TransportError:
+                    pass
+
+    def _on_record(self, partition_id: int, record: Record) -> None:
+        self.records.append(record)
+        if self.handler is not None:
+            self.handler(partition_id, record)
+        self._since_ack += 1
+        if self._since_ack >= self._ack_batch:
+            self.ack(record.position)
+
+    def ack(self, position: int) -> None:
+        self._since_ack = 0
+        self._request(
+            {
+                "t": "topic-subscription",
+                "action": "ack",
+                "partition": self.partition_id,
+                "subscriber_key": self.subscriber_key,
+                "name": self.name,
+                "position": position,
+            },
+            timeout_s=2.0,
+        )
+
+    def close(self) -> None:
+        self._closed = True
+        self.client._push_handlers.pop(self.subscriber_key, None)
+        self._request(
+            {
+                "t": "topic-subscription",
+                "action": "close",
+                "partition": self.partition_id,
+                "subscriber_key": self.subscriber_key,
+                "name": self.name,
+            },
+            timeout_s=1.0,
+        )

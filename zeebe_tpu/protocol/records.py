@@ -245,6 +245,32 @@ class TimerRecord(RecordValue):
 
 
 @dataclasses.dataclass
+class TopicSubscriberRecord(RecordValue):
+    """Topic subscription lifecycle (reference
+    broker-core/.../event/TopicSubscriberEvent.java): SUBSCRIBE command
+    opens a per-subscriber push stream from ``start_position``."""
+
+    VALUE_TYPE: ClassVar[ValueType] = ValueType.SUBSCRIBER
+
+    name: str = _f("name", "")
+    start_position: int = _f("startPosition", -1)
+    buffer_size: int = _f("bufferSize", 32)
+    force_start: bool = _f("forceStart", False)
+
+
+@dataclasses.dataclass
+class TopicSubscriptionRecord(RecordValue):
+    """Topic subscription ack state (reference
+    broker-core/.../event/TopicSubscriptionEvent.java): ACKNOWLEDGE commands
+    persist the consumer's progress in the log itself."""
+
+    VALUE_TYPE: ClassVar[ValueType] = ValueType.SUBSCRIPTION
+
+    name: str = _f("name", "")
+    ack_position: int = _f("ackPosition", -1)
+
+
+@dataclasses.dataclass
 class NoopRecord(RecordValue):
     """Empty value — raft initial/no-op entries (reference
     LeaderCommitInitialEvent appends a NOOP record on leader election)."""
@@ -263,6 +289,8 @@ VALUE_CLASS_BY_TYPE = {
     ValueType.DEPLOYMENT: DeploymentRecord,
     ValueType.TOPIC: TopicRecord,
     ValueType.TIMER: TimerRecord,
+    ValueType.SUBSCRIBER: TopicSubscriberRecord,
+    ValueType.SUBSCRIPTION: TopicSubscriptionRecord,
 }
 
 

@@ -23,6 +23,7 @@ from zeebe_tpu.engine.interpreter import PartitionEngine, WorkflowRepository
 from zeebe_tpu.log import LogStream, SegmentedLogStorage
 from zeebe_tpu.log.snapshot import SnapshotController, SnapshotMetadata, SnapshotStorage
 from zeebe_tpu.protocol.enums import RecordType, ValueType
+from zeebe_tpu.protocol.intents import SubscriberIntent, SubscriptionIntent
 from zeebe_tpu.protocol.records import Record, stamp_source_positions
 from zeebe_tpu.runtime.clock import SystemClock
 
@@ -48,6 +49,73 @@ class Partition:
         return self.next_read_position <= self.log.commit_position
 
 
+class TopicSubscriptionHandle:
+    """Per-subscriber push stream (reference TopicSubscriptionPushProcessor):
+    a read-only cursor over the partition's committed records with
+    credit-bound delivery; acks persist progress as records in the log."""
+
+    def __init__(self, broker, partition_id, name, handler, subscriber_key, cursor, credits):
+        self.broker = broker
+        self.partition_id = partition_id
+        self.name = name
+        self.handler = handler
+        self.subscriber_key = subscriber_key
+        self.cursor = cursor
+        self.capacity = credits
+        self._unacked: List[int] = []
+        self.closed = False
+
+    def pump(self) -> bool:
+        """Push committed records up to the credit limit. Returns True if
+        anything was delivered."""
+        if self.closed:
+            return False
+        partition = self.broker.partitions[self.partition_id]
+        pushed = False
+        while len(self._unacked) < self.capacity:
+            reader = partition.log.reader(self.cursor)
+            batch = reader.read_committed()
+            if not batch:
+                break
+            advanced = False
+            for record in batch:
+                if len(self._unacked) >= self.capacity:
+                    break
+                self.cursor = record.position + 1
+                advanced = True
+                # subscription-admin records are not re-delivered: pushing
+                # them would make every ack generate further pushes
+                if record.metadata.value_type in (
+                    ValueType.SUBSCRIBER, ValueType.SUBSCRIPTION,
+                ):
+                    continue
+                self._unacked.append(record.position)
+                self.handler(self.partition_id, record)
+                pushed = True
+            if not advanced:
+                break
+        return pushed
+
+    def ack(self, position: int) -> None:
+        """Acknowledge progress up to ``position`` (persisted in the log;
+        restart/reopen resumes after it) and free credits."""
+        from zeebe_tpu.protocol.records import TopicSubscriptionRecord
+
+        self.broker.write_command(
+            self.partition_id,
+            TopicSubscriptionRecord(name=self.name, ack_position=position),
+            SubscriptionIntent.ACKNOWLEDGE,
+            key=self.subscriber_key,
+            with_response=False,
+        )
+        self._unacked = [p for p in self._unacked if p > position]
+
+    def close(self) -> None:
+        self.closed = True
+        if self in self.broker._topic_subscriptions:
+            self.broker._topic_subscriptions.remove(self)
+
+
 class Broker:
     """In-process broker (reference: EmbeddedBrokerRule-style single JVM)."""
 
@@ -66,6 +134,7 @@ class Broker:
         self._responses: Dict[int, Record] = {}
         self._push_listeners: Dict[int, Callable[[Record], None]] = {}
         self._record_listeners: List[Callable[[int, Record], None]] = []
+        self._topic_subscriptions: List[TopicSubscriptionHandle] = []
         self._rr_partition = 0
 
         factory = engine_factory or (
@@ -185,8 +254,66 @@ class Broker:
         self._push_listeners[subscriber_key] = listener
 
     def on_record(self, listener: Callable[[int, Record], None]) -> None:
-        """Topic-subscription analogue: observe every committed record."""
+        """In-process record tap (tests/debug; the durable, credit-controlled
+        variant is ``open_topic_subscription``)."""
         self._record_listeners.append(listener)
+
+    # -- topic subscriptions (reference TopicSubscriptionManagementProcessor
+    # + per-subscriber TopicSubscriptionPushProcessor:36) -------------------
+    def open_topic_subscription(
+        self,
+        name: str,
+        handler: Callable[[int, Record], None],
+        partition_id: int = 0,
+        start_position: Optional[int] = None,
+        credits: int = 32,
+        force_start: bool = False,
+    ) -> "TopicSubscriptionHandle":
+        """Open a durable push subscription over a partition's record stream.
+        Resumes from the last ACKNOWLEDGEd position persisted in the log
+        unless ``force_start``; otherwise starts at ``start_position`` (or
+        0). Push pace is credit-bound; ``handle.ack(position)`` persists
+        progress and replenishes credits."""
+        from zeebe_tpu.protocol.records import TopicSubscriberRecord
+
+        request_id = self.write_command(
+            partition_id,
+            TopicSubscriberRecord(
+                name=name,
+                start_position=-1 if start_position is None else start_position,
+                buffer_size=credits,
+                force_start=force_start,
+            ),
+            SubscriberIntent.SUBSCRIBE,
+        )
+        self.run_until_idle()
+        response = self.take_response(request_id)
+        engine = self.partitions[partition_id].engine
+        acked = engine.topic_sub_acks.get(name)
+        if acked is not None and not force_start:
+            cursor = acked + 1  # resume after the last acknowledged record
+        elif start_position is not None:
+            cursor = start_position
+        else:
+            cursor = 0
+        handle = TopicSubscriptionHandle(
+            broker=self,
+            partition_id=partition_id,
+            name=name,
+            handler=handler,
+            subscriber_key=response.key if response is not None else -1,
+            cursor=cursor,
+            credits=credits,
+        )
+        self._topic_subscriptions.append(handle)
+        self._pump_topic_subscriptions()
+        return handle
+
+    def _pump_topic_subscriptions(self) -> bool:
+        pushed = False
+        for handle in list(self._topic_subscriptions):
+            pushed = handle.pump() or pushed
+        return pushed
 
     # -- processing loop ----------------------------------------------------
     def run_until_idle(self, max_iterations: int = 100_000) -> int:
@@ -209,6 +336,10 @@ class Broker:
                         if processed > max_iterations:
                             raise RuntimeError("broker did not reach quiescence")
                     progress = True
+            # deliver to topic subscriptions; their handlers may write acks
+            # or commands, which the next pass processes
+            if self._pump_topic_subscriptions():
+                progress = True
         return processed
 
     def _process_one(self, partition: Partition, record: Record) -> None:

@@ -123,6 +123,9 @@ class PartitionServer:
         )
         self.engine: Optional[PartitionEngine] = None
         self.next_read_position = 0
+        # subscriber_key → topic-subscription pusher state (leader-local;
+        # clients reopen on leader change and resume from logged acks)
+        self.topic_pushers: Dict[int, dict] = {}
         self.is_leader = False
         self._processing_scheduled = False
         self._fetch_attempted = False  # one fetch try per parked record
@@ -212,6 +215,37 @@ class PartitionServer:
             for subscriber_key, push in result.pushes:
                 self.broker.push_to_subscriber(subscriber_key, self.partition_id, push)
             self.broker.metrics_events_processed.inc()
+        self.pump_topic_subscriptions()
+
+    def pump_topic_subscriptions(self) -> None:
+        """Deliver committed records to open topic subscriptions with credit
+        flow control (reference TopicSubscriptionPushProcessor:36)."""
+        from zeebe_tpu.protocol.enums import ValueType
+
+        for key, pusher in list(self.topic_pushers.items()):
+            while len(pusher["unacked"]) < pusher["capacity"]:
+                batch = self.log.reader(pusher["cursor"]).read_committed()
+                if not batch:
+                    break
+                advanced = False
+                for record in batch:
+                    if len(pusher["unacked"]) >= pusher["capacity"]:
+                        break
+                    pusher["cursor"] = record.position + 1
+                    advanced = True
+                    if record.metadata.value_type in (
+                        ValueType.SUBSCRIBER, ValueType.SUBSCRIPTION,
+                    ):
+                        continue
+                    if not pusher["push"](record):
+                        # dead connection: the close listener removes the
+                        # pusher; stop delivering now
+                        self.topic_pushers.pop(key, None)
+                        advanced = False
+                        break
+                    pusher["unacked"].append(record.position)
+                if not advanced:
+                    break
 
     def _needs_workflow_fetch(self, record) -> bool:
         from zeebe_tpu.protocol.enums import RecordType, ValueType
@@ -436,9 +470,110 @@ class ClusterBroker(Actor):
             result = ActorFuture()
             self.actor.run(lambda: self._handle_job_subscription(msg, conn, result))
             return result
+        if t == "topic-subscription":
+            result = ActorFuture()
+            self.actor.run(lambda: self._handle_topic_subscription(msg, conn, result))
+            return result
         if t == "fetch-workflow":
             return self.actor.call(lambda: self._handle_fetch_workflow(msg))
         return None
+
+    # -- topic subscriptions over the client API ----------------------------
+    def _handle_topic_subscription(self, msg: dict, conn, result: ActorFuture) -> None:
+        """reference: TopicSubscriptionManagementProcessor — SUBSCRIBE opens a
+        per-subscriber push processor on the partition leader; ACKNOWLEDGE
+        commands persist progress in the log so a reopen (same name) resumes
+        where the consumer left off, on any future leader."""
+        from zeebe_tpu.protocol.enums import RecordType
+        from zeebe_tpu.protocol.intents import SubscriberIntent, SubscriptionIntent
+        from zeebe_tpu.protocol.metadata import RecordMetadata
+        from zeebe_tpu.protocol.records import (
+            TopicSubscriberRecord,
+            TopicSubscriptionRecord,
+        )
+
+        action = msg.get("action")
+        partition_id = int(msg.get("partition", 0))
+        server = self.partitions.get(partition_id)
+        if server is None or not server.is_leader or server.engine is None:
+            result.complete(msgpack.pack({"t": "error", "code": "NOT_LEADER"}))
+            return
+        name = str(msg.get("name", ""))
+        subscriber_key = int(msg.get("subscriber_key", -1))
+        if action == "open":
+            start_position = int(msg.get("start_position", -1))
+            force_start = bool(msg.get("force_start", False))
+            acked = server.engine.topic_sub_acks.get(name)
+            if acked is not None and not force_start:
+                cursor = acked + 1
+            elif start_position >= 0:
+                cursor = start_position
+            else:
+                cursor = 0
+            # durable audit record (+ ack reset on force_start)
+            server.raft.append([
+                Record(
+                    metadata=RecordMetadata(
+                        record_type=RecordType.COMMAND,
+                        value_type=TopicSubscriberRecord.VALUE_TYPE,
+                        intent=int(SubscriberIntent.SUBSCRIBE),
+                    ),
+                    value=TopicSubscriberRecord(
+                        name=name, start_position=start_position,
+                        buffer_size=int(msg.get("credits", 32)),
+                        force_start=force_start,
+                    ),
+                )
+            ])
+            if conn is not None:
+                def push(record, _conn=conn, _key=subscriber_key, _pid=partition_id):
+                    return _conn.push(
+                        msgpack.pack(
+                            {
+                                "t": "pushed-record",
+                                "partition": _pid,
+                                "subscriber_key": _key,
+                                "frame": codec.encode_record(record),
+                            }
+                        )
+                    )
+
+                server.topic_pushers[subscriber_key] = {
+                    "name": name,
+                    "cursor": cursor,
+                    "capacity": int(msg.get("credits", 32)),
+                    "unacked": [],
+                    "push": push,
+                }
+                conn.on_close(
+                    lambda: self._drop_topic_subscription(partition_id, subscriber_key)
+                )
+                server.pump_topic_subscriptions()
+        elif action == "ack":
+            position = int(msg.get("position", -1))
+            server.raft.append([
+                Record(
+                    key=subscriber_key,
+                    metadata=RecordMetadata(
+                        record_type=RecordType.COMMAND,
+                        value_type=TopicSubscriptionRecord.VALUE_TYPE,
+                        intent=int(SubscriptionIntent.ACKNOWLEDGE),
+                    ),
+                    value=TopicSubscriptionRecord(name=name, ack_position=position),
+                )
+            ])
+            pusher = server.topic_pushers.get(subscriber_key)
+            if pusher is not None:
+                pusher["unacked"] = [p for p in pusher["unacked"] if p > position]
+                server.pump_topic_subscriptions()
+        elif action == "close":
+            self._drop_topic_subscription(partition_id, subscriber_key)
+        result.complete(msgpack.pack({"t": "ok"}))
+
+    def _drop_topic_subscription(self, partition_id: int, subscriber_key: int) -> None:
+        server = self.partitions.get(partition_id)
+        if server is not None:
+            server.topic_pushers.pop(subscriber_key, None)
 
     # -- deployment distribution (reference FetchWorkflowRequest served by
     # the system partition's WorkflowRepositoryService; WorkflowCache on the
