@@ -312,6 +312,67 @@ class TestTopicSubscriptions:
             client.close()
 
 
+class TestTopicOrchestration:
+    def test_create_topic_brings_up_partitions_on_members(self, cluster3):
+        """Reference TopicCreationService flow: (TOPIC, CREATE) on the system
+        partition assigns partition ids, partitions come up on selected
+        members, and the client is answered once every partition is led."""
+        cluster3.await_leaders()
+        client = cluster3.client()
+        try:
+            created = client.create_topic("orders", partitions=2, replication_factor=2)
+            pids = created.value.partition_ids
+            assert len(pids) == 2
+            assert all(pid >= 1 for pid in pids)
+
+            # every new partition has a leader somewhere in the cluster
+            def all_led():
+                return all(
+                    any(
+                        pid in b.partitions and b.partitions[pid].is_leader
+                        for b in cluster3.brokers.values()
+                    )
+                    for pid in pids
+                )
+
+            assert wait_until(all_led, timeout=20)
+
+            # replication factor: each partition exists on 2 brokers
+            for pid in pids:
+                holders = [
+                    b.node_id for b in cluster3.brokers.values() if pid in b.partitions
+                ]
+                assert len(holders) == 2, holders
+
+            # the new partitions process workflow instances end to end
+            # (deployment fetched on demand from the system partition)
+            client.deploy_model(order_process())
+            done = []
+            worker = client.open_job_worker(
+                "payment-service",
+                lambda pid, rec: done.append(rec.key) or {},
+                partitions=pids,
+            )
+            for pid in pids:
+                client.create_instance("order-process", partition_id=pid)
+            assert wait_until(lambda: len(done) == 2, timeout=30), done
+            worker.close()
+        finally:
+            client.close()
+
+    def test_duplicate_topic_rejected(self, cluster3):
+        from zeebe_tpu.gateway.client import ClientException
+
+        cluster3.await_leaders()
+        client = cluster3.client()
+        try:
+            client.create_topic("dup-topic", partitions=1)
+            with pytest.raises(ClientException, match="already exists"):
+                client.create_topic("dup-topic", partitions=1)
+        finally:
+            client.close()
+
+
 class TestMultiPartition:
     def test_cross_partition_message_correlation(self, tmp_path):
         """Message published on its hash-routed partition correlates to a

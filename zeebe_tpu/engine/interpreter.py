@@ -322,6 +322,13 @@ class PartitionEngine:
         self.topic_sub_acks: Dict[str, int] = {}
         self.topic_sub_keys = keyspace.topic_subscriber_keys()
 
+        # topic orchestration state, system partition only (reference
+        # KnownTopics + the IdGenerator stream processor: partition ids are
+        # assigned deterministically from replicated state)
+        self.topics: Dict[str, dict] = {}
+        self.topic_keys = keyspace.topic_keys()
+        self.next_partition_id = 1  # 0 is the system partition
+
         # log access for position-based reads (reference TypedStreamReader)
         self.records_by_position: Dict[int, Record] = {}
 
@@ -354,6 +361,8 @@ class PartitionEngine:
             "message_subscriptions": self.message_subscriptions,
             "timers": self.timers,
             "topic_sub_acks": self.topic_sub_acks,
+            "topics": self.topics,
+            "next_partition_id": self.next_partition_id,
             "last_processed_position": self.last_processed_position,
             # deployed workflows ride along so a restored partition does not
             # depend on replaying the deployment partition (reference:
@@ -377,6 +386,8 @@ class PartitionEngine:
         self.message_subscriptions = state["message_subscriptions"]
         self.timers = state["timers"]
         self.topic_sub_acks = state.get("topic_sub_acks", {})
+        self.topics = state.get("topics", {})
+        self.next_partition_id = state.get("next_partition_id", 1)
         self.last_processed_position = state["last_processed_position"]
         self.repository.merge(state["workflows"])
 
@@ -416,9 +427,74 @@ class PartitionEngine:
             self._process_topic_subscriber(record, out)
         elif vt == ValueType.SUBSCRIPTION and rt == RecordType.COMMAND:
             self._process_topic_subscription_ack(record, out)
+        elif vt == ValueType.TOPIC and rt == RecordType.COMMAND:
+            self._process_topic(record, out)
 
         self.last_processed_position = record.position
         return out
+
+    # -- topic orchestration, system partition (reference
+    # TopicCreateProcessor / TopicCreatedProcessor + IdGenerator) ----------
+    def _process_topic(self, record: Record, out: ProcessingResult) -> None:
+        from zeebe_tpu.protocol.intents import TopicIntent
+
+        intent = TopicIntent(record.metadata.intent)
+        value = record.value
+        request_meta = {
+            "request_id": record.metadata.request_id,
+            "request_stream_id": record.metadata.request_stream_id,
+        }
+        if intent == TopicIntent.CREATE:
+            if not value.name:
+                self._topic_rejection(record, "topic name must not be empty", out)
+                return
+            if value.partitions <= 0:
+                self._topic_rejection(record, "partition count must be positive", out)
+                return
+            if value.name in self.topics:
+                self._topic_rejection(record, f"topic '{value.name}' already exists", out)
+                return
+            created = value.copy()
+            # deterministic id assignment from replicated state (reference
+            # IdGenerator: ids survive failover because they come from the
+            # replicated log, never from local counters)
+            created.partition_ids = [
+                self.next_partition_id + i for i in range(value.partitions)
+            ]
+            self.next_partition_id += value.partitions
+            key = self.topic_keys.next_key()
+            self.topics[created.name] = {"record": created, "state": "CREATING"}
+            # CREATING carries the client request metadata: the response is
+            # deferred until CREATE_COMPLETE confirms leaders exist
+            out.written.append(
+                _record(RecordType.EVENT, created.copy(), TopicIntent.CREATING,
+                        key, record.position, request_meta)
+            )
+        elif intent == TopicIntent.CREATE_COMPLETE:
+            topic = self.topics.get(value.name)
+            if topic is None or topic["state"] == "CREATED":
+                return
+            topic["state"] = "CREATED"
+            done = _record(
+                RecordType.EVENT, topic["record"].copy(), TopicIntent.CREATED,
+                record.key, record.position, request_meta,
+            )
+            out.written.append(done)
+            out.responses.append(done)
+
+    def _topic_rejection(self, record: Record, reason: str, out: ProcessingResult) -> None:
+        rejection = _record(
+            RecordType.COMMAND_REJECTION, record.value.copy(),
+            record.metadata.intent, record.key, record.position,
+            {
+                "rejection_type": RejectionType.BAD_VALUE,
+                "rejection_reason": reason,
+                "request_id": record.metadata.request_id,
+                "request_stream_id": record.metadata.request_stream_id,
+            },
+        )
+        out.written.append(rejection)
+        out.responses.append(rejection)
 
     # -- topic subscriptions (reference TopicSubscriptionManagementProcessor)
     def _process_topic_subscriber(self, record: Record, out: ProcessingResult) -> None:

@@ -161,6 +161,31 @@ class ClusterClient:
             time.sleep(0.05)
         raise TransportError(f"command failed: {last_error}")
 
+    # -- topics (reference TopicClient.newCreateTopicCommand) --------------
+    def create_topic(
+        self, name: str, partitions: int = 1, replication_factor: int = 1
+    ) -> Record:
+        """Create a topic: the system partition assigns partition ids,
+        orchestrates partition creation on the least-loaded brokers, and
+        answers once every partition has a leader. The returned record's
+        ``value.partition_ids`` are routable with ``partition_id=``."""
+        from zeebe_tpu.protocol.intents import TopicIntent
+        from zeebe_tpu.protocol.records import TopicRecord
+
+        response = self.send_command(
+            0,
+            TopicRecord(
+                name=name, partitions=partitions,
+                replication_factor=replication_factor,
+            ),
+            TopicIntent.CREATE,
+        )
+        # widen round-robin routing over the new partitions
+        self.num_partitions = max(
+            self.num_partitions, max(response.value.partition_ids, default=0) + 1
+        )
+        return response
+
     # -- commands (reference WorkflowClient / JobClient / TopicClient) -----
     def deploy_model(self, model: BpmnModel, resource_name: str = "process.bpmn") -> Record:
         deployment = DeploymentRecord(

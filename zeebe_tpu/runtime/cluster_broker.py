@@ -170,6 +170,17 @@ class PartitionServer:
             self.next_read_position = record.position + 1
         self.is_leader = True
         self.broker.on_partition_leader(self.partition_id, term)
+        if self.partition_id == 0:
+            # topics caught mid-creation by the failover: resume
+            # orchestration (reference: pending topic tracking re-drives
+            # partition creation on the new system-partition leader)
+            from zeebe_tpu.protocol.metadata import RecordMetadata
+
+            for name, topic in self.engine.topics.items():
+                if topic["state"] == "CREATING":
+                    self.broker.start_topic_orchestration(
+                        Record(metadata=RecordMetadata(), value=topic["record"])
+                    )
         self._schedule_processing()
 
     def _uninstall_leader(self) -> None:
@@ -215,7 +226,20 @@ class PartitionServer:
             for subscriber_key, push in result.pushes:
                 self.broker.push_to_subscriber(subscriber_key, self.partition_id, push)
             self.broker.metrics_events_processed.inc()
+            self._maybe_orchestrate_topic(record)
         self.pump_topic_subscriptions()
+
+    def _maybe_orchestrate_topic(self, record) -> None:
+        from zeebe_tpu.protocol.enums import RecordType, ValueType
+        from zeebe_tpu.protocol.intents import TopicIntent
+
+        if (
+            self.partition_id == 0
+            and record.metadata.value_type == ValueType.TOPIC
+            and record.metadata.record_type == RecordType.EVENT
+            and record.metadata.intent == int(TopicIntent.CREATING)
+        ):
+            self.broker.start_topic_orchestration(record)
 
     def pump_topic_subscriptions(self) -> None:
         """Deliver committed records to open topic subscriptions with credit
@@ -351,6 +375,7 @@ class ClusterBroker(Actor):
             host=cfg.network.host,
         )
         self.gossip.on_custom_event("partition-leader", self._on_leader_event)
+        self.gossip.on_custom_event("node-info", self._on_node_info_event)
 
         # client + subscription servers
         self.client_server = ServerTransport(
@@ -374,6 +399,26 @@ class ClusterBroker(Actor):
         self.actor_control = self.actor
         self.actor.run_at_fixed_rate(self._snapshot_period_ms, self.snapshot_all)
         self.actor.run_at_fixed_rate(100, self._tick_engines)
+        # disseminate this node's client endpoint so the topic orchestrator
+        # can reach any member over the management plane (reference: local
+        # node info broadcast via gossip custom events)
+        self._publish_node_info()
+        self.actor.run_at_fixed_rate(2000, self._publish_node_info)
+
+    def _publish_node_info(self) -> None:
+        self.gossip.publish_custom_event(
+            "node-info",
+            {
+                "node": self.node_id,
+                "client": [self.client_address.host, self.client_address.port],
+            },
+        )
+
+    def _on_node_info_event(self, _sender: str, payload) -> None:
+        if isinstance(payload, dict) and payload.get("node"):
+            self.topology.members[str(payload["node"])] = list(
+                payload.get("client", ["", 0])
+            )
 
     @property
     def gossip_address(self) -> RemoteAddress:
@@ -476,6 +521,10 @@ class ClusterBroker(Actor):
             return result
         if t == "fetch-workflow":
             return self.actor.call(lambda: self._handle_fetch_workflow(msg))
+        if t == "create-partition":
+            return self._handle_create_partition(msg)
+        if t == "bootstrap-partition":
+            return self._handle_bootstrap_partition(msg)
         return None
 
     # -- topic subscriptions over the client API ----------------------------
@@ -574,6 +623,139 @@ class ClusterBroker(Actor):
         server = self.partitions.get(partition_id)
         if server is not None:
             server.topic_pushers.pop(subscriber_key, None)
+
+    # -- topic orchestration (reference TopicCreationService + NodeSelector
+    # + CreatePartitionRequest → ManagementApiRequestHandler) ---------------
+    def start_topic_orchestration(self, creating_record: Record) -> None:
+        """On the system-partition leader: bring the CREATING topic's
+        partitions up on the least-loaded members, then confirm with a
+        CREATE_COMPLETE command (the engine answers the waiting client)."""
+        record = creating_record
+        threading.Thread(
+            target=self._orchestrate_topic, args=(record,), daemon=True,
+            name=f"zb-topic-orchestrator-{record.value.name}",
+        ).start()
+
+    def _node_loads(self) -> Dict[str, int]:
+        loads: Dict[str, int] = {self.node_id: 0}
+        for node in list(self.topology.members):
+            loads.setdefault(node, 0)
+        with self.topology._lock:
+            for _pid, entry in self.topology.partition_leaders.items():
+                loads[entry[0]] = loads.get(entry[0], 0) + 1
+        return loads
+
+    def _member_client_addr(self, node: str) -> Optional[RemoteAddress]:
+        if node == self.node_id:
+            return self.client_address
+        entry = self.topology.members.get(node)
+        if not entry or not entry[0]:
+            return None
+        return RemoteAddress(entry[0], int(entry[1]))
+
+    def _orchestrate_topic(self, record: Record) -> None:
+        import time as _time
+
+        value = record.value
+        replication = max(1, int(value.replication_factor))
+        deadline = _time.monotonic() + 60.0
+        loads = self._node_loads()
+        try:
+            for pid in list(value.partition_ids):
+                # NodeSelector: fewest-led-partitions first, stable order
+                candidates = sorted(loads, key=lambda n: (loads[n], n))
+                chosen = candidates[: min(replication, len(candidates))]
+                raft_addrs: Dict[str, list] = {}
+                for node in chosen:
+                    addr = self._member_client_addr(node)
+                    if addr is None:
+                        continue
+                    rsp = msgpack.unpack(
+                        self.client_transport.send_request(
+                            addr,
+                            msgpack.pack({"t": "create-partition", "partition": pid}),
+                            timeout_ms=5000,
+                        ).join(6)
+                    )
+                    if rsp.get("t") == "ok":
+                        raft_addrs[node] = list(rsp.get("raft", ["", 0]))
+                for node in list(raft_addrs):
+                    addr = self._member_client_addr(node)
+                    peers = {n: a for n, a in raft_addrs.items() if n != node}
+                    self.client_transport.send_request(
+                        addr,
+                        msgpack.pack(
+                            {
+                                "t": "bootstrap-partition",
+                                "partition": pid,
+                                "members": peers,
+                            }
+                        ),
+                        timeout_ms=5000,
+                    ).join(6)
+                    loads[node] = loads.get(node, 0) + 1
+
+            # leaders elected for every partition? then confirm
+            def all_led():
+                return all(
+                    self.topology.leader_address(pid) is not None
+                    for pid in value.partition_ids
+                )
+
+            while _time.monotonic() < deadline and not self._closing:
+                if all_led():
+                    break
+                _time.sleep(0.05)
+            if not all_led():
+                return  # recovery re-triggers orchestration for CREATING topics
+            server = self.partitions.get(0)
+            if server is None or not server.is_leader:
+                return
+            from zeebe_tpu.protocol.intents import TopicIntent
+            from zeebe_tpu.protocol.metadata import RecordMetadata
+            from zeebe_tpu.protocol.records import TopicRecord
+            from zeebe_tpu.protocol.enums import RecordType as RT
+
+            server.raft.append([
+                Record(
+                    key=record.key,
+                    metadata=RecordMetadata(
+                        record_type=RT.COMMAND,
+                        value_type=TopicRecord.VALUE_TYPE,
+                        intent=int(TopicIntent.CREATE_COMPLETE),
+                        request_id=record.metadata.request_id,
+                        request_stream_id=record.metadata.request_stream_id,
+                    ),
+                    value=TopicRecord(name=value.name),
+                )
+            ])
+        except Exception:  # noqa: BLE001 - orchestration retried on recovery
+            import traceback
+
+            traceback.print_exc()
+
+    def _handle_create_partition(self, msg: dict):
+        partition_id = int(msg.get("partition", 0))
+        result = ActorFuture()
+        self.open_partition(partition_id).on_complete(
+            lambda f: result.complete(
+                msgpack.pack(
+                    {"t": "ok", "raft": [f._value.host, f._value.port]}
+                    if f._exception is None
+                    else {"t": "error", "code": "CREATE_FAILED"}
+                )
+            )
+        )
+        return result
+
+    def _handle_bootstrap_partition(self, msg: dict):
+        partition_id = int(msg.get("partition", 0))
+        members = {
+            str(node): RemoteAddress(a[0], int(a[1]))
+            for node, a in dict(msg.get("members", {})).items()
+        }
+        self.bootstrap_partition(partition_id, members)
+        return msgpack.pack({"t": "ok"})
 
     # -- deployment distribution (reference FetchWorkflowRequest served by
     # the system partition's WorkflowRepositoryService; WorkflowCache on the
