@@ -45,6 +45,7 @@ def make_cfg(node_id, partitions=1):
     cfg.gossip.probe_interval_ms = 50
     cfg.gossip.probe_timeout_ms = 250
     cfg.gossip.sync_interval_ms = 500
+    cfg.data.snapshot_replication_period_ms = 300
     cfg.metrics.enabled = False
     return cfg
 
@@ -371,6 +372,55 @@ class TestTopicOrchestration:
                 client.create_topic("dup-topic", partitions=1)
         finally:
             client.close()
+
+
+class TestSnapshotReplication:
+    def test_followers_fetch_leader_snapshots(self, tmp_path):
+        """SnapshotReplicationTest parity: the leader's snapshot propagates
+        to followers chunk-wise; after a leader kill the new leader recovers
+        from the replicated snapshot (not a full-log replay)."""
+        cluster = ClusterUnderTest(tmp_path, n_brokers=3, partitions=1)
+        try:
+            cluster.await_leaders()
+            client = cluster.client()
+            try:
+                client.deploy_model(order_process())
+                client.create_instance("order-process")
+                leader = cluster.leader_of(0)
+                leader.snapshot_all()
+
+                def followers_have_snapshot():
+                    return all(
+                        b.partitions[0].snapshots.storage.list()
+                        for b in cluster.brokers.values()
+                    )
+
+                assert wait_until(followers_have_snapshot, timeout=20), {
+                    nid: len(b.partitions[0].snapshots.storage.list())
+                    for nid, b in cluster.brokers.items()
+                }
+
+                # kill the leader; the successor restores from the
+                # replicated snapshot and keeps serving
+                old_id = leader.node_id
+                leader.close()
+                del cluster.brokers[old_id]
+                assert wait_until(lambda: cluster.leader_of(0) is not None, 30)
+                new_leader = cluster.leader_of(0)
+                assert wait_until(
+                    lambda: new_leader.repository.latest("order-process") is not None,
+                    timeout=10,
+                )
+                done = []
+                worker = client.open_job_worker(
+                    "payment-service", lambda pid, rec: done.append(rec.key)
+                )
+                assert wait_until(lambda: len(done) >= 1, timeout=20), done
+                worker.close()
+            finally:
+                client.close()
+        finally:
+            cluster.close()
 
 
 class TestMultiPartition:

@@ -1,0 +1,114 @@
+"""gRPC gateway tests (reference: ``Gateway.java`` + the Go client's
+``healthCheck_test.go`` integration suite: dial the gateway over real gRPC,
+check topology, then drive commands end to end)."""
+
+import time
+
+import pytest
+
+from zeebe_tpu.gateway.cluster_client import ClusterClient
+from zeebe_tpu.gateway.grpc_gateway import GrpcGateway, GrpcGatewayClient
+from zeebe_tpu.models.bpmn.builder import Bpmn
+from zeebe_tpu.models.bpmn.xml import write_model
+from zeebe_tpu.runtime.cluster_broker import ClusterBroker
+from zeebe_tpu.runtime.config import BrokerCfg
+
+
+def wait_until(predicate, timeout=20.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def gateway(tmp_path):
+    cfg = BrokerCfg()
+    cfg.cluster.node_id = "gw-broker"
+    cfg.raft.heartbeat_interval_ms = 30
+    cfg.raft.election_timeout_ms = 200
+    cfg.gossip.probe_interval_ms = 50
+    cfg.metrics.enabled = False
+    broker = ClusterBroker(cfg, str(tmp_path / "b0"))
+    broker.open_partition(0).join(10)
+    broker.bootstrap_partition(0, {})
+    assert wait_until(lambda: broker.partitions[0].is_leader, 20)
+    client = ClusterClient([broker.client_address])
+    gw = GrpcGateway(client)
+    stub = GrpcGatewayClient("127.0.0.1", gw.port)
+    yield stub, broker
+    stub.close()
+    gw.close()
+    client.close()
+    broker.close()
+
+
+def order_process_bytes():
+    return write_model(
+        Bpmn.create_process("order-process")
+        .start_event("start")
+        .service_task("collect-money", type="payment-service")
+        .end_event("end")
+        .done()
+    )
+
+
+class TestGrpcGateway:
+    def test_health_check_reports_topology(self, gateway):
+        stub, broker = gateway
+        health = stub.health_check()
+        assert health["brokers"], health
+        assert health["brokers"][0]["partition"] == 0
+        assert health["brokers"][0]["port"] == broker.client_address.port
+
+    def test_deploy_and_run_instance_over_grpc(self, gateway):
+        stub, broker = gateway
+        deployed = stub.call("DeployWorkflow", {"resource": order_process_bytes()})
+        assert deployed["workflows"][0]["bpmn_process_id"] == "order-process"
+
+        created = stub.call(
+            "CreateWorkflowInstance",
+            {"bpmn_process_id": "order-process", "payload": {"orderId": 7},
+             "partition_id": 0},
+        )
+        instance_key = created["workflow_instance_key"]
+        assert instance_key > 0
+
+        # the job exists on the broker; complete it over gRPC
+        engine = broker.partitions[0].engine
+        assert wait_until(lambda: len(engine.jobs) == 1, 10)
+        job_key = next(iter(engine.jobs))
+        # jobs must be activated before completion; drive via the activation
+        # path: a zero-handler worker would race, so complete directly after
+        # activation through the engine-visible state
+        from zeebe_tpu.engine.interpreter import JobSubscription
+
+        backlog = engine.add_job_subscription(
+            JobSubscription(subscriber_key=999, job_type="payment-service",
+                            worker="grpc-test", timeout=300_000, credits=1)
+        )
+        if backlog:
+            broker.partitions[0].raft.append(backlog)
+        assert wait_until(
+            lambda: engine.jobs.get(job_key) is not None
+            and engine.jobs[job_key].state == 3,  # ACTIVATED
+            10,
+        )
+        stub.call("CompleteJob", {"partition_id": 0, "job_key": job_key,
+                                  "payload": {"paid": True}})
+        assert wait_until(
+            lambda: engine.element_instances.get(instance_key) is None, 10
+        ), "instance must complete after the job is done"
+
+    def test_rejection_maps_to_grpc_error(self, gateway):
+        import grpc
+
+        stub, _broker = gateway
+        with pytest.raises(grpc.RpcError) as err:
+            stub.call("CreateWorkflowInstance", {"bpmn_process_id": "no-such",
+                                                 "partition_id": 0})
+        assert err.value.code() in (
+            grpc.StatusCode.FAILED_PRECONDITION, grpc.StatusCode.INTERNAL,
+        )

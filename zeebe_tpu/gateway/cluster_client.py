@@ -238,6 +238,12 @@ class ClusterClient:
         value = JobRecord(retries=retries)
         return self.send_command(partition_id, value, JobIntent.FAIL, key=job_key)
 
+    def update_job_retries(self, partition_id: int, job_key: int, retries: int) -> Record:
+        value = JobRecord(retries=retries)
+        return self.send_command(
+            partition_id, value, JobIntent.UPDATE_RETRIES, key=job_key
+        )
+
     # -- job workers over the wire -----------------------------------------
     def _on_push(self, payload: bytes) -> None:
         # transport IO thread: decode + enqueue only

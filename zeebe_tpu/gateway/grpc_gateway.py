@@ -1,0 +1,192 @@
+"""gRPC gateway: the cluster's front door for polyglot clients.
+
+Reference parity: ``gateway/.../Gateway.java`` (netty gRPC server embedded
+in the broker or standalone) + ``gateway-protocol/src/main/proto/
+gateway.proto:30-33`` — the reference tech-preview exposes ``Health``
+(topology); this gateway keeps that RPC and extends the service with the
+command surface the reference serves over its SBE client protocol
+(``EndpointManager`` / ``ResponseMapper`` would map them onto proto once a
+codegen toolchain is present; payloads here are msgpack maps over raw gRPC
+bytes since ``grpc_tools``/protoc codegen is not available in-image).
+
+Service: ``gateway_protocol.Gateway`` with unary RPCs
+HealthCheck, CreateTopic, DeployWorkflow, CreateWorkflowInstance,
+CancelWorkflowInstance, PublishMessage, CompleteJob, FailJob,
+UpdateJobRetries.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import Any, Dict, Optional
+
+import grpc
+
+from zeebe_tpu.gateway.client import ClientException
+from zeebe_tpu.models.bpmn.xml import read_model
+from zeebe_tpu.protocol import msgpack
+
+_SERVICE = "gateway_protocol.Gateway"
+
+
+def _ident(b: bytes) -> bytes:
+    return b
+
+
+class GrpcGateway:
+    """gRPC server bridging to a cluster (or in-process) client."""
+
+    def __init__(self, client, host: str = "127.0.0.1", port: int = 0,
+                 max_workers: int = 8):
+        self.client = client
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+        rpcs = {
+            "HealthCheck": self._health_check,
+            "CreateTopic": self._create_topic,
+            "DeployWorkflow": self._deploy_workflow,
+            "CreateWorkflowInstance": self._create_workflow_instance,
+            "CancelWorkflowInstance": self._cancel_workflow_instance,
+            "PublishMessage": self._publish_message,
+            "CompleteJob": self._complete_job,
+            "FailJob": self._fail_job,
+            "UpdateJobRetries": self._update_job_retries,
+        }
+        handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                self._wrap(fn), request_deserializer=_ident, response_serializer=_ident
+            )
+            for name, fn in rpcs.items()
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
+        )
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self.address = (host, self.port)
+        self._server.start()
+
+    def _wrap(self, fn):
+        def call(request: bytes, context: grpc.ServicerContext) -> bytes:
+            try:
+                msg = msgpack.unpack(request) if request else {}
+                return msgpack.pack(fn(msg))
+            except ClientException as e:
+                context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+            except Exception as e:  # noqa: BLE001
+                context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+        return call
+
+    # -- RPC implementations ------------------------------------------------
+    def _health_check(self, msg: dict) -> dict:
+        # reference gateway.proto HealthCheck → topology (brokers/partitions)
+        leaders = self.client.refresh_topology()
+        return {
+            "brokers": [
+                {"partition": pid, "host": addr.host, "port": addr.port}
+                for pid, addr in sorted(leaders.items())
+            ]
+        }
+
+    def _create_topic(self, msg: dict) -> dict:
+        record = self.client.create_topic(
+            str(msg["name"]),
+            partitions=int(msg.get("partitions", 1)),
+            replication_factor=int(msg.get("replication_factor", 1)),
+        )
+        return {"name": record.value.name, "partition_ids": record.value.partition_ids}
+
+    def _deploy_workflow(self, msg: dict) -> dict:
+        model = read_model(bytes(msg["resource"]))
+        record = self.client.deploy_model(
+            model, resource_name=str(msg.get("resource_name", "process.bpmn"))
+        )
+        return {
+            "key": record.key,
+            "workflows": [
+                {
+                    "bpmn_process_id": wf.bpmn_process_id,
+                    "version": wf.version,
+                    "workflow_key": wf.key,
+                }
+                for wf in record.value.deployed_workflows
+            ],
+        }
+
+    def _create_workflow_instance(self, msg: dict) -> dict:
+        record = self.client.create_instance(
+            str(msg["bpmn_process_id"]),
+            payload=dict(msg.get("payload", {})),
+            partition_id=msg.get("partition_id"),
+        )
+        return {
+            "workflow_instance_key": record.value.workflow_instance_key,
+            "bpmn_process_id": record.value.bpmn_process_id,
+            "version": record.value.version,
+        }
+
+    def _cancel_workflow_instance(self, msg: dict) -> dict:
+        self.client.cancel_instance(
+            int(msg.get("partition_id", 0)), int(msg["workflow_instance_key"])
+        )
+        return {}
+
+    def _publish_message(self, msg: dict) -> dict:
+        self.client.publish_message(
+            str(msg["name"]),
+            str(msg["correlation_key"]),
+            payload=dict(msg.get("payload", {})),
+            time_to_live_ms=int(msg.get("time_to_live_ms", 0)),
+        )
+        return {}
+
+    def _complete_job(self, msg: dict) -> dict:
+        self.client.complete_job(
+            int(msg.get("partition_id", 0)), int(msg["job_key"]),
+            dict(msg.get("payload", {})),
+        )
+        return {}
+
+    def _fail_job(self, msg: dict) -> dict:
+        self.client.fail_job(
+            int(msg.get("partition_id", 0)), int(msg["job_key"]),
+            int(msg.get("retries", 0)),
+        )
+        return {}
+
+    def _update_job_retries(self, msg: dict) -> dict:
+        self.client.update_job_retries(
+            int(msg.get("partition_id", 0)), int(msg["job_key"]),
+            int(msg.get("retries", 1)),
+        )
+        return {}
+
+    def close(self) -> None:
+        self._server.stop(grace=1)
+
+
+class GrpcGatewayClient:
+    """Minimal polyglot-style client over the gateway (reference
+    ``clients/go/client.go``: gRPC dial + HealthCheck; any language with a
+    gRPC stack can speak this protocol)."""
+
+    def __init__(self, host: str, port: int):
+        self._channel = grpc.insecure_channel(f"{host}:{port}")
+        self._calls: Dict[str, Any] = {}
+
+    def call(self, method: str, body: Optional[dict] = None, timeout: float = 15.0) -> dict:
+        rpc = self._calls.get(method)
+        if rpc is None:
+            rpc = self._channel.unary_unary(
+                f"/{_SERVICE}/{method}",
+                request_serializer=_ident,
+                response_deserializer=_ident,
+            )
+            self._calls[method] = rpc
+        return msgpack.unpack(rpc(msgpack.pack(body or {}), timeout=timeout))
+
+    def health_check(self) -> dict:
+        return self.call("HealthCheck")
+
+    def close(self) -> None:
+        self._channel.close()

@@ -404,6 +404,11 @@ class ClusterBroker(Actor):
         # node info broadcast via gossip custom events)
         self._publish_node_info()
         self.actor.run_at_fixed_rate(2000, self._publish_node_info)
+        # followers poll partition leaders for snapshots (reference
+        # snapshotReplicationPeriod, default 5m)
+        self.actor.run_at_fixed_rate(
+            self.cfg.data.snapshot_replication_period_ms, self._replicate_snapshots
+        )
 
     def _publish_node_info(self) -> None:
         self.gossip.publish_custom_event(
@@ -525,7 +530,126 @@ class ClusterBroker(Actor):
             return self._handle_create_partition(msg)
         if t == "bootstrap-partition":
             return self._handle_bootstrap_partition(msg)
+        if t == "list-snapshots":
+            return self._handle_list_snapshots(msg)
+        if t == "fetch-snapshot-chunk":
+            return self._handle_fetch_snapshot_chunk(msg)
         return None
+
+    # -- snapshot replication (reference SnapshotReplicationService:55-128:
+    # followers poll the leader and fetch snapshots chunk-wise so a
+    # failover recovers from a snapshot instead of replaying the full log)
+    def _handle_list_snapshots(self, msg: dict) -> bytes:
+        server = self.partitions.get(int(msg.get("partition", 0)))
+        if server is None:
+            return msgpack.pack({"t": "ok", "snapshots": []})
+        return msgpack.pack(
+            {
+                "t": "ok",
+                "snapshots": [
+                    {
+                        "processed": m.last_processed_position,
+                        "written": m.last_written_position,
+                        "term": m.term,
+                    }
+                    for m in server.snapshots.storage.list()
+                ],
+            }
+        )
+
+    def _handle_fetch_snapshot_chunk(self, msg: dict) -> bytes:
+        from zeebe_tpu.log.snapshot import SnapshotMetadata
+
+        server = self.partitions.get(int(msg.get("partition", 0)))
+        if server is None:
+            return msgpack.pack({"t": "error", "code": "NO_PARTITION"})
+        meta = SnapshotMetadata(
+            last_processed_position=int(msg.get("processed", -1)),
+            last_written_position=int(msg.get("written", -1)),
+            term=int(msg.get("term", 0)),
+        )
+        payload = server.snapshots.storage.read(meta)
+        if payload is None:
+            return msgpack.pack({"t": "error", "code": "NO_SNAPSHOT"})
+        offset = int(msg.get("offset", 0))
+        length = int(msg.get("length", 256 * 1024))
+        return msgpack.pack(
+            {
+                "t": "ok",
+                "total": len(payload),
+                "chunk": payload[offset : offset + length],
+            }
+        )
+
+    def _replicate_snapshots(self) -> None:
+        """Follower side: poll each partition's leader for new snapshots and
+        fetch them chunk-wise (installed per follower partition —
+        SnapshotReplicationInstallService parity)."""
+        for pid, server in list(self.partitions.items()):
+            if server.is_leader:
+                continue
+            addr = self.topology.leader_address(pid)
+            if addr is None:
+                continue
+            threading.Thread(
+                target=self._fetch_snapshots_from_leader,
+                args=(pid, server, addr),
+                daemon=True,
+                name=f"zb-snapshot-replication-{pid}",
+            ).start()
+
+    def _fetch_snapshots_from_leader(self, pid: int, server, addr) -> None:
+        from zeebe_tpu.log.snapshot import SnapshotMetadata
+
+        try:
+            rsp = msgpack.unpack(
+                self.client_transport.send_request(
+                    addr,
+                    msgpack.pack({"t": "list-snapshots", "partition": pid}),
+                    timeout_ms=3000,
+                ).join(4)
+            )
+            if rsp.get("t") != "ok" or not rsp.get("snapshots"):
+                return
+            newest = max(rsp["snapshots"], key=lambda s: int(s["processed"]))
+            meta = SnapshotMetadata(
+                last_processed_position=int(newest["processed"]),
+                last_written_position=int(newest["written"]),
+                term=int(newest["term"]),
+            )
+            have = {
+                (m.last_processed_position, m.last_written_position, m.term)
+                for m in server.snapshots.storage.list()
+            }
+            key = (meta.last_processed_position, meta.last_written_position, meta.term)
+            if key in have:
+                return
+            chunks = []
+            offset = 0
+            while True:
+                body = {
+                    "t": "fetch-snapshot-chunk",
+                    "partition": pid,
+                    "processed": meta.last_processed_position,
+                    "written": meta.last_written_position,
+                    "term": meta.term,
+                    "offset": offset,
+                }
+                chunk_rsp = msgpack.unpack(
+                    self.client_transport.send_request(
+                        addr, msgpack.pack(body), timeout_ms=5000
+                    ).join(6)
+                )
+                if chunk_rsp.get("t") != "ok":
+                    return
+                chunk = bytes(chunk_rsp.get("chunk", b""))
+                chunks.append(chunk)
+                offset += len(chunk)
+                if offset >= int(chunk_rsp.get("total", 0)) or not chunk:
+                    break
+            server.snapshots.storage.write(meta, b"".join(chunks))
+        except Exception:  # noqa: BLE001 - next poll retries
+            pass
 
     # -- topic subscriptions over the client API ----------------------------
     def _handle_topic_subscription(self, msg: dict, conn, result: ActorFuture) -> None:
