@@ -91,7 +91,8 @@ _DATA = [
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=_DATA,
-    meta_fields=["num_vars", "emit_width", "max_join_in", "has_conditions"],
+    meta_fields=["num_vars", "emit_width", "max_join_in", "has_conditions",
+                 "has_parallel_joins", "has_timers", "has_mappings"],
 )
 @dataclasses.dataclass
 class DeviceGraph:
@@ -126,10 +127,14 @@ class DeviceGraph:
     num_vars: int
     emit_width: int                  # max emissions per record (≥2)
     max_join_in: int
-    # deploy-time kernel specialization: with no conditioned flows anywhere
-    # in the deployed set, the predicate stack machine is omitted from the
-    # compiled step entirely (tri defaults to 'no condition')
+    # deploy-time kernel specialization: features absent from the whole
+    # deployed set are compiled out of the step entirely (the reference
+    # binds steps per element at transform time — ServiceTaskHandler:65 —
+    # the batched analogue specializes the fused program)
     has_conditions: bool = True
+    has_parallel_joins: bool = True
+    has_timers: bool = True
+    has_mappings: bool = True
 
 
 @dataclasses.dataclass
@@ -339,6 +344,12 @@ def compile_graph(
         emit_width=emit_width,
         max_join_in=join_in,
         has_conditions=bool((cond_prog >= 0).any()),
+        has_parallel_joins=bool((join_nin >= 2).any()),
+        has_timers=bool((timer_dur >= 0).any()),
+        has_mappings=bool(
+            (in_map_n > 0).any() or (out_map_n > 0).any()
+            or in_root.any() or out_root.any()
+        ),
     )
     meta = GraphMeta(
         workflows=list(workflows),

@@ -171,9 +171,13 @@ def step_kernel(
     jb_found, jb_slot = hashmap.lookup(
         state.job_map, batch.key, job_cmd & (batch.key >= 0)
     )
-    tm_found, tm_slot = hashmap.lookup(
-        state.timer_map, batch.key, timer_cmd & (batch.key >= 0)
-    )
+    if graph.has_timers:
+        tm_found, tm_slot = hashmap.lookup(
+            state.timer_map, batch.key, timer_cmd & (batch.key >= 0)
+        )
+    else:
+        tm_found = jnp.zeros((b,), bool)
+        tm_slot = jnp.zeros((b,), jnp.int32)
     ei_clip = jnp.clip(ei_slot, 0, n_cap - 1)
     sc_clip = jnp.clip(sc_slot, 0, n_cap - 1)
     aik_clip = jnp.clip(aik_slot, 0, n_cap - 1)
@@ -326,17 +330,25 @@ def step_kernel(
     xs_nofl = m_xsplit & ~cond_errored & (taken_flow < 0)
     xs_err = m_xsplit & cond_errored
 
-    # input mapping
-    in_from, in_has, in_root, in_err = _apply_mappings(
-        graph, wf_c, el_c, batch.v_vt, batch.v_num, batch.v_str, True
-    )
-    im_vt, im_num, im_sid = _select_by_map(in_from, batch.v_vt, batch.v_num, batch.v_str)
-    sel_in = (in_has & ~in_root)[:, None]
-    in_vt = jnp.where(sel_in, im_vt, batch.v_vt)
-    in_num = jnp.where(sel_in, im_num, batch.v_num)
-    in_sid = jnp.where(sel_in, im_sid, batch.v_str)
-    inmap_ok = m_inmap & ~(in_has & in_err)
-    inmap_err = m_inmap & in_has & in_err
+    # input mapping (compiled out when the deployed set has no mappings:
+    # identity pass-through is the default behavior)
+    if graph.has_mappings:
+        in_from, in_has, in_root, in_err = _apply_mappings(
+            graph, wf_c, el_c, batch.v_vt, batch.v_num, batch.v_str, True
+        )
+        im_vt, im_num, im_sid = _select_by_map(
+            in_from, batch.v_vt, batch.v_num, batch.v_str
+        )
+        sel_in = (in_has & ~in_root)[:, None]
+        in_vt = jnp.where(sel_in, im_vt, batch.v_vt)
+        in_num = jnp.where(sel_in, im_num, batch.v_num)
+        in_sid = jnp.where(sel_in, im_sid, batch.v_str)
+        inmap_ok = m_inmap & ~(in_has & in_err)
+        inmap_err = m_inmap & in_has & in_err
+    else:
+        in_vt, in_num, in_sid = batch.v_vt, batch.v_num, batch.v_str
+        inmap_ok = m_inmap
+        inmap_err = jnp.zeros((b,), bool)
 
     # output mapping: merge(record payload → scope payload)
     scope_vt = state.ei_vt[sc_clip]
@@ -344,12 +356,19 @@ def step_kernel(
     scope_sid = state.ei_str[sc_clip]
     no_scope = ~sc_found
     scope_vt = jnp.where(no_scope[:, None], jnp.int8(VT_ABSENT), scope_vt)
-    out_from, out_has, out_root, out_err = _apply_mappings(
-        graph, wf_c, el_c, batch.v_vt, batch.v_num, batch.v_str, False
-    )
-    om_vt, om_num, om_sid = _select_by_map(
-        out_from, batch.v_vt, batch.v_num, batch.v_str
-    )
+    if graph.has_mappings:
+        out_from, out_has, out_root, out_err = _apply_mappings(
+            graph, wf_c, el_c, batch.v_vt, batch.v_num, batch.v_str, False
+        )
+        om_vt, om_num, om_sid = _select_by_map(
+            out_from, batch.v_vt, batch.v_num, batch.v_str
+        )
+    else:
+        out_from = jnp.full((b, v), -1, jnp.int32)
+        out_has = jnp.zeros((b,), bool)
+        out_root = jnp.zeros((b,), bool)
+        out_err = jnp.zeros((b,), bool)
+        om_vt, om_num, om_sid = batch.v_vt, batch.v_num, batch.v_str
     behavior = graph.out_behavior[wf_c, el_c]
     B_MERGE, B_OVERWRITE, B_NONE = 0, 1, 2
     src_present = batch.v_vt != VT_ABSENT
@@ -372,61 +391,76 @@ def step_kernel(
     outmap_ok = m_outmap & ~(out_has & out_err)
     outmap_err = m_outmap & out_has & out_err
 
-    # parallel join: composite key (scope_key, gateway element)
+    # parallel join: composite key (scope_key, gateway element). Compiled
+    # out for deployed sets without a joining parallel gateway.
     gw_elem = graph.flow_target[wf_c, el_c]
     gw_clip = jnp.clip(gw_elem, 0, graph.elem_type.shape[1] - 1)
-    join_key = jnp.where(
-        m_pmerge, (batch.scope_key << jnp.int64(10)) | gw_clip.astype(jnp.int64), -1
-    )
-    jn_found, jn_slot = hashmap.lookup(state.join_map, join_key, m_pmerge)
-    # leaders: first batch occurrence of each missing join key (sort-dedup)
-    missing = m_pmerge & ~jn_found
-    sort_k = jnp.where(missing, join_key, jnp.int64(2**62))
-    order = jnp.argsort(sort_k, stable=True)
-    sorted_k = sort_k[order]
-    first_occ = jnp.concatenate(
-        [jnp.ones((1,), bool), sorted_k[1:] != sorted_k[:-1]]
-    )
-    leader = jnp.zeros((b,), bool).at[order].set(first_occ) & missing
-    # allocate join slots for leaders
-    join_free = jnp.nonzero(state.join_key < 0, size=b, fill_value=j_cap)[0]
-    l_rank = _excl_cumsum(leader.astype(jnp.int32))
-    l_slot = join_free[jnp.clip(l_rank, 0, b - 1)]
-    join_overflow = jnp.any(leader & (l_slot >= j_cap))
-    lw = jnp.where(leader, l_slot, j_cap)
-    join_key_arr = state.join_key.at[lw].set(join_key, mode="drop")
-    nin_here = graph.join_nin[wf_c, gw_clip]
-    join_nin_arr = state.join_nin.at[lw].set(nin_here, mode="drop")
-    jmap, jins = hashmap.insert(state.join_map, join_key, l_slot, leader)
-    # re-lookup so every arrival sees its slot
-    jn_found2, jn_slot2 = hashmap.lookup(jmap, join_key, m_pmerge)
-    arr_slot = jnp.clip(jn_slot2, 0, j_cap - 1)
-    my_pos = graph.join_pos[wf_c, el_c]
-    aw = jnp.where(m_pmerge & jn_found2, arr_slot, j_cap)
-    arrived = state.join_arrived.at[
-        aw, jnp.clip(my_pos, 0, state.join_arrived.shape[1] - 1)
-    ].set(True, mode="drop")
-    # flow-position-stamped payload merge: higher flow pos wins per variable
-    stamp = state.join_pos_stamp.at[aw].max(
-        jnp.where(src_present, my_pos[:, None], -1), mode="drop"
-    )
-    win_var = m_pmerge[:, None] & src_present & (
-        stamp[jnp.clip(aw, 0, j_cap - 1)] == my_pos[:, None]
-    )
-    aw_var = jnp.where(win_var, aw[:, None], j_cap)
-    cols = jnp.broadcast_to(jnp.arange(v, dtype=jnp.int32)[None, :], (b, v))
-    join_vt = state.join_vt.at[aw_var, cols].set(batch.v_vt, mode="drop")
-    join_num = state.join_num.at[aw_var, cols].set(batch.v_num, mode="drop")
-    join_sid = state.join_str.at[aw_var, cols].set(batch.v_str, mode="drop")
-    # completion: all incoming arrived; completer = last arrival in batch
-    arr_count = jnp.sum(arrived, axis=1).astype(jnp.int32)
-    complete_slot = (join_nin_arr > 0) & (arr_count >= join_nin_arr)
-    my_complete = m_pmerge & jn_found2 & complete_slot[arr_slot]
-    completer = _last_writer(arr_slot, my_complete, j_cap)
-    # merged payload for the completer
-    mg_vt = join_vt[arr_slot]
-    mg_num = join_num[arr_slot]
-    mg_sid = join_sid[arr_slot]
+    if graph.has_parallel_joins:
+        join_key = jnp.where(
+            m_pmerge, (batch.scope_key << jnp.int64(10)) | gw_clip.astype(jnp.int64), -1
+        )
+        jn_found, jn_slot = hashmap.lookup(state.join_map, join_key, m_pmerge)
+        # leaders: first batch occurrence of each missing join key (sort-dedup)
+        missing = m_pmerge & ~jn_found
+        sort_k = jnp.where(missing, join_key, jnp.int64(2**62))
+        order = jnp.argsort(sort_k, stable=True)
+        sorted_k = sort_k[order]
+        first_occ = jnp.concatenate(
+            [jnp.ones((1,), bool), sorted_k[1:] != sorted_k[:-1]]
+        )
+        leader = jnp.zeros((b,), bool).at[order].set(first_occ) & missing
+        # allocate join slots for leaders
+        join_free = jnp.nonzero(state.join_key < 0, size=b, fill_value=j_cap)[0]
+        l_rank = _excl_cumsum(leader.astype(jnp.int32))
+        l_slot = join_free[jnp.clip(l_rank, 0, b - 1)]
+        join_overflow = jnp.any(leader & (l_slot >= j_cap))
+        lw = jnp.where(leader, l_slot, j_cap)
+        join_key_arr = state.join_key.at[lw].set(join_key, mode="drop")
+        nin_here = graph.join_nin[wf_c, gw_clip]
+        join_nin_arr = state.join_nin.at[lw].set(nin_here, mode="drop")
+        jmap, jins = hashmap.insert(state.join_map, join_key, l_slot, leader)
+        # re-lookup so every arrival sees its slot
+        jn_found2, jn_slot2 = hashmap.lookup(jmap, join_key, m_pmerge)
+        arr_slot = jnp.clip(jn_slot2, 0, j_cap - 1)
+        my_pos = graph.join_pos[wf_c, el_c]
+        aw = jnp.where(m_pmerge & jn_found2, arr_slot, j_cap)
+        arrived = state.join_arrived.at[
+            aw, jnp.clip(my_pos, 0, state.join_arrived.shape[1] - 1)
+        ].set(True, mode="drop")
+        # flow-position-stamped payload merge: higher flow pos wins per variable
+        stamp = state.join_pos_stamp.at[aw].max(
+            jnp.where(src_present, my_pos[:, None], -1), mode="drop"
+        )
+        win_var = m_pmerge[:, None] & src_present & (
+            stamp[jnp.clip(aw, 0, j_cap - 1)] == my_pos[:, None]
+        )
+        aw_var = jnp.where(win_var, aw[:, None], j_cap)
+        cols = jnp.broadcast_to(jnp.arange(v, dtype=jnp.int32)[None, :], (b, v))
+        join_vt = state.join_vt.at[aw_var, cols].set(batch.v_vt, mode="drop")
+        join_num = state.join_num.at[aw_var, cols].set(batch.v_num, mode="drop")
+        join_sid = state.join_str.at[aw_var, cols].set(batch.v_str, mode="drop")
+        # completion: all incoming arrived; completer = last arrival in batch
+        arr_count = jnp.sum(arrived, axis=1).astype(jnp.int32)
+        complete_slot = (join_nin_arr > 0) & (arr_count >= join_nin_arr)
+        my_complete = m_pmerge & jn_found2 & complete_slot[arr_slot]
+        completer = _last_writer(arr_slot, my_complete, j_cap)
+        # merged payload for the completer
+        mg_vt = join_vt[arr_slot]
+        mg_num = join_num[arr_slot]
+        mg_sid = join_sid[arr_slot]
+    else:
+        join_key = jnp.full((b,), -1, jnp.int64)
+        arr_slot = jnp.zeros((b,), jnp.int32)
+        my_complete = jnp.zeros((b,), bool)
+        completer = jnp.zeros((b,), bool)
+        join_overflow = jnp.zeros((), bool)
+        join_key_arr = state.join_key
+        join_nin_arr = state.join_nin
+        arrived = state.join_arrived
+        stamp = state.join_pos_stamp
+        join_vt, join_num, join_sid = state.join_vt, state.join_num, state.join_str
+        jmap = state.join_map
+        mg_vt, mg_num, mg_sid = batch.v_vt, batch.v_num, batch.v_str
 
     # ---------------- D. key assignment ----------------
     out_count = graph.out_count[wf_c, el_c]
@@ -991,32 +1025,47 @@ def step_kernel(
     job_map = hashmap.delete(job_map, batch.key, job_rm)
 
     # ---------------- join cleanup ----------------
-    done_slot = jnp.where(completer, arr_slot, j_cap)
-    join_key_arr = join_key_arr.at[done_slot].set(-1, mode="drop")
-    join_nin_arr = join_nin_arr.at[done_slot].set(0, mode="drop")
-    arrived = arrived.at[done_slot].set(False, mode="drop")
-    stamp = stamp.at[done_slot].set(-1, mode="drop")
-    join_map = hashmap.delete(jmap, join_key, completer)
+    if graph.has_parallel_joins:
+        done_slot = jnp.where(completer, arr_slot, j_cap)
+        join_key_arr = join_key_arr.at[done_slot].set(-1, mode="drop")
+        join_nin_arr = join_nin_arr.at[done_slot].set(0, mode="drop")
+        arrived = arrived.at[done_slot].set(False, mode="drop")
+        stamp = stamp.at[done_slot].set(-1, mode="drop")
+        join_map = hashmap.delete(jmap, join_key, completer)
+    else:
+        join_map = jmap
 
     # ---------------- timer table ----------------
-    t_ins = m_tcreate
-    tfree = jnp.nonzero(state.timer_key < 0, size=b, fill_value=t_cap)[0]
-    t_rank = _excl_cumsum(t_ins.astype(jnp.int32))
-    t_slot = tfree[jnp.clip(t_rank, 0, b - 1)]
-    timer_overflow = jnp.any(t_ins & (t_slot >= t_cap))
-    tw = jnp.where(t_ins, t_slot, t_cap)
-    timer_key_arr = state.timer_key.at[tw].set(key0, mode="drop")
-    timer_due_arr = state.timer_due.at[tw].set(batch.deadline, mode="drop")
-    timer_aik_arr = state.timer_aik.at[tw].set(batch.aux_key, mode="drop")
-    timer_ik_arr = state.timer_instance_key.at[tw].set(batch.instance_key, mode="drop")
-    timer_elem_arr = state.timer_elem.at[tw].set(batch.elem, mode="drop")
-    timer_wf_arr = state.timer_wf.at[tw].set(batch.wf, mode="drop")
-    timer_map, _t_ok = hashmap.insert(state.timer_map, key0, t_slot, t_ins)
-    t_rm = ttrig_ok | tcan_ok
-    trm = jnp.where(t_rm, tm_clip, t_cap)
-    timer_key_arr = timer_key_arr.at[trm].set(-1, mode="drop")
-    timer_due_arr = timer_due_arr.at[trm].set(-1, mode="drop")
-    timer_map = hashmap.delete(timer_map, batch.key, t_rm)
+    if graph.has_timers:
+        t_ins = m_tcreate
+        tfree = jnp.nonzero(state.timer_key < 0, size=b, fill_value=t_cap)[0]
+        t_rank = _excl_cumsum(t_ins.astype(jnp.int32))
+        t_slot = tfree[jnp.clip(t_rank, 0, b - 1)]
+        timer_overflow = jnp.any(t_ins & (t_slot >= t_cap))
+        tw = jnp.where(t_ins, t_slot, t_cap)
+        timer_key_arr = state.timer_key.at[tw].set(key0, mode="drop")
+        timer_due_arr = state.timer_due.at[tw].set(batch.deadline, mode="drop")
+        timer_aik_arr = state.timer_aik.at[tw].set(batch.aux_key, mode="drop")
+        timer_ik_arr = state.timer_instance_key.at[tw].set(
+            batch.instance_key, mode="drop"
+        )
+        timer_elem_arr = state.timer_elem.at[tw].set(batch.elem, mode="drop")
+        timer_wf_arr = state.timer_wf.at[tw].set(batch.wf, mode="drop")
+        timer_map, _t_ok = hashmap.insert(state.timer_map, key0, t_slot, t_ins)
+        t_rm = ttrig_ok | tcan_ok
+        trm = jnp.where(t_rm, tm_clip, t_cap)
+        timer_key_arr = timer_key_arr.at[trm].set(-1, mode="drop")
+        timer_due_arr = timer_due_arr.at[trm].set(-1, mode="drop")
+        timer_map = hashmap.delete(timer_map, batch.key, t_rm)
+    else:
+        timer_overflow = jnp.zeros((), bool)
+        timer_key_arr = state.timer_key
+        timer_due_arr = state.timer_due
+        timer_aik_arr = state.timer_aik
+        timer_ik_arr = state.timer_instance_key
+        timer_elem_arr = state.timer_elem
+        timer_wf_arr = state.timer_wf
+        timer_map = state.timer_map
 
     # ---------------- output compaction ----------------
     flat_valid = em["valid"].reshape(-1)
