@@ -423,6 +423,72 @@ class TestSnapshotReplication:
             cluster.close()
 
 
+class TestSelfAssembly:
+    def test_cluster_bootstraps_itself_from_config(self, tmp_path):
+        """Reference bootstrap flow: brokers start from config alone (contact
+        points + bootstrapExpect), gossip until the expected count is alive,
+        the elector bootstraps the replicated system partition, and the
+        configured [[topics]] are created — no manual partition wiring."""
+        from zeebe_tpu.runtime.config import TopicCfg
+
+        brokers = {}
+        first = None
+        for i in range(3):
+            cfg = make_cfg(f"b{i}")
+            cfg.cluster.bootstrap_expect = 3
+            cfg.cluster.replication_factor = 3
+            # every broker ships the same config file (reference dist model)
+            cfg.topics.append(TopicCfg(name="orders", partitions=2,
+                                       replication_factor=2))
+            if first is not None:
+                cfg.cluster.initial_contact_points = [
+                    f"{first.gossip_address.host}:{first.gossip_address.port}"
+                ]
+            broker = ClusterBroker(cfg, str(tmp_path / f"b{i}"))
+            brokers[f"b{i}"] = broker
+            if first is None:
+                first = broker
+        try:
+            # system partition comes up replicated on all three
+            assert wait_until(
+                lambda: any(
+                    0 in b.partitions and b.partitions[0].is_leader
+                    for b in brokers.values()
+                ),
+                timeout=30,
+            )
+            assert wait_until(
+                lambda: all(0 in b.partitions for b in brokers.values()), 20
+            )
+            # the configured default topic gets orchestrated
+            def topic_created():
+                for b in brokers.values():
+                    server = b.partitions.get(0)
+                    if server and server.is_leader and server.engine:
+                        t = server.engine.topics.get("orders")
+                        return t is not None and t["state"] == "CREATED"
+                return False
+
+            assert wait_until(topic_created, timeout=30)
+            # and it serves real work
+            client = ClusterClient([b.client_address for b in brokers.values()])
+            try:
+                client.deploy_model(order_process())
+                done = []
+                worker = client.open_job_worker(
+                    "payment-service", lambda pid, rec: done.append(rec.key) or {},
+                    partitions=[1],
+                )
+                client.create_instance("order-process", partition_id=1)
+                assert wait_until(lambda: len(done) == 1, timeout=30), done
+                worker.close()
+            finally:
+                client.close()
+        finally:
+            for b in brokers.values():
+                b.close()
+
+
 class TestMultiPartition:
     def test_cross_partition_message_correlation(self, tmp_path):
         """Message published on its hash-routed partition correlates to a

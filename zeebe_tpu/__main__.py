@@ -1,0 +1,66 @@
+"""Standalone broker entry point.
+
+Reference parity: ``StandaloneBroker.main``
+(broker-core/.../StandaloneBroker.java:32) + the dist launch scripts: read
+the TOML config (path as argv[1] or ZEEBE_CFG), start a broker node, join
+the configured contact points, self-bootstrap the cluster once the expected
+node count is present, optionally serve the gRPC gateway, run until
+SIGINT/SIGTERM.
+
+    python -m zeebe_tpu [zeebe.cfg.toml]
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    config_path = argv[0] if argv else os.environ.get("ZEEBE_CFG")
+
+    from zeebe_tpu.runtime.cluster_broker import ClusterBroker
+    from zeebe_tpu.runtime.config import load_config
+
+    cfg = load_config(config_path)
+    data_dir = os.path.join(cfg.data.directory, cfg.cluster.node_id)
+    broker = ClusterBroker(cfg, data_dir)
+    print(
+        f"zeebe-tpu broker {cfg.cluster.node_id}: "
+        f"client={broker.client_address.host}:{broker.client_address.port} "
+        f"gossip={broker.gossip_address.host}:{broker.gossip_address.port} "
+        f"data={data_dir}",
+        flush=True,
+    )
+
+    gateway = None
+    try:
+        from zeebe_tpu.gateway.cluster_client import ClusterClient
+        from zeebe_tpu.gateway.grpc_gateway import GrpcGateway
+
+        gw_client = ClusterClient(
+            [broker.client_address], num_partitions=cfg.cluster.partitions
+        )
+        gateway = GrpcGateway(
+            gw_client, host=cfg.network.host, port=cfg.network.gateway_port
+        )
+        print(f"gRPC gateway on {cfg.network.host}:{gateway.port}", flush=True)
+    except Exception as e:  # noqa: BLE001 - port may be taken; broker still runs
+        print(f"gateway disabled: {e}", flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    print("shutting down", flush=True)
+    if gateway is not None:
+        gateway.close()
+    broker.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

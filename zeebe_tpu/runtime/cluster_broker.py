@@ -336,6 +336,8 @@ class ClusterBroker(Actor):
         self.clock = clock or SystemClock()
         self._own_scheduler = scheduler is None
         self._closing = False
+        self._bootstrap_started = False
+        self._default_topics_created = False
         self.scheduler = scheduler or ActorScheduler(
             cpu_threads=cfg.threads.cpu_thread_count,
             io_threads=cfg.threads.io_thread_count,
@@ -409,6 +411,18 @@ class ClusterBroker(Actor):
         self.actor.run_at_fixed_rate(
             self.cfg.data.snapshot_replication_period_ms, self._replicate_snapshots
         )
+        # self-assembly (reference BootstrapExpectNodes/BootstrapSystemTopic/
+        # BootstrapDefaultTopicsService): join configured contact points, and
+        # once the expected node count gossips alive, the smallest node id
+        # bootstraps the system partition, then the configured topics
+        if self.cfg.cluster.initial_contact_points:
+            self.join(
+                [
+                    RemoteAddress(hp.split(":")[0], int(hp.split(":")[1]))
+                    for hp in self.cfg.cluster.initial_contact_points
+                ]
+            )
+        self.actor.run_at_fixed_rate(500, self._maybe_bootstrap)
 
     def _publish_node_info(self) -> None:
         self.gossip.publish_custom_event(
@@ -747,6 +761,89 @@ class ClusterBroker(Actor):
         server = self.partitions.get(partition_id)
         if server is not None:
             server.topic_pushers.pop(subscriber_key, None)
+
+    # -- cluster self-assembly (reference bootstrap services) ---------------
+    def _maybe_bootstrap(self) -> None:
+        if self._closing:
+            return
+        self._maybe_create_default_topics()
+        if self._bootstrap_started:
+            return
+        if 0 in self.partitions or self.topology.leader_address(0) is not None:
+            self._bootstrap_started = True  # already bootstrapped or joined
+            return
+        alive = set(self.gossip.alive_members()) | {self.node_id}
+        if len(alive) < max(1, self.cfg.cluster.bootstrap_expect):
+            return
+        # deterministic elector: the smallest node id drives the bootstrap
+        if min(alive) != self.node_id:
+            return
+        # all chosen members must be reachable over the management plane
+        members = sorted(alive)[: max(1, self.cfg.cluster.replication_factor)]
+        if any(self._member_client_addr(n) is None for n in members):
+            return
+        self._bootstrap_started = True
+        threading.Thread(
+            target=self._bootstrap_system_partition, args=(members,),
+            daemon=True, name="zb-bootstrap",
+        ).start()
+
+    def _bootstrap_system_partition(self, members) -> None:
+        try:
+            raft_addrs: Dict[str, list] = {}
+            for node in members:
+                addr = self._member_client_addr(node)
+                rsp = msgpack.unpack(
+                    self.client_transport.send_request(
+                        addr,
+                        msgpack.pack({"t": "create-partition", "partition": 0}),
+                        timeout_ms=5000,
+                    ).join(6)
+                )
+                if rsp.get("t") == "ok":
+                    raft_addrs[node] = list(rsp.get("raft", ["", 0]))
+            for node in raft_addrs:
+                addr = self._member_client_addr(node)
+                peers = {n: a for n, a in raft_addrs.items() if n != node}
+                self.client_transport.send_request(
+                    addr,
+                    msgpack.pack(
+                        {"t": "bootstrap-partition", "partition": 0, "members": peers}
+                    ),
+                    timeout_ms=5000,
+                ).join(6)
+        except Exception:  # noqa: BLE001 - the periodic check retries
+            self._bootstrap_started = False
+
+    def _maybe_create_default_topics(self) -> None:
+        """Configured [[topics]] created once the system partition is led by
+        this node (duplicate CREATEs are rejected — idempotent)."""
+        server = self.partitions.get(0)
+        if not self.cfg.topics or server is None or not server.is_leader:
+            return
+        if self._default_topics_created:
+            return
+        self._default_topics_created = True
+        from zeebe_tpu.protocol.intents import TopicIntent
+        from zeebe_tpu.protocol.metadata import RecordMetadata
+        from zeebe_tpu.protocol.records import TopicRecord
+        from zeebe_tpu.protocol.enums import RecordType as RT
+
+        for topic in self.cfg.topics:
+            server.raft.append([
+                Record(
+                    metadata=RecordMetadata(
+                        record_type=RT.COMMAND,
+                        value_type=TopicRecord.VALUE_TYPE,
+                        intent=int(TopicIntent.CREATE),
+                    ),
+                    value=TopicRecord(
+                        name=topic.name,
+                        partitions=topic.partitions,
+                        replication_factor=topic.replication_factor,
+                    ),
+                )
+            ])
 
     # -- topic orchestration (reference TopicCreationService + NodeSelector
     # + CreatePartitionRequest → ManagementApiRequestHandler) ---------------
