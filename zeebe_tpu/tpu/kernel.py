@@ -56,9 +56,43 @@ VT_TIMER = int(ValueType.TIMER)
 _KEY_STEP = keyspace.STEP_SIZE
 
 
+def _mxu_cumsum_i32(x):
+    """Inclusive scan of small-int vectors via triangular matmuls on the
+    MXU. XLA's TPU cumsum lowering (reduce-window) serializes badly at
+    these lengths; two tiny matmuls are ~free. Exact while the running sum
+    stays below 2^24 (batch sizes here are ≤ 2^20 of 0/1 counts)."""
+    n = x.shape[0]
+    tile = 128
+    if n % tile != 0:  # fall back off the fast path for odd sizes
+        return jnp.cumsum(x)
+    rows = n // tile
+    xf = x.astype(jnp.float32).reshape(rows, tile)
+    upper = jnp.triu(jnp.ones((tile, tile), jnp.float32))
+    lower_strict = jnp.tril(jnp.ones((rows, rows), jnp.float32), k=-1)
+    within = xf @ upper                          # [rows, tile] row-wise scan
+    row_tot = within[:, -1]                      # [rows]
+    row_off = lower_strict @ row_tot             # exclusive row offsets
+    return (within + row_off[:, None]).reshape(n).astype(x.dtype)
+
+
 def _excl_cumsum(x):
-    c = jnp.cumsum(x)
+    c = _mxu_cumsum_i32(x)
     return c - x
+
+
+def _first_true_indices(avail, k):
+    """Indices of the first ``k`` True entries of ``avail`` (padded with
+    ``len(avail)``) — the free-slot scan. ``jnp.nonzero`` lowers to a slow
+    serialized cumsum+scatter on TPU; this uses the MXU scan + one bounded
+    scatter."""
+    cap = avail.shape[0]
+    rank = _excl_cumsum(avail.astype(jnp.int32))
+    tgt = jnp.where(avail & (rank < k), rank, k)
+    return (
+        jnp.full((k,), cap, jnp.int32)
+        .at[tgt]
+        .set(jnp.arange(cap, dtype=jnp.int32), mode="drop")
+    )
 
 
 def _last_writer(slots, mask, size):
@@ -410,7 +444,7 @@ def step_kernel(
         )
         leader = jnp.zeros((b,), bool).at[order].set(first_occ) & missing
         # allocate join slots for leaders
-        join_free = jnp.nonzero(state.join_key < 0, size=b, fill_value=j_cap)[0]
+        join_free = _first_true_indices(state.join_key < 0, b)
         l_rank = _excl_cumsum(leader.astype(jnp.int32))
         l_slot = join_free[jnp.clip(l_rank, 0, b - 1)]
         join_overflow = jnp.any(leader & (l_slot >= j_cap))
@@ -955,7 +989,7 @@ def step_kernel(
     ins_elem = jnp.where(ins_root, 0, jnp.where(ins_child, ftarget, batch.elem))
     ins_parent = jnp.where(ins_child, sc_slot, -1)
     ins_ikey = jnp.where(ins_root, key0, batch.instance_key)
-    free = jnp.nonzero(state.ei_state < 0, size=b, fill_value=n_cap)[0]
+    free = _first_true_indices(state.ei_state < 0, b)
     ins_rank = _excl_cumsum(ins.astype(jnp.int32))
     ins_slot = free[jnp.clip(ins_rank, 0, b - 1)]
     ei_overflow = jnp.any(ins & (ins_slot >= n_cap))
@@ -975,7 +1009,7 @@ def step_kernel(
 
     # ---------------- job table ----------------
     job_ins = m_jcreate
-    jfree = jnp.nonzero(state.job_state < 0, size=b, fill_value=m_cap)[0]
+    jfree = _first_true_indices(state.job_state < 0, b)
     j_rank = _excl_cumsum(job_ins.astype(jnp.int32))
     j_slot = jfree[jnp.clip(j_rank, 0, b - 1)]
     job_overflow = jnp.any(job_ins & (j_slot >= m_cap))
@@ -1038,7 +1072,7 @@ def step_kernel(
     # ---------------- timer table ----------------
     if graph.has_timers:
         t_ins = m_tcreate
-        tfree = jnp.nonzero(state.timer_key < 0, size=b, fill_value=t_cap)[0]
+        tfree = _first_true_indices(state.timer_key < 0, b)
         t_rank = _excl_cumsum(t_ins.astype(jnp.int32))
         t_slot = tfree[jnp.clip(t_rank, 0, b - 1)]
         timer_overflow = jnp.any(t_ins & (t_slot >= t_cap))
@@ -1070,7 +1104,7 @@ def step_kernel(
     # ---------------- output compaction ----------------
     flat_valid = em["valid"].reshape(-1)
     be = b * e_w
-    take_idx = jnp.nonzero(flat_valid, size=be, fill_value=be)[0]
+    take_idx = _first_true_indices(flat_valid, be)
     count = jnp.sum(flat_valid, dtype=jnp.int32)
 
     def compact(a):
