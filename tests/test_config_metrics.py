@@ -115,3 +115,71 @@ class TestMetrics:
         scheduler.work_until_done()
         with open(path) as f:
             assert "zb_up 1" in f.read()
+
+
+class TestWorkflowRepositoryQueries:
+    """Reference WorkflowRepositoryService: list-workflows / get-workflow
+    resource requests (gateway newWorkflowRequest / newResourceRequest)."""
+
+    def test_in_process_list_and_get(self, tmp_path):
+        from zeebe_tpu.gateway import ZeebeClient
+        from zeebe_tpu.models.bpmn.builder import Bpmn
+        from zeebe_tpu.models.bpmn.xml import read_model
+        from zeebe_tpu.runtime import Broker
+
+        broker = Broker(num_partitions=1, data_dir=str(tmp_path / "d"))
+        try:
+            client = ZeebeClient(broker)
+            model = (Bpmn.create_process("repo-proc").start_event()
+                     .service_task("t", type="x").end_event().done())
+            client.deploy_model(model)
+            client.deploy_model(model)  # version 2
+
+            all_wfs = client.list_workflows()
+            assert len(all_wfs) == 2
+            assert {w["version"] for w in all_wfs} == {1, 2}
+
+            latest = client.get_workflow(bpmn_process_id="repo-proc")
+            assert latest["version"] == 2
+            assert read_model(latest["resource"]).processes[0].id == "repo-proc"
+
+            v1 = client.get_workflow(bpmn_process_id="repo-proc", version=1)
+            assert v1["version"] == 1
+            by_key = client.get_workflow(workflow_key=v1["workflow_key"])
+            assert by_key["version"] == 1
+        finally:
+            broker.close()
+
+    def test_cluster_list_and_get_over_the_wire(self, tmp_path):
+        import time as _t
+
+        from zeebe_tpu.gateway.cluster_client import ClusterClient
+        from zeebe_tpu.models.bpmn.builder import Bpmn
+        from zeebe_tpu.runtime.cluster_broker import ClusterBroker
+        from zeebe_tpu.runtime.config import BrokerCfg
+
+        cfg = BrokerCfg()
+        cfg.cluster.node_id = "repo-broker"
+        cfg.raft.heartbeat_interval_ms = 30
+        cfg.raft.election_timeout_ms = 200
+        cfg.metrics.enabled = False
+        broker = ClusterBroker(cfg, str(tmp_path / "b"))
+        try:
+            broker.open_partition(0).join(10)
+            broker.bootstrap_partition(0, {})
+            deadline = _t.monotonic() + 20
+            while _t.monotonic() < deadline and not broker.partitions[0].is_leader:
+                _t.sleep(0.02)
+            client = ClusterClient([broker.client_address])
+            try:
+                model = (Bpmn.create_process("wire-proc").start_event()
+                         .service_task("t", type="x").end_event().done())
+                client.deploy_model(model)
+                wfs = client.list_workflows("wire-proc")
+                assert len(wfs) == 1 and wfs[0]["version"] == 1
+                got = client.get_workflow(bpmn_process_id="wire-proc")
+                assert got["resource"].startswith(b"<?xml")
+            finally:
+                client.close()
+        finally:
+            broker.close()

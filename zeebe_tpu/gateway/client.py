@@ -56,11 +56,67 @@ class WorkflowInstanceResult:
     record: Record
 
 
-class ZeebeClient:
+def _workflow_meta(wf) -> dict:
+    return {
+        "bpmn_process_id": wf.id,
+        "version": wf.version,
+        "workflow_key": wf.key,
+        "resource_name": getattr(wf, "resource_name", "") or "",
+    }
+
+
+class _RepositoryQueries:
+    """Workflow repository queries (reference WorkflowRepositoryService
+    control messages: list-workflows / get-workflow with the deployed
+    resource; ``gateway/.../api/commands/WorkflowRequest``)."""
+
+    def _repository(self):
+        raise NotImplementedError
+
+    def list_workflows(self, bpmn_process_id: Optional[str] = None) -> List[dict]:
+        repo = self._repository()
+        if bpmn_process_id:
+            workflows = list(repo.versions.get(bpmn_process_id, []))
+        else:
+            workflows = list(repo.by_key.values())
+        return [_workflow_meta(wf) for wf in sorted(workflows, key=lambda w: w.key)]
+
+    def get_workflow(
+        self,
+        workflow_key: int = -1,
+        bpmn_process_id: str = "",
+        version: int = -1,
+    ) -> dict:
+        """Fetch one workflow incl. its deployed resource. ``version=-1``
+        means latest."""
+        repo = self._repository()
+        wf = None
+        if workflow_key >= 0:
+            wf = repo.by_key.get(workflow_key)
+        elif bpmn_process_id and version >= 0:
+            wf = repo.by_id_and_version(bpmn_process_id, version)
+        elif bpmn_process_id:
+            wf = repo.latest(bpmn_process_id)
+        if wf is None:
+            raise ClientException(
+                0, f"no workflow for key={workflow_key} id={bpmn_process_id!r} "
+                   f"version={version}"
+            )
+        meta = _workflow_meta(wf)
+        meta["resource"] = wf.source_resource
+        meta["resource_type"] = wf.source_type
+        return meta
+
+
+
+class ZeebeClient(_RepositoryQueries):
     """In-process client (reference embedded-gateway mode)."""
 
     def __init__(self, broker: Broker):
         self.broker = broker
+
+    def _repository(self):
+        return self.broker.repository
 
     # -- helpers -----------------------------------------------------------
     def _await(self, request_id: Optional[int]) -> Record:

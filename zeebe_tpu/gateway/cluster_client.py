@@ -288,6 +288,60 @@ class ClusterClient:
             partitions if partitions is not None else list(range(self.num_partitions)),
         )
 
+    # -- workflow repository queries (reference newWorkflowRequest /
+    # newResourceRequest served by the system partition leader) ------------
+    def _repository_request(self, body: dict) -> dict:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            addr = self._leader_for(0)
+            if addr is None:
+                time.sleep(0.05)
+                continue
+            try:
+                rsp = msgpack.unpack(
+                    self.transport.send_request(addr, msgpack.pack(body),
+                                                timeout_ms=3000).join(4)
+                )
+            except (TransportError, ValueError, TimeoutError):
+                with self._lock:
+                    self._leaders.pop(0, None)
+                time.sleep(0.05)
+                continue
+            if rsp.get("t") == "ok":
+                return rsp
+            if rsp.get("code") == "NOT_FOUND":
+                raise ClientException(0, "workflow not found")
+            time.sleep(0.05)
+        raise TransportError("repository request failed")
+
+    def list_workflows(self, bpmn_process_id: str = "") -> List[dict]:
+        rsp = self._repository_request(
+            {"t": "list-workflows", "process_id": bpmn_process_id}
+        )
+        return [
+            {"bpmn_process_id": w["id"], "version": int(w["version"]),
+             "workflow_key": int(w["key"])}
+            for w in rsp.get("workflows", [])
+        ]
+
+    def get_workflow(self, workflow_key: int = -1, bpmn_process_id: str = "",
+                     version: int = -1) -> dict:
+        rsp = self._repository_request(
+            {
+                "t": "get-workflow",
+                "workflow_key": workflow_key,
+                "process_id": bpmn_process_id,
+                "version": version,
+            }
+        )
+        return {
+            "bpmn_process_id": rsp["id"],
+            "version": int(rsp["version"]),
+            "workflow_key": int(rsp["key"]),
+            "resource": bytes(rsp.get("resource", b"")),
+            "resource_type": rsp.get("resource_type", "BPMN_XML"),
+        }
+
     def open_topic_subscription(
         self,
         name: str,

@@ -540,6 +540,10 @@ class ClusterBroker(Actor):
             return result
         if t == "fetch-workflow":
             return self.actor.call(lambda: self._handle_fetch_workflow(msg))
+        if t == "list-workflows":
+            return self.actor.call(lambda: self._handle_list_workflows(msg))
+        if t == "get-workflow":
+            return self.actor.call(lambda: self._handle_get_workflow(msg))
         if t == "create-partition":
             return self._handle_create_partition(msg)
         if t == "bootstrap-partition":
@@ -977,6 +981,48 @@ class ClusterBroker(Actor):
         }
         self.bootstrap_partition(partition_id, members)
         return msgpack.pack({"t": "ok"})
+
+    # -- workflow repository queries (reference WorkflowRepositoryService
+    # list-workflows / get-workflow control messages) ------------------------
+    def _handle_list_workflows(self, msg: dict) -> bytes:
+        process_id = msg.get("process_id") or ""
+        if process_id:
+            workflows = list(self.repository.versions.get(process_id, []))
+        else:
+            workflows = list(self.repository.by_key.values())
+        return msgpack.pack(
+            {
+                "t": "ok",
+                "workflows": [
+                    {"id": wf.id, "version": wf.version, "key": wf.key}
+                    for wf in sorted(workflows, key=lambda w: w.key)
+                ],
+            }
+        )
+
+    def _handle_get_workflow(self, msg: dict) -> bytes:
+        workflow_key = int(msg.get("workflow_key", -1))
+        process_id = msg.get("process_id") or ""
+        version = int(msg.get("version", -1))
+        wf = None
+        if workflow_key >= 0:
+            wf = self.repository.by_key.get(workflow_key)
+        elif process_id and version >= 0:
+            wf = self.repository.by_id_and_version(process_id, version)
+        elif process_id:
+            wf = self.repository.latest(process_id)
+        if wf is None:
+            return msgpack.pack({"t": "error", "code": "NOT_FOUND"})
+        return msgpack.pack(
+            {
+                "t": "ok",
+                "id": wf.id,
+                "version": wf.version,
+                "key": wf.key,
+                "resource": wf.source_resource,
+                "resource_type": wf.source_type,
+            }
+        )
 
     # -- deployment distribution (reference FetchWorkflowRequest served by
     # the system partition's WorkflowRepositoryService; WorkflowCache on the
