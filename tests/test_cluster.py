@@ -286,8 +286,8 @@ class TestTopicSubscriptions:
             sub = client.open_topic_subscription("resume", lambda pid, r: None, ack_batch=1)
             client.deploy_model(order_process())
             client.create_instance("order-process")
-            assert wait_until(lambda: len(sub.records) >= 5, timeout=20)
-            assert wait_until(lambda: sub._since_ack == 0, timeout=10)
+            assert wait_until(lambda: len(sub.records) >= 5, timeout=30)
+            assert wait_until(lambda: sub._since_ack == 0, timeout=30)
             acked_through = sub.records[-1].position
 
             old = cluster3.leader_of(0)
@@ -298,16 +298,17 @@ class TestTopicSubscriptions:
 
             before = len(sub.records)
             client.create_instance("order-process")
-            assert wait_until(lambda: len(sub.records) > before, timeout=30)
-            fresh = sub.records[before:]
             # acks are at-least-once: the in-flight tail (acks not yet
-            # committed when the leader died) may re-deliver, but the
-            # subscription must RESUME near its progress, not rewind to the
-            # log start, and must deliver the new instance's records
+            # committed when the leader died) re-delivers first; wait until
+            # records BEYOND the acked point (the new instance's) arrive
+            assert wait_until(
+                lambda: any(r.position > acked_through for r in sub.records[before:]),
+                timeout=30,
+            ), [r.position for r in sub.records[before:]]
+            fresh = sub.records[before:]
             assert fresh[0].position > 0, "subscription rewound to log start"
             positions = [r.position for r in fresh]
             assert positions == sorted(positions)
-            assert any(r.position > acked_through for r in fresh)
             sub.close()
         finally:
             client.close()
