@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Microbenchmarks (reference JMH parity: POJOMappingBenchmark,
+MergeThroughputBenchmark, BufferedLogStreamReaderBenchmark,
+RequestResponseStressTest, BasicActorStressTest — one harness per hot
+subsystem, one JSON line per result).
+
+    python benchmarks/micro.py [name ...]
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _rate(n, t0):
+    return round(n / (time.perf_counter() - t0), 1)
+
+
+def bench_codec():
+    """Record encode/decode round trips (SBE+msgpack analogue)."""
+    from zeebe_tpu.protocol import codec
+    from zeebe_tpu.protocol.enums import RecordType
+    from zeebe_tpu.protocol.metadata import RecordMetadata
+    from zeebe_tpu.protocol.records import Record, WorkflowInstanceRecord
+
+    record = Record(
+        position=42, key=7,
+        metadata=RecordMetadata(record_type=RecordType.EVENT, value_type=5, intent=3),
+        value=WorkflowInstanceRecord(
+            bpmn_process_id="order-process", workflow_instance_key=9,
+            payload={"orderId": 1, "total": 99.5, "customer": "acme"},
+        ),
+    )
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        frame = codec.encode_record(record)
+        codec.decode_record(frame)
+    return {"metric": "codec_roundtrips_per_sec", "value": _rate(n, t0)}
+
+
+def bench_log():
+    """Append + sequential read over the segmented log."""
+    from zeebe_tpu.log import LogStream, SegmentedLogStorage
+    from zeebe_tpu.protocol.enums import RecordType
+    from zeebe_tpu.protocol.metadata import RecordMetadata
+    from zeebe_tpu.protocol.records import Record, JobRecord
+
+    with tempfile.TemporaryDirectory() as tmp:
+        log = LogStream(SegmentedLogStorage(tmp), partition_id=0)
+        n = 20_000
+        rec = lambda: Record(  # noqa: E731
+            metadata=RecordMetadata(record_type=RecordType.EVENT, value_type=0, intent=1),
+            value=JobRecord(type="payment", retries=3, payload={"k": 1}),
+        )
+        t0 = time.perf_counter()
+        for _ in range(n):
+            log.append([rec()])
+        append_rate = _rate(n, t0)
+        t0 = time.perf_counter()
+        count = sum(1 for _ in log.reader(0))
+        read_rate = _rate(count, t0)
+        return [
+            {"metric": "log_appends_per_sec", "value": append_rate},
+            {"metric": "log_reads_per_sec", "value": read_rate},
+        ]
+
+
+def bench_transport():
+    """Loopback request/response round trips (RequestResponseStressTest)."""
+    from zeebe_tpu.transport import ClientTransport, ServerTransport
+
+    server = ServerTransport(request_handler=lambda p: p)
+    client = ClientTransport(default_timeout_ms=5000)
+    try:
+        n = 3_000
+        t0 = time.perf_counter()
+        for i in range(n):
+            client.send_request(server.address, b"x" * 64).join(5)
+        return {"metric": "transport_roundtrips_per_sec", "value": _rate(n, t0)}
+    finally:
+        client.close()
+        server.close()
+
+
+def bench_actors():
+    """Actor submit/run throughput (BasicActorStressTest)."""
+    from zeebe_tpu.runtime.actors import Actor, ActorScheduler
+
+    scheduler = ActorScheduler(cpu_threads=2).start()
+    done = []
+
+    class Counter(Actor):
+        def on_actor_started(self):
+            pass
+
+    actor = Counter()
+    scheduler.submit_actor(actor).join(5)
+    n = 50_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        actor.actor.run(lambda: None)
+    actor.actor.call(lambda: done.append(1)).join(10)
+    rate = _rate(n, t0)
+    scheduler.stop()
+    return {"metric": "actor_jobs_per_sec", "value": rate}
+
+
+def bench_engine():
+    """Host-engine end-to-end records/sec (the per-record interpreter —
+    the number the TPU kernel's transitions/sec is measured against)."""
+    from zeebe_tpu.gateway import JobWorker, ZeebeClient
+    from zeebe_tpu.models.bpmn.builder import Bpmn
+    from zeebe_tpu.runtime import Broker, ControlledClock
+
+    with tempfile.TemporaryDirectory() as tmp:
+        broker = Broker(num_partitions=1, data_dir=tmp, clock=ControlledClock())
+        client = ZeebeClient(broker)
+        client.deploy_model(
+            Bpmn.create_process("p").start_event()
+            .service_task("t", type="x").end_event().done()
+        )
+        JobWorker(broker, "x", lambda ctx: {})
+        n_inst = 300
+        t0 = time.perf_counter()
+        for _ in range(n_inst):
+            client.create_instance("p")
+        broker.run_until_idle()
+        records = len(broker.records(0))
+        rate = _rate(records, t0)
+        broker.close()
+        return {"metric": "host_engine_records_per_sec", "value": rate,
+                "detail": {"records": records, "instances": n_inst}}
+
+
+BENCHES = {
+    "codec": bench_codec,
+    "log": bench_log,
+    "transport": bench_transport,
+    "actors": bench_actors,
+    "engine": bench_engine,
+}
+
+
+def main():
+    names = sys.argv[1:] or list(BENCHES)
+    for name in names:
+        result = BENCHES[name]()
+        for row in result if isinstance(result, list) else [result]:
+            print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()
