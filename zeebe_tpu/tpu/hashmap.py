@@ -53,10 +53,15 @@ def make(capacity: int) -> HashTable:
 
 
 def _hash(keys: jax.Array, table_size: int) -> jax.Array:
-    # Fibonacci (golden-ratio) multiplicative hash, then fold high bits.
-    h = keys * jnp.int64(-7046029254386353131)  # 0x9E3779B97F4A7C15 as signed
-    h = h ^ (h >> jnp.int64(31))
-    return (h & jnp.int64(table_size - 1)).astype(jnp.int32)
+    # Multiplicative hash over the two 32-bit halves. TPUs have no native
+    # 64-bit multiply (XLA emulates it with 32-bit mul chains — it showed
+    # up in every probe-loop fusion); two u32 multiplies are native-cheap
+    # and mix just as well for monotone-counter keys.
+    lo = keys.astype(jnp.uint32)
+    hi = (keys >> jnp.int64(32)).astype(jnp.uint32)
+    h = lo * jnp.uint32(0x9E3779B1) ^ hi * jnp.uint32(0x85EBCA77)
+    h = h ^ (h >> jnp.uint32(15))
+    return (h & jnp.uint32(table_size - 1)).astype(jnp.int32)
 
 
 def lookup(table: HashTable, keys: jax.Array, valid: jax.Array):
