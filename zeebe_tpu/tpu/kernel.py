@@ -43,7 +43,13 @@ from zeebe_tpu.tpu.conditions import ERROR as TRI_ERROR
 from zeebe_tpu.tpu.conditions import TRUE as TRI_TRUE
 from zeebe_tpu.tpu.conditions import VT_ABSENT, eval_programs
 from zeebe_tpu.tpu.graph import DeviceGraph
-from zeebe_tpu.tpu.state import EngineState
+from zeebe_tpu.tpu.state import (
+    EngineState,
+    EI_ELEM, EI_STATE, EI_WF, EI_SCOPE, EI_TOKENS,
+    EIL_KEY, EIL_IKEY, EIL_JOB_KEY,
+    JB_STATE, JB_ELEM, JB_WF, JB_TYPE, JB_RETRIES, JB_WORKER,
+    JBL_KEY, JBL_IKEY, JBL_AIK, JBL_DEADLINE,
+)
 
 RT_EVENT = int(RecordType.EVENT)
 RT_CMD = int(RecordType.COMMAND)
@@ -932,10 +938,10 @@ def step_kernel(
     tok_delta = tok_delta.at[jnp.where(completer, sc_clip, n_cap)].add(
         -(nin_rec - 1), mode="drop"
     )
-    ei_tokens = state.ei_tokens + tok_delta
-    ei_tokens = ei_tokens.at[jnp.where(m_trigstart, ei_clip, n_cap)].set(
-        1, mode="drop"
-    )
+    ei_i32_arr = state.ei_i32.at[:, EI_TOKENS].add(tok_delta)
+    ei_i32_arr = ei_i32_arr.at[
+        jnp.where(m_trigstart, ei_clip, n_cap), EI_TOKENS
+    ].set(1, mode="drop")
 
     # scope payload on consume (oracle: scope value.payload = record payload)
     ei_vt, ei_num, ei_str = _scatter_payload(
@@ -943,40 +949,40 @@ def step_kernel(
         sc_clip, m_consume, batch.v_vt, batch.v_num, batch.v_str, n_cap,
     )
     # scope state transition by consume completer
-    ei_state_arr = state.ei_state.at[
-        jnp.where(consume_completer, sc_clip, n_cap)
+    ei_i32_arr = ei_i32_arr.at[
+        jnp.where(consume_completer, sc_clip, n_cap), EI_STATE
     ].set(int(WI.ELEMENT_COMPLETING), mode="drop")
     # own-instance transitions
-    ei_state_arr = ei_state_arr.at[jnp.where(inmap_ok, ei_clip, n_cap)].set(
+    ei_i32_arr = ei_i32_arr.at[jnp.where(inmap_ok, ei_clip, n_cap), EI_STATE].set(
         int(WI.ELEMENT_ACTIVATED), mode="drop"
     )
     ei_vt, ei_num, ei_str = _scatter_payload(
         ei_vt, ei_num, ei_str, ei_clip, inmap_ok, in_vt, in_num, in_sid, n_cap
     )
     # job completed → instance completing
-    ei_state_arr = ei_state_arr.at[jnp.where(jev_completed, aik_clip, n_cap)].set(
+    ei_i32_arr = ei_i32_arr.at[jnp.where(jev_completed, aik_clip, n_cap), EI_STATE].set(
         int(WI.ELEMENT_COMPLETING), mode="drop"
     )
     ei_vt, ei_num, ei_str = _scatter_payload(
         ei_vt, ei_num, ei_str, aik_clip, jev_completed,
         batch.v_vt, batch.v_num, batch.v_str, n_cap,
     )
-    ei_job_key = state.ei_job_key.at[jnp.where(jev_completed, aik_clip, n_cap)].set(
-        -1, mode="drop"
-    )
-    ei_job_key = ei_job_key.at[
-        jnp.where(jev_created & aik_found, aik_clip, n_cap)
+    ei_i64_arr = state.ei_i64.at[
+        jnp.where(jev_completed, aik_clip, n_cap), EIL_JOB_KEY
+    ].set(-1, mode="drop")
+    ei_i64_arr = ei_i64_arr.at[
+        jnp.where(jev_created & aik_found, aik_clip, n_cap), EIL_JOB_KEY
     ].set(batch.key, mode="drop")
     # timer trigger → instance completing
-    ei_state_arr = ei_state_arr.at[jnp.where(ttrig_inst, aik_clip, n_cap)].set(
+    ei_i32_arr = ei_i32_arr.at[jnp.where(ttrig_inst, aik_clip, n_cap), EI_STATE].set(
         int(WI.ELEMENT_COMPLETING), mode="drop"
     )
 
     # removals (final states written this round)
     ei_remove = outmap_ok | m_complete_proc
     rm_w = jnp.where(ei_remove, ei_clip, n_cap)
-    ei_state_arr = ei_state_arr.at[rm_w].set(-1, mode="drop")
-    ei_key_arr = state.ei_key.at[rm_w].set(-1, mode="drop")
+    ei_i32_arr = ei_i32_arr.at[rm_w, EI_STATE].set(-1, mode="drop")
+    ei_i64_arr = ei_i64_arr.at[rm_w, EIL_KEY].set(-1, mode="drop")
     ei_map = hashmap.delete(state.ei_map, batch.key, ei_remove)
 
     # inserts: CREATE command roots + START_STATEFUL children (+ replayed
@@ -994,14 +1000,17 @@ def step_kernel(
     ins_slot = free[jnp.clip(ins_rank, 0, b - 1)]
     ei_overflow = jnp.any(ins & (ins_slot >= n_cap))
     iw = jnp.where(ins, ins_slot, n_cap)
-    ei_key_arr = ei_key_arr.at[iw].set(ins_key, mode="drop")
-    ei_state_arr = ei_state_arr.at[iw].set(int(WI.ELEMENT_READY), mode="drop")
-    ei_elem_arr = state.ei_elem.at[iw].set(ins_elem, mode="drop")
-    ei_wf_arr = state.ei_wf.at[iw].set(batch.wf, mode="drop")
-    ei_scope_arr = state.ei_scope_slot.at[iw].set(ins_parent, mode="drop")
-    ei_ikey_arr = state.ei_instance_key.at[iw].set(ins_ikey, mode="drop")
-    ei_tokens = ei_tokens.at[iw].set(0, mode="drop")
-    ei_job_key = ei_job_key.at[iw].set(-1, mode="drop")
+    # one row scatter per dtype group (the point of the packed layout)
+    ei_i32_rows = jnp.stack(
+        [ins_elem,
+         jnp.full((b,), int(WI.ELEMENT_READY), jnp.int32),
+         batch.wf, ins_parent, jnp.zeros((b,), jnp.int32)], axis=-1,
+    )
+    ei_i32_arr = ei_i32_arr.at[iw].set(ei_i32_rows, mode="drop")
+    ei_i64_rows = jnp.stack(
+        [ins_key, ins_ikey, jnp.full((b,), -1, jnp.int64)], axis=-1
+    )
+    ei_i64_arr = ei_i64_arr.at[iw].set(ei_i64_rows, mode="drop")
     ei_vt = ei_vt.at[iw].set(batch.v_vt, mode="drop")
     ei_num = ei_num.at[iw].set(batch.v_num, mode="drop")
     ei_str = ei_str.at[iw].set(batch.v_str, mode="drop")
@@ -1014,48 +1023,61 @@ def step_kernel(
     j_slot = jfree[jnp.clip(j_rank, 0, b - 1)]
     job_overflow = jnp.any(job_ins & (j_slot >= m_cap))
     jw = jnp.where(job_ins, j_slot, m_cap)
-    job_key_arr = state.job_key.at[jw].set(job_base, mode="drop")
-    job_state_arr = state.job_state.at[jw].set(int(JI.CREATED), mode="drop")
-    job_elem_arr = state.job_elem.at[jw].set(batch.elem, mode="drop")
-    job_wf_arr = state.job_wf.at[jw].set(batch.wf, mode="drop")
-    job_ik_arr = state.job_instance_key.at[jw].set(batch.instance_key, mode="drop")
-    job_aik_arr = state.job_aik.at[jw].set(batch.aux_key, mode="drop")
-    job_type_arr = state.job_type.at[jw].set(batch.type_id, mode="drop")
-    job_retries_arr = state.job_retries.at[jw].set(batch.retries, mode="drop")
-    job_deadline_arr = state.job_deadline.at[jw].set(-1, mode="drop")
-    job_worker_arr = state.job_worker.at[jw].set(0, mode="drop")
+    job_i32_rows = jnp.stack(
+        [jnp.full((b,), int(JI.CREATED), jnp.int32),
+         batch.elem, batch.wf, batch.type_id, batch.retries,
+         jnp.zeros((b,), jnp.int32)], axis=-1,
+    )
+    job_i32_arr = state.job_i32.at[jw].set(job_i32_rows, mode="drop")
+    job_i64_rows = jnp.stack(
+        [job_base, batch.instance_key, batch.aux_key,
+         jnp.full((b,), -1, jnp.int64)], axis=-1,
+    )
+    job_i64_arr = state.job_i64.at[jw].set(job_i64_rows, mode="drop")
     job_vt_arr = state.job_vt.at[jw].set(batch.v_vt, mode="drop")
     job_num_arr = state.job_num.at[jw].set(batch.v_num, mode="drop")
     job_str_arr = state.job_str.at[jw].set(batch.v_str, mode="drop")
     job_map, job_ins_ok = hashmap.insert(state.job_map, job_base, j_slot, job_ins)
 
-    # transitions
+    # transitions: multi-column scatters share one op per dtype group
     jup = jnp.where(jact_ok, jb_clip, m_cap)
-    job_state_arr = job_state_arr.at[jup].set(int(JI.ACTIVATED), mode="drop")
-    job_deadline_arr = job_deadline_arr.at[jup].set(batch.deadline, mode="drop")
-    job_worker_arr = job_worker_arr.at[jup].set(batch.worker, mode="drop")
-    job_retries_arr = job_retries_arr.at[jup].set(batch.retries, mode="drop")
+    act_cols = jnp.array([JB_STATE, JB_WORKER, JB_RETRIES], jnp.int32)
+    job_i32_arr = job_i32_arr.at[jup[:, None], act_cols[None, :]].set(
+        jnp.stack(
+            [jnp.full((b,), int(JI.ACTIVATED), jnp.int32),
+             batch.worker, batch.retries], axis=-1,
+        ),
+        mode="drop",
+    )
+    job_i64_arr = job_i64_arr.at[jup, JBL_DEADLINE].set(
+        batch.deadline, mode="drop"
+    )
     job_vt_arr = job_vt_arr.at[jup].set(batch.v_vt, mode="drop")
     job_num_arr = job_num_arr.at[jup].set(batch.v_num, mode="drop")
     job_str_arr = job_str_arr.at[jup].set(batch.v_str, mode="drop")
 
     jfw = jnp.where(jfail_ok, jb_clip, m_cap)
-    job_state_arr = job_state_arr.at[jfw].set(int(JI.FAILED), mode="drop")
-    job_retries_arr = job_retries_arr.at[jfw].set(batch.retries, mode="drop")
+    fail_cols = jnp.array([JB_STATE, JB_RETRIES], jnp.int32)
+    job_i32_arr = job_i32_arr.at[jfw[:, None], fail_cols[None, :]].set(
+        jnp.stack(
+            [jnp.full((b,), int(JI.FAILED), jnp.int32), batch.retries], axis=-1
+        ),
+        mode="drop",
+    )
     job_vt_arr = job_vt_arr.at[jfw].set(fail_vt, mode="drop")
     job_num_arr = job_num_arr.at[jfw].set(fail_num, mode="drop")
     job_str_arr = job_str_arr.at[jfw].set(fail_sid, mode="drop")
 
-    job_state_arr = job_state_arr.at[jnp.where(jtime_ok, jb_clip, m_cap)].set(
-        int(JI.TIMED_OUT), mode="drop"
-    )
-    job_retries_arr = job_retries_arr.at[jnp.where(jret_ok, jb_clip, m_cap)].set(
-        batch.retries, mode="drop"
-    )
+    job_i32_arr = job_i32_arr.at[
+        jnp.where(jtime_ok, jb_clip, m_cap), JB_STATE
+    ].set(int(JI.TIMED_OUT), mode="drop")
+    job_i32_arr = job_i32_arr.at[
+        jnp.where(jret_ok, jb_clip, m_cap), JB_RETRIES
+    ].set(batch.retries, mode="drop")
     job_rm = jcomp_ok | jcan_ok
     jrm = jnp.where(job_rm, jb_clip, m_cap)
-    job_state_arr = job_state_arr.at[jrm].set(-1, mode="drop")
-    job_key_arr = job_key_arr.at[jrm].set(-1, mode="drop")
+    job_i32_arr = job_i32_arr.at[jrm, JB_STATE].set(-1, mode="drop")
+    job_i64_arr = job_i64_arr.at[jrm, JBL_KEY].set(-1, mode="drop")
     job_map = hashmap.delete(job_map, batch.key, job_rm)
 
     # ---------------- join cleanup ----------------
@@ -1163,14 +1185,9 @@ def step_kernel(
     )
 
     new_state = EngineState(
-        ei_key=ei_key_arr, ei_elem=ei_elem_arr, ei_state=ei_state_arr,
-        ei_wf=ei_wf_arr, ei_scope_slot=ei_scope_arr, ei_instance_key=ei_ikey_arr,
-        ei_tokens=ei_tokens, ei_job_key=ei_job_key,
+        ei_i32=ei_i32_arr, ei_i64=ei_i64_arr,
         ei_vt=ei_vt, ei_num=ei_num, ei_str=ei_str, ei_map=ei_map,
-        job_key=job_key_arr, job_state=job_state_arr, job_elem=job_elem_arr,
-        job_wf=job_wf_arr, job_instance_key=job_ik_arr, job_aik=job_aik_arr,
-        job_type=job_type_arr, job_retries=job_retries_arr,
-        job_deadline=job_deadline_arr, job_worker=job_worker_arr,
+        job_i32=job_i32_arr, job_i64=job_i64_arr,
         job_vt=job_vt_arr, job_num=job_num_arr, job_str=job_str_arr,
         job_map=job_map,
         join_key=join_key_arr, join_nin=join_nin_arr, join_arrived=arrived,

@@ -30,12 +30,17 @@ import jax.numpy as jnp
 from zeebe_tpu.engine import keyspace
 from zeebe_tpu.tpu import hashmap
 
+# packed column layouts: same-dtype scalar fields of a table live in one
+# [cap, K] matrix so inserts/updates touching many fields are ONE row
+# scatter instead of one scatter fusion per field
+EI_ELEM, EI_STATE, EI_WF, EI_SCOPE, EI_TOKENS = 0, 1, 2, 3, 4
+EIL_KEY, EIL_IKEY, EIL_JOB_KEY = 0, 1, 2
+JB_STATE, JB_ELEM, JB_WF, JB_TYPE, JB_RETRIES, JB_WORKER = 0, 1, 2, 3, 4, 5
+JBL_KEY, JBL_IKEY, JBL_AIK, JBL_DEADLINE = 0, 1, 2, 3
+
 _STATE_FIELDS = [
-    "ei_key", "ei_elem", "ei_state", "ei_wf", "ei_scope_slot", "ei_instance_key",
-    "ei_tokens", "ei_job_key", "ei_vt", "ei_num", "ei_str", "ei_map",
-    "job_key", "job_state", "job_elem", "job_wf", "job_instance_key",
-    "job_aik", "job_type", "job_retries", "job_deadline", "job_worker",
-    "job_vt", "job_num", "job_str", "job_map",
+    "ei_i32", "ei_i64", "ei_vt", "ei_num", "ei_str", "ei_map",
+    "job_i32", "job_i64", "job_vt", "job_num", "job_str", "job_map",
     "join_key", "join_nin", "join_arrived", "join_vt", "join_num", "join_str",
     "join_pos_stamp", "join_map",
     "timer_key", "timer_due", "timer_aik", "timer_instance_key", "timer_elem",
@@ -49,31 +54,21 @@ _STATE_FIELDS = [
 @partial(jax.tree_util.register_dataclass, data_fields=_STATE_FIELDS, meta_fields=[])
 @dataclasses.dataclass
 class EngineState:
-    # element instances [N]
-    ei_key: jax.Array          # i64, -1 free
-    ei_elem: jax.Array         # i32
-    ei_state: jax.Array        # i32 lifecycle intent, -1 free
-    ei_wf: jax.Array           # i32 workflow slot
-    ei_scope_slot: jax.Array   # i32 parent slot, -1 root
-    ei_instance_key: jax.Array # i64 workflowInstanceKey
-    ei_tokens: jax.Array       # i32 active tokens in this scope
-    ei_job_key: jax.Array      # i64
+    # element instances [N] (ElementInstanceIndex analogue), packed:
+    # ei_i32 cols = (elem, lifecycle state[-1 free], wf slot, scope slot,
+    # token count); ei_i64 cols = (key[-1 free], workflowInstanceKey, jobKey)
+    ei_i32: jax.Array          # [N, 5] i32
+    ei_i64: jax.Array          # [N, 3] i64
     ei_vt: jax.Array           # [N, V] i8 payload value types
     ei_num: jax.Array          # [N, V] f64
     ei_str: jax.Array          # [N, V] i32
     ei_map: hashmap.HashTable  # key → slot
 
-    # jobs [M]
-    job_key: jax.Array         # i64, -1 free
-    job_state: jax.Array       # i32 (JobIntent of last state event), -1 free
-    job_elem: jax.Array        # i32 (headers.activityId element)
-    job_wf: jax.Array          # i32
-    job_instance_key: jax.Array# i64
-    job_aik: jax.Array         # i64 headers.activityInstanceKey
-    job_type: jax.Array        # i32 interned
-    job_retries: jax.Array     # i32
-    job_deadline: jax.Array    # i64
-    job_worker: jax.Array      # i32 interned
+    # jobs [M], packed: job_i32 cols = (state[-1 free], elem, wf, type,
+    # retries, worker); job_i64 cols = (key[-1 free], instanceKey, aik,
+    # deadline)
+    job_i32: jax.Array         # [M, 6] i32
+    job_i64: jax.Array         # [M, 4] i64
     job_vt: jax.Array          # [M, V]
     job_num: jax.Array
     job_str: jax.Array
@@ -111,9 +106,48 @@ class EngineState:
     next_wf_key: jax.Array
     next_job_key: jax.Array
 
+    # unpacked read views (lazy column slices — free inside jit; host code
+    # and the kernel's read paths keep the original field names)
+    @property
+    def ei_key(self): return self.ei_i64[:, EIL_KEY]
+    @property
+    def ei_instance_key(self): return self.ei_i64[:, EIL_IKEY]
+    @property
+    def ei_job_key(self): return self.ei_i64[:, EIL_JOB_KEY]
+    @property
+    def ei_elem(self): return self.ei_i32[:, EI_ELEM]
+    @property
+    def ei_state(self): return self.ei_i32[:, EI_STATE]
+    @property
+    def ei_wf(self): return self.ei_i32[:, EI_WF]
+    @property
+    def ei_scope_slot(self): return self.ei_i32[:, EI_SCOPE]
+    @property
+    def ei_tokens(self): return self.ei_i32[:, EI_TOKENS]
+    @property
+    def job_key(self): return self.job_i64[:, JBL_KEY]
+    @property
+    def job_instance_key(self): return self.job_i64[:, JBL_IKEY]
+    @property
+    def job_aik(self): return self.job_i64[:, JBL_AIK]
+    @property
+    def job_deadline(self): return self.job_i64[:, JBL_DEADLINE]
+    @property
+    def job_state(self): return self.job_i32[:, JB_STATE]
+    @property
+    def job_elem(self): return self.job_i32[:, JB_ELEM]
+    @property
+    def job_wf(self): return self.job_i32[:, JB_WF]
+    @property
+    def job_type(self): return self.job_i32[:, JB_TYPE]
+    @property
+    def job_retries(self): return self.job_i32[:, JB_RETRIES]
+    @property
+    def job_worker(self): return self.job_i32[:, JB_WORKER]
+
     @property
     def capacity(self) -> int:
-        return self.ei_key.shape[0]
+        return self.ei_i32.shape[0]
 
     @property
     def num_vars(self) -> int:
@@ -144,28 +178,16 @@ def make_state(
     i64, i32, i8, f64 = jnp.int64, jnp.int32, jnp.int8, jnp.float64
 
     return EngineState(
-        ei_key=jnp.full((n,), -1, i64),
-        ei_elem=jnp.zeros((n,), i32),
-        ei_state=jnp.full((n,), -1, i32),
-        ei_wf=jnp.zeros((n,), i32),
-        ei_scope_slot=jnp.full((n,), -1, i32),
-        ei_instance_key=jnp.full((n,), -1, i64),
-        ei_tokens=jnp.zeros((n,), i32),
-        ei_job_key=jnp.full((n,), -1, i64),
+        # ei_i32: elem=0, state=-1, wf=0, scope=-1, tokens=0
+        ei_i32=jnp.tile(jnp.array([[0, -1, 0, -1, 0]], i32), (n, 1)),
+        ei_i64=jnp.full((n, 3), -1, i64),
         ei_vt=jnp.zeros((n, v), i8),
         ei_num=jnp.zeros((n, v), f64),
         ei_str=jnp.zeros((n, v), i32),
         ei_map=hashmap.make(_pow2(4 * n)),
-        job_key=jnp.full((m,), -1, i64),
-        job_state=jnp.full((m,), -1, i32),
-        job_elem=jnp.zeros((m,), i32),
-        job_wf=jnp.zeros((m,), i32),
-        job_instance_key=jnp.full((m,), -1, i64),
-        job_aik=jnp.full((m,), -1, i64),
-        job_type=jnp.zeros((m,), i32),
-        job_retries=jnp.zeros((m,), i32),
-        job_deadline=jnp.full((m,), -1, i64),
-        job_worker=jnp.zeros((m,), i32),
+        # job_i32: state=-1, elem/wf/type/retries/worker=0
+        job_i32=jnp.tile(jnp.array([[-1, 0, 0, 0, 0, 0]], i32), (m, 1)),
+        job_i64=jnp.full((m, 4), -1, i64),
         job_vt=jnp.zeros((m, v), i8),
         job_num=jnp.zeros((m, v), f64),
         job_str=jnp.zeros((m, v), i32),
