@@ -185,12 +185,21 @@ class TestReplication:
     def test_append_on_follower_rejected(self, scheduler, tmp_path):
         cluster = Cluster(scheduler, tmp_path, 3)
         try:
-            leader = cluster.await_leader()
-            follower = next(
-                n for n in cluster.nodes.values() if n.node_id != leader.node_id
-            )
-            with pytest.raises(RuntimeError, match="not leader"):
-                follower.append([job_record(0)]).join(5)
+            # leadership can move between picking a follower and appending
+            # (elections flap under load); retry until an append hit a node
+            # that was still follower at that instant
+            for _ in range(10):
+                leader = cluster.await_leader()
+                follower = next(
+                    n for n in cluster.nodes.values() if n.node_id != leader.node_id
+                )
+                try:
+                    follower.append([job_record(0)]).join(5)
+                except RuntimeError as e:
+                    assert "not leader" in str(e)
+                    break
+            else:
+                pytest.fail("append never hit a follower")
         finally:
             cluster.close()
 
