@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Profile the drive loop: per-op time breakdown of one quiescence wave.
+
+Runs the bench setup (order-process, wave 2^14), captures a trace of a few
+timed waves, and prints the top ops by total self time. Maps fusion names
+back to source lines where the trace metadata has them.
+
+Usage: python benchmarks/profile_round.py [--wave 14] [--trace-dir DIR]
+"""
+
+import argparse
+import dataclasses
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--wave", type=int, default=14)
+    ap.add_argument("--waves", type=int, default=3)
+    ap.add_argument("--trace-dir", default="/tmp/zbtpu-trace")
+    args = ap.parse_args()
+
+    from zeebe_tpu import tpu as _tpu  # noqa: F401
+    import jax
+    import jax.numpy as jnp
+
+    from zeebe_tpu.tpu import drive, hashmap, state as state_mod
+    import bench
+
+    wave = 1 << args.wave
+    capacity = 4 * wave
+    graph, meta = bench.build_graph()
+    meta.varspace.column("orderId")
+    meta.varspace.column("orderValue")
+    meta.varspace.column("paid")
+    num_vars = max(graph.num_vars, 8)
+    graph = dataclasses.replace(graph, num_vars=num_vars)
+
+    state = state_mod.make_state(
+        capacity=capacity, num_vars=num_vars, job_capacity=capacity,
+        sub_capacity=8,
+    )
+    import numpy as np
+    state = dataclasses.replace(
+        state,
+        sub_key=state.sub_key.at[0].set(1),
+        sub_type=state.sub_type.at[0].set(meta.interns.intern("payment-service")),
+        sub_worker=state.sub_worker.at[0].set(meta.interns.intern("bench-worker")),
+        sub_credits=state.sub_credits.at[0].set(np.int32(2**31 - 1)),
+        sub_timeout=state.sub_timeout.at[0].set(300_000),
+        sub_valid=state.sub_valid.at[0].set(True),
+    )
+    queue = drive.make_queue(8 * wave, num_vars)
+    creates = bench.stage_creates(meta, wave, num_vars, meta.interns)
+    enqueue_jit = jax.jit(drive.enqueue, donate_argnums=(0,))
+    rebuild_jit = jax.jit(
+        lambda st: dataclasses.replace(
+            st,
+            ei_map=hashmap.rebuild_from(
+                st.ei_map.keys.shape[0], st.ei_key,
+                jnp.arange(st.ei_key.shape[0], dtype=jnp.int32),
+                st.ei_state >= 0)[0],
+            job_map=hashmap.rebuild_from(
+                st.job_map.keys.shape[0], st.job_key,
+                jnp.arange(st.job_key.shape[0], dtype=jnp.int32),
+                st.job_state >= 0)[0],
+        ),
+        donate_argnums=(0,),
+    )
+
+    def run_wave(state, queue, sync=True):
+        queue = enqueue_jit(queue, creates)
+        return drive.run_to_quiescence(
+            graph, state, queue, 0, wave, synthetic_workers=True, sync=sync)
+
+    print("warmup/compile...", file=sys.stderr)
+    t0 = time.perf_counter()
+    state, queue, warm = run_wave(state, queue)
+    print(f"warmup {time.perf_counter()-t0:.1f}s totals={warm}", file=sys.stderr)
+    state = rebuild_jit(state)
+    jax.block_until_ready(state.ei_state)
+
+    # timed, untraced: ground-truth wave time
+    t0 = time.perf_counter()
+    for _ in range(args.waves):
+        state, queue, tot = run_wave(state, queue, sync=False)
+        state = rebuild_jit(state)
+    jax.block_until_ready(state.ei_state)
+    per_wave = (time.perf_counter() - t0) / args.waves
+    rounds = warm["rounds"]
+    print(f"per-wave {per_wave*1e3:.1f}ms  (warm rounds={rounds}, "
+          f"per-round {per_wave/rounds*1e3:.2f}ms)", file=sys.stderr)
+
+    # traced wave
+    os.system(f"rm -rf {args.trace_dir}")
+    with jax.profiler.trace(args.trace_dir):
+        state, queue, tot = run_wave(state, queue, sync=False)
+        state = rebuild_jit(state)
+        jax.block_until_ready(state.ei_state)
+
+    # parse trace: sum durations per op name on the device track
+    paths = glob.glob(f"{args.trace_dir}/**/*.trace.json.gz", recursive=True)
+    if not paths:
+        print("no trace found", file=sys.stderr)
+        return
+    with gzip.open(paths[0], "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    # find device pids (TPU core tracks)
+    dev_pids = set()
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            nm = e.get("args", {}).get("name", "")
+            if "TPU" in nm or "/device:" in nm or "Chip" in nm:
+                dev_pids.add(e["pid"])
+    agg = defaultdict(lambda: [0.0, 0])
+    for e in events:
+        if e.get("ph") == "X" and e.get("pid") in dev_pids:
+            nm = e.get("name", "")
+            agg[nm][0] += e.get("dur", 0)
+            agg[nm][1] += 1
+    total = sum(v[0] for v in agg.values())
+    print(f"\ndevice total {total/1e3:.1f}ms over {len(agg)} distinct ops")
+    for nm, (dur, n) in sorted(agg.items(), key=lambda kv: -kv[1][0])[:45]:
+        print(f"{dur/1e3:9.2f}ms  x{n:5d}  {nm[:110]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,169 @@
+"""Snapshot codec safety + round-trip (zeebe_tpu/log/stateser.py).
+
+Snapshots cross the unauthenticated snapshot-replication wire
+(``cluster_broker._fetch_snapshots_from_leader``), so decoding must be a
+pure data operation: no pickle, nothing executable, malformed input
+rejected with SnapshotFormatError. Reference stance: the broker replicates
+opaque RocksDB/state files and never deserializes objects from peers
+(``broker-core/.../clustering/base/snapshots/SnapshotReplicationService.java``).
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from zeebe_tpu.engine.interpreter import PartitionEngine, WorkflowRepository
+from zeebe_tpu.log import stateser
+from zeebe_tpu.log.snapshot import (
+    SnapshotController,
+    SnapshotMetadata,
+    SnapshotStorage,
+)
+from zeebe_tpu.gateway import ZeebeClient
+from zeebe_tpu.models.bpmn.builder import Bpmn
+from zeebe_tpu.protocol import msgpack
+from zeebe_tpu.runtime import Broker, ControlledClock
+
+
+@pytest.fixture
+def traffic_broker(tmp_path):
+    b = Broker(
+        num_partitions=1,
+        data_dir=str(tmp_path / "data"),
+        clock=ControlledClock(start_ms=1_000_000),
+    )
+    client = ZeebeClient(b)
+    model = (
+        Bpmn.create_process("order")
+        .start_event("start")
+        .service_task("task", type="work")
+        .end_event("end")
+        .done()
+    )
+    client.deploy_model(model)
+    client.create_instance("order", {"a": 1, "s": "x"})
+    client.create_instance("order", {"a": 2})
+    b.run_until_idle()
+    yield b
+    b.close()
+
+
+class TestHostStateRoundTrip:
+    def test_round_trip_preserves_replay_equivalence(self, traffic_broker):
+        engine = traffic_broker.partitions[0].engine
+        state = engine.snapshot_state()
+        payload = stateser.encode_state(state)
+        assert isinstance(payload, bytes)
+        restored = stateser.decode_state(payload)
+
+        fresh = PartitionEngine(
+            partition_id=0, num_partitions=1, repository=WorkflowRepository(),
+            clock=engine.clock,
+        )
+        fresh.restore_state(restored)
+        # the restored engine serves the same state families
+        assert set(fresh.element_instances.instances) == set(
+            engine.element_instances.instances
+        )
+        assert set(fresh.jobs) == set(engine.jobs)
+        for k, js in engine.jobs.items():
+            assert fresh.jobs[k].state == js.state
+            assert fresh.jobs[k].record.to_document() == js.record.to_document()
+        assert fresh.last_processed_position == engine.last_processed_position
+        # key generators resume where they left off
+        assert fresh.wf_keys.peek == engine.wf_keys.peek
+        assert fresh.job_keys.peek == engine.job_keys.peek
+        # workflows re-transformed from source are executable
+        wf = fresh.repository.latest("order")
+        assert wf is not None and wf.key == engine.repository.latest("order").key
+        assert wf.element_by_id("task").job_type == "work"
+
+    def test_scope_tree_round_trip(self, traffic_broker):
+        engine = traffic_broker.partitions[0].engine
+        state = engine.snapshot_state()
+        restored = stateser.decode_state(stateser.encode_state(state))
+        for key, inst in engine.element_instances.instances.items():
+            r = restored["element_instances"].get(key)
+            assert r is not None
+            assert r.state == inst.state
+            assert r.active_tokens == inst.active_tokens
+            if inst.parent is None:
+                assert r.parent is None
+            else:
+                assert r.parent.key == inst.parent.key
+            assert [c.key for c in r.children] == [c.key for c in inst.children]
+
+
+class TestUntrustedPayloadRejection:
+    def test_pickle_payload_rejected_not_executed(self, tmp_path):
+        # a malicious peer plants a pickle that would execute on load
+        class Boom:
+            def __reduce__(self):
+                return (pytest.fail, ("pickle payload was executed!",))
+
+        evil = pickle.dumps(Boom())
+        storage = SnapshotStorage(str(tmp_path))
+        storage.write(SnapshotMetadata(5, 5, 1), evil)
+        controller = SnapshotController(storage)
+        state, meta = controller.recover(log_last_position=100)
+        assert state is None and meta is None
+
+    def test_garbage_bytes_rejected(self):
+        with pytest.raises(stateser.SnapshotFormatError):
+            stateser.decode_state(b"\x00\x01\x02 garbage")
+
+    def test_wrong_format_tag_rejected(self):
+        payload = msgpack.pack({"fmt": "something-else", "data": 1})
+        with pytest.raises(stateser.SnapshotFormatError):
+            stateser.decode_state(payload)
+
+    def test_truncated_valid_payload_rejected(self, traffic_broker):
+        engine = traffic_broker.partitions[0].engine
+        payload = stateser.encode_state(engine.snapshot_state())
+        with pytest.raises(stateser.SnapshotFormatError):
+            stateser.decode_state(payload[: len(payload) // 2])
+
+    def test_malformed_host_fields_rejected(self):
+        doc = {"fmt": stateser.FORMAT_HOST_V1, "wf_keys": "nope"}
+        with pytest.raises(stateser.SnapshotFormatError):
+            stateser.decode_state(msgpack.pack(doc))
+
+    def test_ndarray_dtype_allowlist(self):
+        with pytest.raises(stateser.SnapshotFormatError):
+            stateser.unpack_ndarray({"__nd": "object", "sh": [1], "b": b"x"})
+
+    def test_ndarray_size_mismatch_rejected(self):
+        with pytest.raises(stateser.SnapshotFormatError):
+            stateser.unpack_ndarray({"__nd": "int32", "sh": [100], "b": b"\0" * 8})
+
+
+class TestDeviceEnvelope:
+    def test_device_state_round_trip(self):
+        arrays = {
+            "ei_i32": np.arange(12, dtype=np.int32).reshape(3, 4),
+            "flags": np.array([True, False, True]),
+            "nums": np.linspace(0, 1, 5),
+        }
+        state = {
+            "fmt": stateser.FORMAT_DEVICE_V1,
+            "arrays": arrays,
+            "meta": {"num_vars": 8, "capacity": 3},
+            "host": None,
+        }
+        restored = stateser.decode_state(stateser.encode_state(state))
+        assert restored["meta"] == {"num_vars": 8, "capacity": 3}
+        for name, a in arrays.items():
+            np.testing.assert_array_equal(restored["arrays"][name], a)
+            assert restored["arrays"][name].dtype == a.dtype
+
+    def test_device_state_with_embedded_host(self, traffic_broker):
+        engine = traffic_broker.partitions[0].engine
+        state = {
+            "fmt": stateser.FORMAT_DEVICE_V1,
+            "arrays": {"x": np.ones((2, 2), np.float32)},
+            "meta": {},
+            "host": engine.snapshot_state(),
+        }
+        restored = stateser.decode_state(stateser.encode_state(state))
+        assert set(restored["host"]["jobs"]) == set(engine.jobs)

@@ -459,8 +459,14 @@ class Raft(Actor):
         )
 
     def _handle_poll(self, msg: dict) -> bytes:
+        # A current leader never grants pre-votes: _last_heartbeat_ms is
+        # only refreshed by incoming appends, which a leader does not
+        # receive, so without this guard a rejoining up-to-date node could
+        # poll-quorum a healthy leader into stepping aside (the exact churn
+        # pre-vote exists to prevent — reference RaftPollService).
         granted = (
-            msg.get("term", 0) > self.persistent.term
+            self.state != RaftState.LEADER
+            and msg.get("term", 0) > self.persistent.term
             and self._log_up_to_date(msg)
             and self.scheduler.now_ms() >= self._last_heartbeat_ms
             + self.config.election_timeout_ms

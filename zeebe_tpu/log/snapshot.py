@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import pickle
 import re
 import shutil
 import zlib
@@ -123,29 +122,40 @@ class SnapshotStorage:
 
 
 class SnapshotController:
-    """Takes/recovers pickled state snapshots for one stream processor.
+    """Takes/recovers engine-state snapshots for one stream processor.
 
-    The processor supplies ``snapshot_state() -> picklable`` and
+    The processor supplies ``snapshot_state() -> state dict`` and
     ``restore_state(obj)`` (the engine's analogue of the reference's
     ``SnapshotSupport`` composition: ComposedSnapshot over ZbMapSnapshotSupport
     / SerializableWrapper, FsSnapshotController.java).
+
+    Payloads are encoded with the explicit data-only codec
+    (``zeebe_tpu.log.stateser``), never pickle: snapshots are fetched from
+    cluster peers during replication and must be safe to decode untrusted
+    (the reference replicates opaque RocksDB files; it never deserializes
+    executable objects from peers).
     """
 
     def __init__(self, storage: SnapshotStorage):
         self.storage = storage
 
     def take(self, state: Any, metadata: SnapshotMetadata) -> None:
-        payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+        from zeebe_tpu.log import stateser
+
+        payload = stateser.encode_state(state)
         self.storage.write(metadata, payload)
         self.storage.purge_older_than(metadata)
 
     def recover(self, log_last_position: int):
         """Newest snapshot whose written position is still on the log.
 
-        Returns (state, metadata) or (None, None). Invalid/corrupt snapshots
-        are skipped (and the next older one is tried), mirroring
-        ``StateSnapshotController.recover`` trying metadata candidates.
+        Returns (state, metadata) or (None, None). Invalid/corrupt/
+        unparseable snapshots are skipped (and the next older one is tried),
+        mirroring ``StateSnapshotController.recover`` trying metadata
+        candidates.
         """
+        from zeebe_tpu.log import stateser
+
         for meta in self.storage.list():
             if meta.last_written_position > log_last_position:
                 continue  # log was truncated past this snapshot: stale
@@ -153,7 +163,7 @@ class SnapshotController:
             if payload is None:
                 continue
             try:
-                return pickle.loads(payload), meta
-            except Exception:
+                return stateser.decode_state(payload), meta
+            except stateser.SnapshotFormatError:
                 continue
         return None, None

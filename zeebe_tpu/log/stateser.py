@@ -1,0 +1,415 @@
+"""Data-only snapshot codec for engine state (no pickle, ever).
+
+Snapshot payloads are fetched from cluster peers over the snapshot
+replication protocol (``runtime/cluster_broker.py``), so they must be
+treated as untrusted input. The reference replicates opaque RocksDB files
+and never deserializes executable objects from peers
+(``broker-core/.../clustering/base/snapshots/SnapshotReplicationService.java``);
+this module is the equivalent stance for the host engine: every state
+family is explicitly encoded to plain msgpack data
+(``zeebe_tpu.protocol.msgpack``) and explicitly reconstructed — decoding
+can only ever produce the fixed set of state types below.
+
+Workflows are snapshotted as their deployed source resource (BPMN XML /
+YAML) plus (key, version) and re-transformed on restore — the transform is
+deterministic, so this both avoids serializing the executable graph and
+keeps the snapshot wire format independent of transformer internals.
+
+Numpy arrays (device-engine state) are supported via a tagged
+``{dtype, shape, raw bytes}`` envelope, mirroring how the reference treats
+RocksDB checkpoints as raw byte streams.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from zeebe_tpu.engine import interpreter as eng
+from zeebe_tpu.engine.keyspace import KeyGenerator
+from zeebe_tpu.protocol import msgpack
+from zeebe_tpu.protocol.intents import WorkflowInstanceIntent as WI
+from zeebe_tpu.protocol.records import (
+    IncidentRecord,
+    JobRecord,
+    TimerRecord,
+    WorkflowInstanceRecord,
+)
+
+FORMAT_HOST_V1 = "zbtpu-host-state-v1"
+FORMAT_DEVICE_V1 = "zbtpu-device-state-v1"
+FORMAT_RAW_V1 = "zbtpu-raw-state-v1"
+
+# snapshots cross the wire during replication; refuse absurd payloads
+# before decoding (the follower also caps what it buffers per transfer)
+MAX_SNAPSHOT_BYTES = 1 << 31
+
+
+class SnapshotFormatError(ValueError):
+    """Payload is not a valid snapshot in a known format."""
+
+
+# ---------------------------------------------------------------------------
+# ndarray envelope (device state / bulk columns)
+# ---------------------------------------------------------------------------
+
+_ALLOWED_DTYPES = {
+    "bool", "int8", "uint8", "int16", "int32", "int64",
+    "uint32", "uint64", "float32", "float64",
+}
+
+
+def pack_ndarray(a: np.ndarray) -> dict:
+    a = np.ascontiguousarray(a)
+    if a.dtype.name not in _ALLOWED_DTYPES:
+        raise SnapshotFormatError(f"unsupported dtype {a.dtype.name}")
+    return {"__nd": a.dtype.name, "sh": list(a.shape), "b": a.tobytes()}
+
+
+def unpack_ndarray(d: dict) -> np.ndarray:
+    name = d.get("__nd")
+    if name not in _ALLOWED_DTYPES:
+        raise SnapshotFormatError(f"unsupported dtype {name!r}")
+    shape = tuple(int(x) for x in d.get("sh", []))
+    raw = d.get("b", b"")
+    if not isinstance(raw, (bytes, bytearray)):
+        raise SnapshotFormatError("ndarray payload is not bytes")
+    a = np.frombuffer(raw, dtype=np.dtype(name))
+    expected = int(np.prod(shape)) if shape else 1
+    if a.size != expected:
+        raise SnapshotFormatError("ndarray size mismatch")
+    return a.reshape(shape).copy()
+
+
+# ---------------------------------------------------------------------------
+# host-engine state
+# ---------------------------------------------------------------------------
+
+
+def _enc_keygen(kg: KeyGenerator) -> dict:
+    return {"n": kg.peek, "s": kg._step}
+
+
+def _dec_keygen(d: dict) -> KeyGenerator:
+    kg = KeyGenerator(int(d["n"]), int(d["s"]))
+    return kg
+
+
+def _enc_instances(index: eng.ElementInstanceIndex) -> List[dict]:
+    # dict preserves insertion order, and a parent is always created before
+    # its children, so a flat parent-key list round-trips the scope tree
+    # (including children order).
+    out = []
+    for inst in index.instances.values():
+        out.append({
+            "k": inst.key,
+            "p": inst.parent.key if inst.parent is not None else None,
+            "s": int(inst.state) if inst.state is not None else None,
+            "v": inst.value.to_document() if inst.value is not None else None,
+            "j": inst.job_key,
+            "t": inst.active_tokens,
+            "a": inst.join_arrivals,
+        })
+    return out
+
+
+def _dec_instances(items: List[Any]) -> eng.ElementInstanceIndex:
+    index = eng.ElementInstanceIndex()
+    for d in items:
+        if not isinstance(d, dict):
+            raise SnapshotFormatError("bad element instance entry")
+        parent = index.get(int(d["p"])) if d.get("p") is not None else None
+        inst = eng.ElementInstance(int(d["k"]), parent)
+        inst.state = WI(int(d["s"])) if d.get("s") is not None else None
+        inst.value = (
+            WorkflowInstanceRecord.from_document(d["v"])
+            if d.get("v") is not None else None
+        )
+        inst.job_key = int(d.get("j", -1))
+        inst.active_tokens = int(d.get("t", 0))
+        arrivals = d.get("a") or {}
+        inst.join_arrivals = {
+            int(gw): {int(fl): dict(payload) for fl, payload in flows.items()}
+            for gw, flows in arrivals.items()
+        }
+        index.instances[inst.key] = inst
+    return index
+
+
+def _enc_workflows(workflows) -> List[dict]:
+    out = []
+    for wf in workflows:
+        src = wf.source_resource
+        if isinstance(src, str):
+            src = src.encode("utf-8")
+        out.append({
+            "id": wf.id, "k": wf.key, "ver": wf.version,
+            "src": src, "st": wf.source_type,
+        })
+    return out
+
+
+def _dec_workflows(items: List[Any]):
+    from zeebe_tpu.models.bpmn.xml import read_model
+    from zeebe_tpu.models.bpmn.yaml_front import read_yaml_workflow
+    from zeebe_tpu.models.transform.transformer import transform_model
+
+    out = []
+    for d in items:
+        if not isinstance(d, dict):
+            raise SnapshotFormatError("bad workflow entry")
+        data = d.get("src", b"")
+        if isinstance(data, str):
+            data = data.encode("utf-8")
+        if d.get("st") == "YAML_WORKFLOW":
+            model = read_yaml_workflow(data.decode("utf-8"))
+        else:
+            model = read_model(data)
+        for wf in transform_model(model):
+            if wf.id != d.get("id"):
+                continue
+            wf.key = int(d["k"])
+            wf.version = int(d["ver"])
+            wf.source_resource = data
+            wf.source_type = d.get("st", "BPMN_XML")
+            out.append(wf)
+    return out
+
+
+def encode_host_state(state: Dict[str, Any]) -> bytes:
+    """Encode ``PartitionEngine.snapshot_state()`` output to safe bytes."""
+    doc = {
+        "fmt": FORMAT_HOST_V1,
+        "wf_keys": _enc_keygen(state["wf_keys"]),
+        "job_keys": _enc_keygen(state["job_keys"]),
+        "incident_keys": _enc_keygen(state["incident_keys"]),
+        "deployment_keys": _enc_keygen(state["deployment_keys"]),
+        "element_instances": _enc_instances(state["element_instances"]),
+        "jobs": {
+            k: {"s": js.state, "d": js.deadline, "r": js.record.to_document()}
+            for k, js in state["jobs"].items()
+        },
+        "incidents": {
+            k: {"s": i.state, "ie": i.incident_event_position,
+                "fe": i.failure_event_position}
+            for k, i in state["incidents"].items()
+        },
+        "incident_by_activity": dict(state["incident_by_activity"]),
+        "incident_by_failed_job": dict(state["incident_by_failed_job"]),
+        "resolving_events": dict(state["resolving_events"]),
+        "incident_records": {
+            k: r.to_document() for k, r in state["incident_records"].items()
+        },
+        "messages": {
+            k: {"k": m.key, "n": m.name, "c": m.correlation_key,
+                "ttl": m.time_to_live, "p": m.payload, "id": m.message_id,
+                "dl": m.deadline}
+            for k, m in state["messages"].items()
+        },
+        "message_subscriptions": [
+            {"n": s.message_name, "c": s.correlation_key,
+             "pp": s.workflow_instance_partition_id,
+             "wk": s.workflow_instance_key, "ak": s.activity_instance_key}
+            for s in state["message_subscriptions"]
+        ],
+        "timers": {
+            k: {"d": t.due_date, "a": t.activity_instance_key,
+                "r": t.record.to_document()}
+            for k, t in state["timers"].items()
+        },
+        "topic_sub_acks": dict(state["topic_sub_acks"]),
+        "topics": {k: dict(v) for k, v in state["topics"].items()},
+        "next_partition_id": state["next_partition_id"],
+        "last_processed_position": state["last_processed_position"],
+        "workflows": _enc_workflows(state["workflows"]),
+    }
+    return msgpack.pack(doc)
+
+
+def decode_host_state(payload: bytes) -> Dict[str, Any]:
+    """Decode untrusted snapshot bytes back into the restore_state() dict.
+
+    Raises SnapshotFormatError on anything that is not a well-formed v1
+    host snapshot; never constructs anything beyond the fixed state types.
+    """
+    return _decode_host_doc(_unpack_checked(payload, FORMAT_HOST_V1))
+
+
+def _unpack_checked(payload: bytes, expect_fmt: str) -> dict:
+    if len(payload) > MAX_SNAPSHOT_BYTES:
+        raise SnapshotFormatError("snapshot payload too large")
+    try:
+        doc = msgpack.unpack(payload)
+    except Exception as e:
+        raise SnapshotFormatError(f"undecodable snapshot: {e}") from None
+    if not isinstance(doc, dict) or doc.get("fmt") != expect_fmt:
+        raise SnapshotFormatError("unknown snapshot format")
+    return doc
+
+
+def _decode_host_doc(doc: dict) -> Dict[str, Any]:
+    try:
+        return {
+            "wf_keys": _dec_keygen(doc["wf_keys"]),
+            "job_keys": _dec_keygen(doc["job_keys"]),
+            "incident_keys": _dec_keygen(doc["incident_keys"]),
+            "deployment_keys": _dec_keygen(doc["deployment_keys"]),
+            "element_instances": _dec_instances(doc["element_instances"]),
+            "jobs": {
+                int(k): eng.JobState(
+                    state=int(v["s"]),
+                    record=JobRecord.from_document(v["r"]),
+                    deadline=int(v["d"]),
+                )
+                for k, v in doc["jobs"].items()
+            },
+            "incidents": {
+                int(k): eng.IncidentState(
+                    state=int(v["s"]),
+                    incident_event_position=int(v["ie"]),
+                    failure_event_position=int(v["fe"]),
+                )
+                for k, v in doc["incidents"].items()
+            },
+            "incident_by_activity": {
+                int(k): int(v) for k, v in doc["incident_by_activity"].items()
+            },
+            "incident_by_failed_job": {
+                int(k): int(v) for k, v in doc["incident_by_failed_job"].items()
+            },
+            "resolving_events": {
+                int(k): int(v) for k, v in doc["resolving_events"].items()
+            },
+            "incident_records": {
+                int(k): IncidentRecord.from_document(v)
+                for k, v in doc["incident_records"].items()
+            },
+            "messages": {
+                int(k): eng.StoredMessage(
+                    key=int(v["k"]), name=str(v["n"]),
+                    correlation_key=str(v["c"]), time_to_live=int(v["ttl"]),
+                    payload=dict(v["p"]), message_id=str(v["id"]),
+                    deadline=int(v["dl"]),
+                )
+                for k, v in doc["messages"].items()
+            },
+            "message_subscriptions": [
+                eng.StoredSubscription(
+                    message_name=str(s["n"]), correlation_key=str(s["c"]),
+                    workflow_instance_partition_id=int(s["pp"]),
+                    workflow_instance_key=int(s["wk"]),
+                    activity_instance_key=int(s["ak"]),
+                )
+                for s in doc["message_subscriptions"]
+            ],
+            "timers": {
+                int(k): eng.TimerState(
+                    due_date=int(v["d"]),
+                    activity_instance_key=int(v["a"]),
+                    record=TimerRecord.from_document(v["r"]),
+                )
+                for k, v in doc["timers"].items()
+            },
+            "topic_sub_acks": {
+                str(k): int(v) for k, v in doc["topic_sub_acks"].items()
+            },
+            "topics": {str(k): dict(v) for k, v in doc["topics"].items()},
+            "next_partition_id": int(doc["next_partition_id"]),
+            "last_processed_position": int(doc["last_processed_position"]),
+            "workflows": _dec_workflows(doc["workflows"]),
+        }
+    except SnapshotFormatError:
+        raise
+    except Exception as e:
+        # includes parser errors from workflow-source re-transform (XML
+        # ParseError, YAML errors): a snapshot that cannot be restored must
+        # be SKIPPED by recovery (next older one is tried), never crash it
+        raise SnapshotFormatError(f"malformed snapshot: {e}") from None
+
+
+# ---------------------------------------------------------------------------
+# generic entry points used by SnapshotController
+# ---------------------------------------------------------------------------
+
+
+def encode_state(state: Any) -> bytes:
+    """Engine-state → bytes. Dispatches on shape: a device-state envelope
+    (dict with 'fmt' already set by the device engine) passes through its
+    own encoder; a dict carrying KeyGenerators is host-engine state; any
+    other plain-data value is wrapped raw (msgpack.pack rejects non-data
+    objects, so nothing executable can sneak through this path either)."""
+    if isinstance(state, dict) and state.get("fmt") == FORMAT_DEVICE_V1:
+        return encode_device_state(state)
+    if isinstance(state, dict) and isinstance(state.get("wf_keys"), KeyGenerator):
+        return encode_host_state(state)
+    return msgpack.pack({"fmt": FORMAT_RAW_V1, "data": state})
+
+
+def decode_state(payload: bytes) -> Any:
+    if len(payload) > MAX_SNAPSHOT_BYTES:
+        raise SnapshotFormatError("snapshot payload too large")
+    try:
+        doc = msgpack.unpack(payload)
+    except Exception as e:
+        raise SnapshotFormatError(f"undecodable snapshot: {e}") from None
+    if not isinstance(doc, dict):
+        raise SnapshotFormatError("unknown snapshot format")
+    fmt = doc.get("fmt")
+    if fmt == FORMAT_HOST_V1:
+        return _decode_host_doc(doc)
+    if fmt == FORMAT_DEVICE_V1:
+        return _decode_device_doc(doc)
+    if fmt == FORMAT_RAW_V1:
+        return doc.get("data")
+    raise SnapshotFormatError(f"unknown snapshot format {fmt!r}")
+
+
+# ---------------------------------------------------------------------------
+# device-engine state (SoA tables + intern/varspace sidecars)
+# ---------------------------------------------------------------------------
+
+
+def encode_device_state(state: Dict[str, Any]) -> bytes:
+    """Device snapshot envelope: {'fmt', 'arrays': {name: ndarray},
+    'meta': plain-data dict, 'host': host-engine snapshot dict or None}.
+
+    The embedded host oracle state (device engines keep one for
+    device-ineligible records) rides along as its own encoded payload.
+    """
+    doc = {
+        "fmt": FORMAT_DEVICE_V1,
+        "arrays": {
+            name: pack_ndarray(np.asarray(a))
+            for name, a in state.get("arrays", {}).items()
+        },
+        "meta": state.get("meta", {}),
+        "host": (
+            encode_host_state(state["host"])
+            if state.get("host") is not None else None
+        ),
+    }
+    return msgpack.pack(doc)
+
+
+def decode_device_state(payload: bytes) -> Dict[str, Any]:
+    return _decode_device_doc(_unpack_checked(payload, FORMAT_DEVICE_V1))
+
+
+def _decode_device_doc(doc: dict) -> Dict[str, Any]:
+    try:
+        return {
+            "fmt": FORMAT_DEVICE_V1,
+            "arrays": {
+                str(k): unpack_ndarray(v) for k, v in doc["arrays"].items()
+            },
+            "meta": doc.get("meta", {}),
+            "host": (
+                decode_host_state(doc["host"])
+                if doc.get("host") is not None else None
+            ),
+        }
+    except SnapshotFormatError:
+        raise
+    except Exception as e:
+        raise SnapshotFormatError(f"malformed snapshot: {e}") from None

@@ -63,7 +63,24 @@ def _load() -> Optional[ctypes.CDLL]:
             _build_error = _build()
             if _build_error is not None:
                 return None
-        lib = ctypes.CDLL(_LIB_PATH)
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            # stale or wrong-arch binary (e.g. left over from another
+            # machine): force a full rebuild — the binary must go first,
+            # else make's mtime check would skip compiling it again
+            try:
+                os.remove(_LIB_PATH)
+            except OSError:
+                pass
+            _build_error = _build()
+            if _build_error is not None:
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+            except OSError as e:
+                _build_error = f"built library failed to load: {e}"
+                return None
         _bind(lib)
         _lib = lib
         return _lib

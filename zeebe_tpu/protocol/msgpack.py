@@ -79,8 +79,11 @@ def _pack_into(out: bytearray, obj: Any) -> None:
         else:
             out += struct.pack(">BI", 0xDF, n)
         for k, v in obj.items():
-            if not isinstance(k, str):
-                raise TypeError(f"map keys must be str, got {type(k)}")
+            # the msgpack spec allows any key type; record documents use
+            # str keys (reference wire parity), engine-state snapshots
+            # (log/stateser.py) also use int keys (entity-key maps)
+            if not isinstance(k, (str, int)) or isinstance(k, bool):
+                raise TypeError(f"map keys must be str or int, got {type(k)}")
             _pack_into(out, k)
             _pack_into(out, v)
     else:

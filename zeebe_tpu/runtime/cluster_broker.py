@@ -30,6 +30,7 @@ codec frames.
 from __future__ import annotations
 
 import os
+import zlib
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -37,6 +38,7 @@ from zeebe_tpu.cluster.gossip import Gossip, GossipConfig
 from zeebe_tpu.cluster.raft import Raft, RaftConfig, RaftState
 from zeebe_tpu.engine.interpreter import JobSubscription, PartitionEngine, WorkflowRepository
 from zeebe_tpu.log import LogStream, SegmentedLogStorage
+from zeebe_tpu.log import stateser
 from zeebe_tpu.log.snapshot import SnapshotController, SnapshotMetadata, SnapshotStorage
 from zeebe_tpu.protocol import codec, msgpack
 from zeebe_tpu.protocol.records import Record, stamp_source_positions
@@ -362,6 +364,10 @@ class ClusterBroker(Actor):
         self._next_request_id = 0
         self._push_listeners: Dict[int, Callable[[int, Record], None]] = {}
         self._request_lock = threading.Lock()
+        # bounded cache for chunked snapshot serving (avoids re-reading
+        # and re-checksumming the file once per 256K chunk); keyed by
+        # (partition, snapshot metadata), insertion-ordered for LRU drop
+        self._snapshot_serve_cache: Dict[tuple, tuple] = {}
 
         # gossip (management-plane membership + topology dissemination)
         self.gossip = Gossip(
@@ -586,15 +592,31 @@ class ClusterBroker(Actor):
             last_written_position=int(msg.get("written", -1)),
             term=int(msg.get("term", 0)),
         )
-        payload = server.snapshots.storage.read(meta)
-        if payload is None:
-            return msgpack.pack({"t": "error", "code": "NO_SNAPSHOT"})
+        # serve ranged reads out of a small per-transfer cache — re-reading
+        # and checksumming the whole snapshot per 256K chunk is quadratic
+        # IO. Keyed per (partition, meta) so concurrent transfers (one
+        # leader serving several follower partitions) don't thrash; bounded
+        # LRU so completed transfers don't pin payloads forever.
+        cache_key = (int(msg.get("partition", 0)), meta)
+        cached = self._snapshot_serve_cache.get(cache_key)
+        if cached is None:
+            payload = server.snapshots.storage.read(meta)
+            if payload is None:
+                return msgpack.pack({"t": "error", "code": "NO_SNAPSHOT"})
+            cached = (payload, zlib.crc32(payload))
+            self._snapshot_serve_cache[cache_key] = cached
+            while len(self._snapshot_serve_cache) > 4:
+                self._snapshot_serve_cache.pop(
+                    next(iter(self._snapshot_serve_cache))
+                )
+        payload, crc = cached
         offset = int(msg.get("offset", 0))
-        length = int(msg.get("length", 256 * 1024))
+        length = min(max(int(msg.get("length", 256 * 1024)), 0), 4 * 1024 * 1024)
         return msgpack.pack(
             {
                 "t": "ok",
                 "total": len(payload),
+                "crc": crc,
                 "chunk": payload[offset : offset + length],
             }
         )
@@ -644,6 +666,8 @@ class ClusterBroker(Actor):
                 return
             chunks = []
             offset = 0
+            expect_total = None
+            expect_crc = None
             while True:
                 body = {
                     "t": "fetch-snapshot-chunk",
@@ -660,12 +684,34 @@ class ClusterBroker(Actor):
                 )
                 if chunk_rsp.get("t") != "ok":
                     return
+                total = int(chunk_rsp.get("total", 0))
+                # don't trust the remote size field blindly: bound what we
+                # buffer, and require it stable across chunks
+                if total < 0 or total > stateser.MAX_SNAPSHOT_BYTES:
+                    return
+                if expect_total is None:
+                    expect_total = total
+                    expect_crc = chunk_rsp.get("crc")
+                elif total != expect_total:
+                    return
                 chunk = bytes(chunk_rsp.get("chunk", b""))
                 chunks.append(chunk)
                 offset += len(chunk)
-                if offset >= int(chunk_rsp.get("total", 0)) or not chunk:
+                if offset > expect_total:
+                    return
+                if offset >= expect_total or not chunk:
                     break
-            server.snapshots.storage.write(meta, b"".join(chunks))
+            payload = b"".join(chunks)
+            # end-to-end integrity from the leader's serve cache, then a
+            # full decode check: a fetched snapshot must be parseable by
+            # the data-only codec before it can ever be offered to recovery
+            if expect_crc is not None and zlib.crc32(payload) != int(expect_crc):
+                return
+            try:
+                stateser.decode_state(payload)
+            except stateser.SnapshotFormatError:
+                return
+            server.snapshots.storage.write(meta, payload)
         except Exception:  # noqa: BLE001 - next poll retries
             pass
 

@@ -66,18 +66,22 @@ def _mxu_cumsum_i32(x):
     """Inclusive scan of small-int vectors via triangular matmuls on the
     MXU. XLA's TPU cumsum lowering (reduce-window) serializes badly at
     these lengths; two tiny matmuls are ~free. Exact while the running sum
-    stays below 2^24 (batch sizes here are ≤ 2^20 of 0/1 counts)."""
+    stays below 2^24 (batch sizes here are ≤ 2^20 of 0/1 counts) — which
+    requires full f32 accumulation: the TPU matmul default feeds the MXU
+    bf16 inputs (8 mantissa bits), so Precision.HIGHEST is load-bearing,
+    not a nicety (row totals above 256 would round)."""
     n = x.shape[0]
     tile = 128
     if n % tile != 0:  # fall back off the fast path for odd sizes
         return jnp.cumsum(x)
     rows = n // tile
+    hi = jax.lax.Precision.HIGHEST
     xf = x.astype(jnp.float32).reshape(rows, tile)
     upper = jnp.triu(jnp.ones((tile, tile), jnp.float32))
     lower_strict = jnp.tril(jnp.ones((rows, rows), jnp.float32), k=-1)
-    within = xf @ upper                          # [rows, tile] row-wise scan
-    row_tot = within[:, -1]                      # [rows]
-    row_off = lower_strict @ row_tot             # exclusive row offsets
+    within = jnp.matmul(xf, upper, precision=hi)  # [rows, tile] row-wise scan
+    row_tot = within[:, -1]                       # [rows]
+    row_off = jnp.matmul(lower_strict, row_tot, precision=hi)
     return (within + row_off[:, None]).reshape(n).astype(x.dtype)
 
 
