@@ -56,7 +56,7 @@ def stage_creates(meta, wave, num_vars, interns):
     oid = meta.varspace.column("orderId")
     oval = meta.varspace.column("orderValue")
     v_vt = np.zeros((wave, num_vars), np.int8)
-    v_num = np.zeros((wave, num_vars), np.float64)
+    v_num = np.zeros((wave, num_vars), np.float32)
     v_vt[:, oid] = VT_NUM
     v_vt[:, oval] = VT_NUM
     v_num[:, oid] = np.arange(wave)
@@ -160,6 +160,10 @@ def main():
     _progress("rebuild done; timing waves...")
 
     waves = max(total_instances // wave - 1, 1)
+    # tombstone budget: each wave retires ~2 element instances + 1 job per
+    # created instance; at map capacity 16x wave a rebuild every 3rd wave
+    # keeps live+dead load under hashmap.REBUILD_LOAD with margin
+    rebuild_every = 3
     # totals accumulate as device scalars: zero host round trips inside the
     # timed loop, one device_get at the end
     processed_dev = jnp.zeros((), jnp.int64)
@@ -171,7 +175,8 @@ def main():
         processed_dev = processed_dev + totals["processed"]
         completed_dev = completed_dev + totals["completed_roots"]
         overflow_dev = overflow_dev | totals["overflow"]
-        state = rebuild_jit(state)
+        if (i + 1) % rebuild_every == 0:
+            state = rebuild_jit(state)
         if i % 16 == 0:
             _progress(f"wave {i}/{waves} dispatched")
     jax.block_until_ready(state.ei_state)

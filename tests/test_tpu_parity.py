@@ -391,3 +391,58 @@ class TestInstanceCounts:
                 and r.value.activity_id == "order-process"
             ]
             assert len(completed) == 1
+
+
+class TestPayloadContract:
+    """TPU partitions reject (not crash on, not round) payload numbers that
+    are not exactly representable in float32 — the device stores payload
+    numerics as f32 (state.pack_payload); the reference likewise validates
+    msgpack documents at the client API boundary
+    (``ClientApiMessageHandler.java:90-165``)."""
+
+    def _tpu_broker(self):
+        clock = ControlledClock(start_ms=1_000_000)
+        repo = WorkflowRepository()
+        broker = Broker(
+            num_partitions=1,
+            clock=clock,
+            engine_factory=lambda pid: TpuPartitionEngine(
+                pid, 1, repository=repo, clock=clock
+            ),
+        )
+        return broker
+
+    def test_inexact_float_create_is_rejected(self):
+        from zeebe_tpu.protocol.enums import RejectionType
+
+        broker = self._tpu_broker()
+        try:
+            client = ZeebeClient(broker)
+            client.deploy_model(order_process())
+            with pytest.raises(ClientException) as err:
+                client.create_instance("order-process", {"x": 0.1})
+            assert "float32" in str(err.value)
+            broker.run_until_idle()
+            rejections = [
+                r for r in broker.records(0)
+                if int(r.metadata.record_type) == int(RecordType.COMMAND_REJECTION)
+                and int(r.metadata.value_type) == int(ValueType.WORKFLOW_INSTANCE)
+            ]
+            assert len(rejections) == 1
+            assert rejections[0].metadata.rejection_type == RejectionType.BAD_VALUE
+        finally:
+            broker.close()
+
+    def test_exact_float_passes(self):
+        broker = self._tpu_broker()
+        try:
+            client = ZeebeClient(broker)
+            client.deploy_model(order_process())
+            client.create_instance("order-process", {"x": 0.25, "n": 1 << 20})
+            broker.run_until_idle()
+            assert not any(
+                int(r.metadata.record_type) == int(RecordType.COMMAND_REJECTION)
+                for r in broker.records(0)
+            )
+        finally:
+            broker.close()

@@ -29,6 +29,7 @@ from zeebe_tpu.tpu.conditions import (
     VT_NIL,
     VT_NUM,
     VT_STR,
+    f32_exact,
 )
 from zeebe_tpu.tpu.intern import InternTable
 
@@ -83,7 +84,9 @@ class RecordBatch:
     instance_key: jax.Array # [B] i64 workflowInstanceKey
     scope_key: jax.Array    # [B] i64 scopeInstanceKey
     v_vt: jax.Array         # [B, V] i8 payload types
-    v_num: jax.Array        # [B, V] f64
+    v_num: jax.Array        # [B, V] f32 (f32-exact by construction; see
+                            # payload_to_columns — inexact values take the
+                            # host-oracle path)
     v_str: jax.Array        # [B, V] i32
     req: jax.Array          # [B] i64 request id (-1 none)
     req_stream: jax.Array   # [B] i32 request stream / subscriber key
@@ -108,7 +111,7 @@ class RecordBatch:
 
 
 def empty(size: int, num_vars: int) -> RecordBatch:
-    i64, i32, i8, f64 = jnp.int64, jnp.int32, jnp.int8, jnp.float64
+    i64, i32, i8, f32 = jnp.int64, jnp.int32, jnp.int8, jnp.float32
     z64 = lambda: jnp.full((size,), -1, i64)  # noqa: E731
     z32 = lambda: jnp.full((size,), -1, i32)  # noqa: E731
     return RecordBatch(
@@ -122,7 +125,7 @@ def empty(size: int, num_vars: int) -> RecordBatch:
         instance_key=z64(),
         scope_key=z64(),
         v_vt=jnp.zeros((size, num_vars), i8),
-        v_num=jnp.zeros((size, num_vars), f64),
+        v_num=jnp.zeros((size, num_vars), f32),
         v_str=jnp.zeros((size, num_vars), i32),
         req=z64(),
         req_stream=z32(),
@@ -156,7 +159,7 @@ def payload_to_columns(
     num_vars: int,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     vt = np.zeros((num_vars,), np.int8)
-    num = np.zeros((num_vars,), np.float64)
+    num = np.zeros((num_vars,), np.float32)
     sid = np.zeros((num_vars,), np.int32)
     for name, value in doc.items():
         col = column_of(name)
@@ -167,11 +170,12 @@ def payload_to_columns(
         elif isinstance(value, bool):
             vt[col] = VT_BOOL
             num[col] = 1.0 if value else 0.0
-        elif isinstance(value, int):
-            vt[col] = VT_NUM
-            num[col] = float(value)
-        elif isinstance(value, float):
-            vt[col] = VT_FLOAT
+        elif isinstance(value, (int, float)):
+            if not f32_exact(value):
+                raise PayloadError(
+                    f"payload number not f32-exact for {name!r}: {value!r}"
+                )
+            vt[col] = VT_NUM if isinstance(value, int) else VT_FLOAT
             num[col] = value
         elif isinstance(value, str):
             vt[col] = VT_STR

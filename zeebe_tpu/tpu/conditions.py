@@ -14,13 +14,19 @@ errors when a referenced variable is absent, types mismatch (int/float
 widen), or ordering is applied to non-numbers — exactly the oracle's raise
 conditions, so an ERROR result maps to the same CONDITION_ERROR incident.
 
-Strings compare by interned id (exact); numbers compare as float64.
+Strings compare by interned id (exact); numbers compare as float32 —
+sound because only f32-EXACT values reach the device (payload
+columnarization and literal compilation both reject inexact values into
+the host path, where the oracle compares float64), and f64→f32 is
+order-preserving on exactly-representable values.
 """
 
 from __future__ import annotations
 
 import dataclasses
 from typing import List, Optional, Tuple
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -57,6 +63,17 @@ VT_ABSENT, VT_NIL, VT_BOOL, VT_NUM, VT_STR, VT_FLOAT = 0, 1, 2, 3, 4, 5
 STACK_DEPTH = 8
 
 
+def f32_exact(value: float) -> bool:
+    """True when ``value`` survives a float32 round trip exactly. The
+    device engine stores payload numerics as f32 (see state.pack_payload);
+    BOTH gates — payload columnarization (batch.payload_to_columns) and
+    condition-literal compilation below — must use this same predicate, or
+    the "only f32-exact values reach the device" soundness argument of this
+    module's header breaks."""
+    f = np.float32(value)
+    return bool(np.isfinite(f)) and float(f) == float(value)
+
+
 class DeviceIneligible(ValueError):
     """Condition uses a feature the device path cannot evaluate (nested
     JSONPath, non-scalar literal) — the workflow falls back to the host
@@ -76,6 +93,10 @@ class ProgramPool:
     lit_nums: List[float] = dataclasses.field(default_factory=list)
 
     def _num_literal(self, value: float) -> int:
+        if not f32_exact(value):
+            raise DeviceIneligible(
+                f"condition literal not f32-exact: {value!r}"
+            )
         self.lit_nums.append(float(value))
         return len(self.lit_nums) - 1
 
@@ -123,7 +144,7 @@ class ProgramPool:
         return len(self.programs) - 1
 
     def tensors(self):
-        """(progs [P, L, 6] i32, lit_nums [Q] f64), padded to coarse sizes
+        """(progs [P, L, 6] i32, lit_nums [Q] f32), padded to coarse sizes
         so kernel jit caches are shared across deployments."""
 
         def _pad(n: int, mult: int) -> int:
@@ -139,7 +160,7 @@ class ProgramPool:
         progs = jnp.array(arr, dtype=jnp.int32).reshape(count, max_len, 6)
         lits = list(self.lit_nums)
         lits += [0.0] * (_pad(len(lits), 8) - len(lits))
-        lit_nums = jnp.array(lits, dtype=jnp.float64)
+        lit_nums = jnp.array(lits, dtype=jnp.float32)
         return progs, lit_nums
 
 
@@ -158,8 +179,8 @@ def _resolve(kind, idx, v_vt, v_num, v_str, lit_nums):
     )
     num = jnp.select(
         [kind == K_VAR, kind == K_NUM, kind == K_BOOL],
-        [var_num, lit_num, idx.astype(jnp.float64)],
-        0.0,
+        [var_num, lit_num, idx.astype(jnp.float32)],
+        jnp.float32(0.0),
     )
     sid = jnp.select(
         [kind == K_VAR, kind == K_STR],

@@ -2,13 +2,29 @@
 
 The broker's hot loop (``StreamProcessorController.java:296-399``) reads
 committed records and feeds follow-ups back into the log. On device, that
-feedback must not cross the host boundary: emissions are enqueued into an
-HBM ring buffer (the dispatcher/"write buffer" analogue,
+feedback must not cross the host boundary: emissions are appended to an
+HBM FIFO (the dispatcher/"write buffer" analogue,
 ``dispatcher/.../Dispatcher.java:222``) and dequeued as the next fixed-size
-input batch. One host sync per round (the pending-record count scalar)
-drives the loop; everything else stays on device.
+input batch. One host sync per wave (the totals dict) drives the loop;
+everything else stays on device.
 
-The bench and the (future) batched broker path both run on this driver; the
+Queue design (TPU-specific): XLA lowers general scatters/gathers to
+SERIAL per-index loops on TPU (~10ns/row), so a classic ring buffer —
+one scatter per record field per enqueue — dominated the whole round
+(~50 serial ops x 32k rows). This queue instead keeps the FIFO front at
+index 0:
+
+- dequeue  = static slice ``rows[:B]`` + one contiguous shift-down copy
+  per field (vectorized copies, no per-index work),
+- enqueue  = one ``dynamic_update_slice`` per field at the tail
+  (requires the incoming batch to be PREFIX-COMPACTED: valid rows form a
+  contiguous prefix, which the kernel's emission compaction guarantees).
+
+Rows at index >= count are always invalid (valid=False padding), so block
+writes past the tail never clobber live records. FIFO order — the replay
+determinism contract — is bit-identical to the ring design.
+
+The bench and the batched broker path both run on this driver; the
 durability path drains the same emissions to the host log asynchronously.
 """
 
@@ -20,9 +36,8 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
-from zeebe_tpu.protocol.enums import RecordType, ValueType
-from zeebe_tpu.protocol.intents import JobIntent as JI
 from zeebe_tpu.tpu import batch as rb
 from zeebe_tpu.tpu.batch import RecordBatch
 from zeebe_tpu.tpu.graph import DeviceGraph
@@ -32,14 +47,14 @@ from zeebe_tpu.tpu.state import EngineState
 
 @partial(
     jax.tree_util.register_dataclass,
-    data_fields=["rows", "head", "count"],
+    data_fields=["rows", "count", "overflow"],
     meta_fields=[],
 )
 @dataclasses.dataclass
 class RecordQueue:
-    rows: RecordBatch  # capacity Q storage; only [head, head+count) live
-    head: jax.Array    # i32 scalar
-    count: jax.Array   # i32 scalar
+    rows: RecordBatch   # [Q] storage; live rows are exactly [0, count)
+    count: jax.Array    # i32 scalar
+    overflow: jax.Array  # bool scalar, sticky: an enqueue didn't fit
 
     @property
     def capacity(self) -> int:
@@ -47,77 +62,60 @@ class RecordQueue:
 
 
 def make_queue(capacity: int, num_vars: int) -> RecordQueue:
+    """``capacity`` must budget for block writes: an enqueue needs the whole
+    (padded) incoming block to fit, so the usable record count is
+    ``capacity - largest_enqueued_block`` (the kernel's emission block is
+    ``batch_size * graph.emit_width`` rows). Size generously — storage is
+    cheap, the shift copy is bandwidth-bound, and overflow is a hard abort."""
     return RecordQueue(
         rows=rb.empty(capacity, num_vars),
-        head=jnp.zeros((), jnp.int32),
         count=jnp.zeros((), jnp.int32),
-    )
-
-
-def _rows_at(store: RecordBatch, idx) -> RecordBatch:
-    return jax.tree.map(lambda a: a[idx], store)
-
-
-def _store_rows(store: RecordBatch, idx, rows: RecordBatch, mask) -> RecordBatch:
-    cap = store.size
-    widx = jnp.where(mask, idx, cap)
-    return jax.tree.map(
-        lambda a, r: a.at[widx].set(r, mode="drop"), store, rows
+        overflow=jnp.zeros((), bool),
     )
 
 
 def enqueue(queue: RecordQueue, batch: RecordBatch) -> RecordQueue:
-    """Append the valid rows of ``batch`` to the queue, in row order. The
-    mask may be arbitrary (not just a compacted prefix): each valid row is
-    scattered to its prefix-sum slot, preserving record order — the
-    determinism contract replay depends on."""
-    cap = queue.capacity
-    valid = batch.valid.astype(jnp.int32)
-    add = jnp.sum(valid, dtype=jnp.int32)
-    # rank of each valid row among valid rows
-    offs = jnp.cumsum(valid, dtype=jnp.int32) - 1
-    idx = (queue.head + queue.count + offs) % cap
-    rows = _store_rows(queue.rows, idx, batch, batch.valid)
-    return RecordQueue(rows=rows, head=queue.head, count=queue.count + add)
+    """Append a prefix-compacted batch in row order (FIFO).
+
+    ``batch`` must have its valid rows as a contiguous prefix (the kernel's
+    output compaction and host staging both guarantee this); the whole
+    block lands at the tail with one dynamic_update_slice per field — the
+    invalid padding rows fall beyond the new count where they are inert.
+    Sets the sticky overflow flag (and leaves the queue corrupt) if the
+    block doesn't fit; callers abort the drive loop on overflow.
+    """
+    qcap = queue.capacity
+    ob = batch.size
+    add = jnp.sum(batch.valid, dtype=jnp.int32)
+    tail = queue.count
+    # dynamic_update_slice clamps the start index; past qcap-ob the block
+    # would land over live rows, so that is the (sticky) overflow line
+    overflow = queue.overflow | (tail > qcap - ob)
+    start = jnp.minimum(tail, qcap - ob)
+    rows = jax.tree.map(
+        lambda store, b: lax.dynamic_update_slice_in_dim(store, b, start, axis=0),
+        queue.rows,
+        batch,
+    )
+    return RecordQueue(rows=rows, count=tail + add, overflow=overflow)
 
 
 def dequeue(queue: RecordQueue, batch_size: int) -> Tuple[RecordQueue, RecordBatch]:
-    cap = queue.capacity
+    """Take the first ``batch_size`` rows (static slice) and shift the
+    remainder down (contiguous per-field copies). Valid flags in storage
+    already mask the sub-batch tail when fewer than ``batch_size`` rows
+    are pending."""
     take = jnp.minimum(queue.count, batch_size)
-    idx = (queue.head + jnp.arange(batch_size, dtype=jnp.int32)) % cap
-    batch = _rows_at(queue.rows, idx)
-    live = jnp.arange(batch_size, dtype=jnp.int32) < take
-    batch = dataclasses.replace(batch, valid=batch.valid & live)
+    batch = jax.tree.map(lambda a: a[:batch_size], queue.rows)
+    blanks = rb.empty(batch_size, queue.rows.num_vars)
+    rows = jax.tree.map(
+        lambda a, z: jnp.concatenate([a[batch_size:], z], axis=0),
+        queue.rows,
+        blanks,
+    )
     return (
-        RecordQueue(
-            rows=queue.rows,
-            head=(queue.head + take) % cap,
-            count=queue.count - take,
-        ),
+        RecordQueue(rows=rows, count=queue.count - take, overflow=queue.overflow),
         batch,
-    )
-
-
-def _synthetic_complete(out: RecordBatch) -> RecordBatch:
-    """Bench-only instant worker: turn pushed ACTIVATED job events into
-    COMPLETE commands (models the external worker round-trip of
-    ``gateway/.../impl/subscription/job/JobSubscriber.java:51`` without
-    leaving the device)."""
-    is_act = (
-        out.valid
-        & (out.vtype == int(ValueType.JOB))
-        & (out.intent == int(JI.ACTIVATED))
-        & out.push
-    )
-    return dataclasses.replace(
-        out,
-        valid=is_act,
-        rtype=jnp.where(is_act, int(RecordType.COMMAND), out.rtype),
-        intent=jnp.where(is_act, int(JI.COMPLETE), out.intent),
-        push=jnp.zeros_like(out.push),
-        resp=jnp.zeros_like(out.resp),
-        req=jnp.full_like(out.req, -1),
-        src=jnp.full_like(out.src, -1),
     )
 
 
@@ -132,12 +130,16 @@ def drive_round(
     """Dequeue one batch, step the kernel, enqueue the emissions.
 
     Returns (state, queue, stats). jit-compiled per (batch_size, shapes).
+    ``synthetic_workers`` makes the kernel emit an instant COMPLETE after
+    every ACTIVATED push (bench-only; see kernel.step_kernel).
     """
     queue, batch = dequeue(queue, batch_size)
-    state, out, stats = step_kernel(graph, state, batch, now)
+    state, out, stats = step_kernel(
+        graph, state, batch, now, synthetic_workers=synthetic_workers
+    )
     queue = enqueue(queue, out)
-    if synthetic_workers:
-        queue = enqueue(queue, _synthetic_complete(out))
+    stats = dict(stats)
+    stats["overflow"] = stats["overflow"] | queue.overflow
     return state, queue, stats
 
 
@@ -158,7 +160,7 @@ def _quiesce_device(graph, state, queue, now, batch_size, synthetic_workers, max
     (``lax.while_loop``): no host round-trips between rounds. Off a local
     chip every per-round scalar sync is a full network round trip (the
     broker may sit across a tunnel/DCN from the device), and even locally
-    dispatch latency dwarfs the sub-ms step kernel."""
+    dispatch latency dwarfs the step kernel."""
     totals0 = {
         "processed": jnp.zeros((), jnp.int64),
         "emitted": jnp.zeros((), jnp.int64),
@@ -174,17 +176,19 @@ def _quiesce_device(graph, state, queue, now, batch_size, synthetic_workers, max
     def body(carry):
         s, q, t = carry
         q, batch = dequeue(q, batch_size)
-        s, out, stats = step_kernel(graph, s, batch, now)
+        s, out, stats = step_kernel(
+            graph, s, batch, now, synthetic_workers=synthetic_workers
+        )
         q = enqueue(q, out)
-        if synthetic_workers:
-            q = enqueue(q, _synthetic_complete(out))
         t = {
             "processed": t["processed"] + stats["processed"].astype(jnp.int64),
             "emitted": t["emitted"] + stats["emitted"].astype(jnp.int64),
             "completed_roots": t["completed_roots"]
             + stats["completed_roots"].astype(jnp.int64),
             "rounds": t["rounds"] + 1,
-            "overflow": t["overflow"] | stats["overflow"].astype(bool),
+            "overflow": t["overflow"]
+            | stats["overflow"].astype(bool)
+            | q.overflow,
         }
         return s, q, t
 
@@ -194,10 +198,8 @@ def _quiesce_device(graph, state, queue, now, batch_size, synthetic_workers, max
 # NOTE: an earlier revision compiled this program with
 # ``xla_tpu_scoped_vmem_limit_kib=65536`` to get XLA's reduce-window cumsum
 # lowering past a scoped-vmem allocation failure. The MXU-matmul prefix sums
-# (kernel._mxu_cumsum_i32) removed those programs — and the raised limit
-# turned out to force the in-loop scatter operands into scoped vmem, making
-# every scatter ~100x slower (87ms/round vs 11ms without the flag on v5e).
-# Plain compilation is both sufficient and much faster now.
+# (kernel._mxu_cumsum_i32) removed those programs, and plain compilation is
+# both sufficient and faster.
 _quiesce_cache: dict = {}
 
 
@@ -249,7 +251,7 @@ def run_to_quiescence(
     # round trip to the device (networked tunnel: ~150ms apiece)
     host_totals = jax.device_get(dev_totals)
     if bool(host_totals.pop("overflow")):
-        raise RuntimeError("device table overflow during drive loop")
+        raise RuntimeError("device table or queue overflow during drive loop")
     totals = {k: int(v) for k, v in host_totals.items()}
     if totals["rounds"] >= max_rounds and int(queue.count) > 0:
         raise RuntimeError("drive loop did not quiesce")

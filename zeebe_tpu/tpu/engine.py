@@ -67,6 +67,16 @@ _DEVICE_VALUE_TYPES = {
 _ERR_NO_RETRIES = 105  # kernel's JOB_NO_RETRIES incident code
 
 
+def _host_unpack_payload(pay: np.ndarray):
+    """Host-side view of one packed payload row ([3V] i32 — see
+    state.pack_payload): returns (vt, num, sid) for columns_to_payload."""
+    v = pay.shape[-1] // 3
+    vt = pay[..., :v]
+    sid = pay[..., v : 2 * v]
+    num = np.ascontiguousarray(pay[..., 2 * v : 3 * v]).view(np.float32)
+    return vt, num, sid
+
+
 def _pow2(n: int) -> int:
     p = 64
     while p < n:
@@ -256,9 +266,7 @@ class TpuPartitionEngine:
             deadline=int(np.asarray(s.job_deadline)[slot]),
             worker=self.interns.string(int(np.asarray(s.job_worker)[slot])) or "",
             payload=rb.columns_to_payload(
-                np.asarray(s.job_vt)[slot],
-                np.asarray(s.job_num)[slot],
-                np.asarray(s.job_str)[slot],
+                *_host_unpack_payload(np.asarray(s.job_pay)[slot]),
                 self.meta.varspace.names if self.meta else [],
                 self.interns,
             ),
@@ -290,6 +298,17 @@ class TpuPartitionEngine:
         for i, record in enumerate(records):
             vt = int(record.metadata.value_type)
             if vt in _DEVICE_VALUE_TYPES and self.meta is not None:
+                # data contract of TPU-backed partitions: payload numbers
+                # must be exactly representable in float32 (device payload
+                # columns are f32). Commands violating it are REJECTED at
+                # the boundary — the reference likewise validates msgpack
+                # documents at the client API (ClientApiMessageHandler) —
+                # instead of silently rounding. Events are engine-produced
+                # and therefore exact by induction.
+                bad = self._inexact_payload_value(record)
+                if bad is not None:
+                    per_record[i] = self._reject_payload(record, bad)
+                    continue
                 device_rows.append(i)
             else:
                 deployed_before = len(self.repository.by_key)
@@ -317,6 +336,50 @@ class TpuPartitionEngine:
             self.last_processed_position = records[-1].position
         return merged
 
+    def _inexact_payload_value(self, record: Record):
+        """Name of the first payload entry not exactly representable in
+        f32 on a COMMAND record, else None."""
+        from zeebe_tpu.tpu.conditions import f32_exact
+
+        if int(record.metadata.record_type) != int(RecordType.COMMAND):
+            return None
+        payload = getattr(record.value, "payload", None)
+        if not payload:
+            return None
+        for name, value in payload.items():
+            if (
+                isinstance(value, (int, float))
+                and not isinstance(value, bool)
+                and not f32_exact(value)
+            ):
+                return name
+        return None
+
+    def _reject_payload(self, record: Record, field: str) -> ProcessingResult:
+        out = ProcessingResult()
+        md = record.metadata
+        rejection = Record(
+            key=record.key,
+            value=record.value.copy(),
+            metadata=RecordMetadata(
+                record_type=RecordType.COMMAND_REJECTION,
+                value_type=md.value_type,
+                intent=md.intent,
+                rejection_type=RejectionType.BAD_VALUE,
+                rejection_reason=(
+                    f"payload value {field!r} is not exactly representable "
+                    "in float32 (TPU partition payload contract)"
+                ),
+                request_id=md.request_id,
+                request_stream_id=md.request_stream_id,
+            ),
+            source_record_position=record.position,
+        )
+        out.written.append(rejection)
+        if md.request_id >= 0:
+            out.responses.append(rejection)
+        return out
+
     # -- host record → batch row -------------------------------------------
     def _stage(self, records: List[Record]) -> RecordBatch:
         n = len(records)
@@ -333,7 +396,7 @@ class TpuPartitionEngine:
             "instance_key": np.full(size, -1, np.int64),
             "scope_key": np.full(size, -1, np.int64),
             "v_vt": np.zeros((size, v), np.int8),
-            "v_num": np.zeros((size, v), np.float64),
+            "v_num": np.zeros((size, v), np.float32),
             "v_str": np.zeros((size, v), np.int32),
             "req": np.full(size, -1, np.int64),
             "req_stream": np.full(size, -1, np.int32),
@@ -404,9 +467,18 @@ class TpuPartitionEngine:
     def _stage_payload(self, cols, i, payload) -> None:
         if not payload:
             return
-        vt, num, sid = rb.payload_to_columns(
-            payload, self._var_column, self.interns, self.num_vars
-        )
+        try:
+            vt, num, sid = rb.payload_to_columns(
+                payload, self._var_column, self.interns, self.num_vars
+            )
+        except rb.PayloadError:
+            if int(cols["rtype"][i]) == int(RecordType.COMMAND_REJECTION):
+                # a rejection record echoes the offending command's payload
+                # (e.g. a non-f32-exact number) — it is terminal for the
+                # kernel, so it stages with an empty payload instead of
+                # re-tripping the payload contract it reported
+                return
+            raise
         cols["v_vt"][i] = vt
         cols["v_num"][i] = num
         cols["v_str"][i] = sid

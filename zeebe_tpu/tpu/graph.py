@@ -122,7 +122,7 @@ class DeviceGraph:
     out_behavior: jax.Array
     timer_dur: jax.Array             # i64, -1 = no timer
     progs: jax.Array                 # [P, L, 6] predicate programs
-    lit_nums: jax.Array              # [Q] f64
+    lit_nums: jax.Array              # [Q] f32
     # static meta
     num_vars: int
     emit_width: int                  # max emissions per record (≥2)

@@ -45,6 +45,7 @@ from zeebe_tpu.tpu.conditions import VT_ABSENT, eval_programs
 from zeebe_tpu.tpu.graph import DeviceGraph
 from zeebe_tpu.tpu.state import (
     EngineState,
+    pack_payload, unpack_payload,
     EI_ELEM, EI_STATE, EI_WF, EI_SCOPE, EI_TOKENS,
     EIL_KEY, EIL_IKEY, EIL_JOB_KEY,
     JB_STATE, JB_ELEM, JB_WF, JB_TYPE, JB_RETRIES, JB_WORKER,
@@ -117,15 +118,12 @@ def _last_writer(slots, mask, size):
     return mask & (best[jnp.clip(tgt, 0, size)] == rank)
 
 
-def _scatter_payload(vt, num, sid, slots, mask, b_vt, b_num, b_sid, size):
-    """Write batch payload rows into table rows at ``slots`` (last writer
-    wins)."""
+def _scatter_pay(pay, slots, mask, b_pay, size):
+    """Write packed batch payload rows ([B, 3V] i32) into table rows at
+    ``slots`` (last writer wins) — ONE scatter for the whole payload."""
     win = _last_writer(slots, mask, size)
     idx = jnp.where(win, slots, size)
-    vt = vt.at[idx].set(b_vt, mode="drop")
-    num = num.at[idx].set(b_num, mode="drop")
-    sid = sid.at[idx].set(b_sid, mode="drop")
-    return vt, num, sid
+    return pay.at[idx].set(b_pay, mode="drop")
 
 
 def _apply_mappings(graph, wf, elem, src_vt, src_num, src_sid, is_input):
@@ -174,12 +172,18 @@ def _select_by_map(dst_from, vt, num, sid):
 
 
 def step_kernel(
-    graph: DeviceGraph, state: EngineState, batch: RecordBatch, now
+    graph: DeviceGraph, state: EngineState, batch: RecordBatch, now,
+    synthetic_workers: bool = False,
 ) -> Tuple[EngineState, RecordBatch, dict]:
     """Process one committed-record batch; returns (state', emissions, stats).
 
     Emissions are compacted in oracle append order; ``emissions.src`` links
     each emission to its source row (host assigns positions/responses).
+
+    ``synthetic_workers`` (static, bench-only): every ACTIVATED push also
+    emits an instant COMPLETE command — the worker round-trip of
+    ``gateway/.../impl/subscription/job/JobSubscriber.java:51`` without
+    leaving the device.
     """
     b = batch.size
     v = state.num_vars
@@ -205,13 +209,20 @@ def step_kernel(
     job_ev = is_job & (rt == RT_EVENT)
     timer_cmd = valid & (vt_ == VT_TIMER) & (rt == RT_CMD)
 
-    ei_found, ei_slot = hashmap.lookup(state.ei_map, batch.key, wi_ev)
-    sc_found, sc_slot = hashmap.lookup(
-        state.ei_map, batch.scope_key, wi_ev & (batch.scope_key >= 0)
+    # the three element-instance lookups (record key / scope key / job
+    # activity key) probe the same table — ONE batched probe loop over the
+    # concatenated keys costs the same gather volume but a third of the
+    # serialized loop iterations
+    ei3_found, ei3_slot = hashmap.lookup(
+        state.ei_map,
+        jnp.concatenate([batch.key, batch.scope_key, batch.aux_key]),
+        jnp.concatenate(
+            [wi_ev, wi_ev & (batch.scope_key >= 0), job_ev | timer_cmd]
+        ),
     )
-    aik_found, aik_slot = hashmap.lookup(
-        state.ei_map, batch.aux_key, job_ev | timer_cmd
-    )
+    ei_found, ei_slot = ei3_found[:b], ei3_slot[:b]
+    sc_found, sc_slot = ei3_found[b : 2 * b], ei3_slot[b : 2 * b]
+    aik_found, aik_slot = ei3_found[2 * b :], ei3_slot[2 * b :]
     jb_found, jb_slot = hashmap.lookup(
         state.job_map, batch.key, job_cmd & (batch.key >= 0)
     )
@@ -395,11 +406,10 @@ def step_kernel(
         inmap_err = jnp.zeros((b,), bool)
 
     # output mapping: merge(record payload → scope payload)
-    scope_vt = state.ei_vt[sc_clip]
-    scope_num = state.ei_num[sc_clip]
-    scope_sid = state.ei_str[sc_clip]
+    scope_vt, scope_sid, scope_num = unpack_payload(state.ei_pay[sc_clip])
+    scope_vt = scope_vt.astype(jnp.int8)
     no_scope = ~sc_found
-    scope_vt = jnp.where(no_scope[:, None], jnp.int8(VT_ABSENT), scope_vt)
+    scope_vt = jnp.where(no_scope[:, None], VT_ABSENT, scope_vt)
     if graph.has_mappings:
         out_from, out_has, out_root, out_err = _apply_mappings(
             graph, wf_c, el_c, batch.v_vt, batch.v_num, batch.v_str, False
@@ -478,20 +488,21 @@ def step_kernel(
         win_var = m_pmerge[:, None] & src_present & (
             stamp[jnp.clip(aw, 0, j_cap - 1)] == my_pos[:, None]
         )
-        aw_var = jnp.where(win_var, aw[:, None], j_cap)
-        cols = jnp.broadcast_to(jnp.arange(v, dtype=jnp.int32)[None, :], (b, v))
-        join_vt = state.join_vt.at[aw_var, cols].set(batch.v_vt, mode="drop")
-        join_num = state.join_num.at[aw_var, cols].set(batch.v_num, mode="drop")
-        join_sid = state.join_str.at[aw_var, cols].set(batch.v_str, mode="drop")
+        win3 = jnp.concatenate([win_var, win_var, win_var], axis=1)
+        aw_var3 = jnp.where(win3, aw[:, None], j_cap)
+        cols3 = jnp.broadcast_to(
+            jnp.arange(3 * v, dtype=jnp.int32)[None, :], (b, 3 * v)
+        )
+        b_pay_join = pack_payload(batch.v_vt, batch.v_str, batch.v_num)
+        join_pay = state.join_pay.at[aw_var3, cols3].set(b_pay_join, mode="drop")
         # completion: all incoming arrived; completer = last arrival in batch
         arr_count = jnp.sum(arrived, axis=1).astype(jnp.int32)
         complete_slot = (join_nin_arr > 0) & (arr_count >= join_nin_arr)
         my_complete = m_pmerge & jn_found2 & complete_slot[arr_slot]
         completer = _last_writer(arr_slot, my_complete, j_cap)
         # merged payload for the completer
-        mg_vt = join_vt[arr_slot]
-        mg_num = join_num[arr_slot]
-        mg_sid = join_sid[arr_slot]
+        mg_vt, mg_sid, mg_num = unpack_payload(join_pay[arr_slot])
+        mg_vt = mg_vt.astype(jnp.int8)
     else:
         join_key = jnp.full((b,), -1, jnp.int64)
         arr_slot = jnp.zeros((b,), jnp.int32)
@@ -502,7 +513,7 @@ def step_kernel(
         join_nin_arr = state.join_nin
         arrived = state.join_arrived
         stamp = state.join_pos_stamp
-        join_vt, join_num, join_sid = state.join_vt, state.join_num, state.join_str
+        join_pay = state.join_pay
         jmap = state.join_map
         mg_vt, mg_num, mg_sid = batch.v_vt, batch.v_num, batch.v_str
 
@@ -555,7 +566,7 @@ def step_kernel(
 
     # ---------------- E. emissions ----------------
     zero_vt = jnp.zeros((b, v), jnp.int8)
-    zero_num = jnp.zeros((b, v), jnp.float64)
+    zero_num = jnp.zeros((b, v), jnp.float32)
     zero_sid = jnp.zeros((b, v), jnp.int32)
 
     def blank():
@@ -731,6 +742,17 @@ def step_kernel(
         type_id=batch.type_id, retries=batch.retries, deadline=batch.deadline,
         worker=batch.worker, push=True, req_stream=batch.req_stream,
     )
+    if synthetic_workers:
+        # bench-only instant worker: the COMPLETE lands in the slot right
+        # after its ACTIVATED, riding the normal emission compaction (e1 is
+        # never used by job-command rows, so the slot is free here)
+        e1 = put(
+            e1, jact_ok,
+            valid=True, rtype=RT_CMD, vtype=VT_JOB, intent=int(JI.COMPLETE),
+            key=batch.key, elem=batch.elem, aux_key=batch.aux_key,
+            type_id=batch.type_id, retries=batch.retries,
+            worker=batch.worker, src=jnp.full((b,), -1, jnp.int32),
+        )
     # completed value = stored job record + command payload
     st_elem = state.job_elem[jb_clip]
     st_wf = state.job_wf[jb_clip]
@@ -749,9 +771,11 @@ def step_kernel(
         req=batch.req, req_stream=batch.req_stream, resp=batch.req >= 0,
     )
     payload_nonempty = jnp.any(batch.v_vt != VT_ABSENT, axis=1)
-    fail_vt = jnp.where(payload_nonempty[:, None], batch.v_vt, state.job_vt[jb_clip])
-    fail_num = jnp.where(payload_nonempty[:, None], batch.v_num, state.job_num[jb_clip])
-    fail_sid = jnp.where(payload_nonempty[:, None], batch.v_str, state.job_str[jb_clip])
+    jb_vt, jb_sid, jb_num = unpack_payload(state.job_pay[jb_clip])
+    jb_vt = jb_vt.astype(jnp.int8)
+    fail_vt = jnp.where(payload_nonempty[:, None], batch.v_vt, jb_vt)
+    fail_num = jnp.where(payload_nonempty[:, None], batch.v_num, jb_num)
+    fail_sid = jnp.where(payload_nonempty[:, None], batch.v_str, jb_sid)
     e0 = put(
         e0, jfail_ok,
         valid=True, rtype=RT_EVENT, vtype=VT_JOB, intent=int(JI.FAILED),
@@ -771,9 +795,9 @@ def step_kernel(
         deadline=batch.deadline, worker=batch.worker,
         req=batch.req, req_stream=batch.req_stream, resp=batch.req >= 0,
     )
-    ret_vt = state.job_vt[jb_clip]
-    ret_num = state.job_num[jb_clip]
-    ret_sid = state.job_str[jb_clip]
+    ret_vt = jb_vt
+    ret_num = jb_num
+    ret_sid = jb_sid
     e0 = put(
         e0, jret_ok,
         valid=True, rtype=RT_EVENT, vtype=VT_JOB, intent=int(JI.RETRIES_UPDATED),
@@ -813,9 +837,10 @@ def step_kernel(
     )
 
     # --- slot 0: job events → workflow / activation / incident
-    wi_of_inst_vt = state.ei_vt[aik_clip]
-    wi_of_inst_num = state.ei_num[aik_clip]
-    wi_of_inst_sid = state.ei_str[aik_clip]
+    wi_of_inst_vt, wi_of_inst_sid, wi_of_inst_num = unpack_payload(
+        state.ei_pay[aik_clip]
+    )
+    wi_of_inst_vt = wi_of_inst_vt.astype(jnp.int8)
     inst_elem = state.ei_elem[aik_clip]
     inst_wf = state.ei_wf[aik_clip]
     inst_scope_slot = state.ei_scope_slot[aik_clip]
@@ -948,10 +973,8 @@ def step_kernel(
     ].set(1, mode="drop")
 
     # scope payload on consume (oracle: scope value.payload = record payload)
-    ei_vt, ei_num, ei_str = _scatter_payload(
-        state.ei_vt, state.ei_num, state.ei_str,
-        sc_clip, m_consume, batch.v_vt, batch.v_num, batch.v_str, n_cap,
-    )
+    b_pay = pack_payload(batch.v_vt, batch.v_str, batch.v_num)
+    ei_pay = _scatter_pay(state.ei_pay, sc_clip, m_consume, b_pay, n_cap)
     # scope state transition by consume completer
     ei_i32_arr = ei_i32_arr.at[
         jnp.where(consume_completer, sc_clip, n_cap), EI_STATE
@@ -960,17 +983,14 @@ def step_kernel(
     ei_i32_arr = ei_i32_arr.at[jnp.where(inmap_ok, ei_clip, n_cap), EI_STATE].set(
         int(WI.ELEMENT_ACTIVATED), mode="drop"
     )
-    ei_vt, ei_num, ei_str = _scatter_payload(
-        ei_vt, ei_num, ei_str, ei_clip, inmap_ok, in_vt, in_num, in_sid, n_cap
+    ei_pay = _scatter_pay(
+        ei_pay, ei_clip, inmap_ok, pack_payload(in_vt, in_sid, in_num), n_cap
     )
     # job completed → instance completing
     ei_i32_arr = ei_i32_arr.at[jnp.where(jev_completed, aik_clip, n_cap), EI_STATE].set(
         int(WI.ELEMENT_COMPLETING), mode="drop"
     )
-    ei_vt, ei_num, ei_str = _scatter_payload(
-        ei_vt, ei_num, ei_str, aik_clip, jev_completed,
-        batch.v_vt, batch.v_num, batch.v_str, n_cap,
-    )
+    ei_pay = _scatter_pay(ei_pay, aik_clip, jev_completed, b_pay, n_cap)
     ei_i64_arr = state.ei_i64.at[
         jnp.where(jev_completed, aik_clip, n_cap), EIL_JOB_KEY
     ].set(-1, mode="drop")
@@ -1015,9 +1035,7 @@ def step_kernel(
         [ins_key, ins_ikey, jnp.full((b,), -1, jnp.int64)], axis=-1
     )
     ei_i64_arr = ei_i64_arr.at[iw].set(ei_i64_rows, mode="drop")
-    ei_vt = ei_vt.at[iw].set(batch.v_vt, mode="drop")
-    ei_num = ei_num.at[iw].set(batch.v_num, mode="drop")
-    ei_str = ei_str.at[iw].set(batch.v_str, mode="drop")
+    ei_pay = ei_pay.at[iw].set(b_pay, mode="drop")
     ei_map, ei_ins_ok = hashmap.insert(ei_map, ins_key, ins_slot, ins)
 
     # ---------------- job table ----------------
@@ -1038,9 +1056,7 @@ def step_kernel(
          jnp.full((b,), -1, jnp.int64)], axis=-1,
     )
     job_i64_arr = state.job_i64.at[jw].set(job_i64_rows, mode="drop")
-    job_vt_arr = state.job_vt.at[jw].set(batch.v_vt, mode="drop")
-    job_num_arr = state.job_num.at[jw].set(batch.v_num, mode="drop")
-    job_str_arr = state.job_str.at[jw].set(batch.v_str, mode="drop")
+    job_pay_arr = state.job_pay.at[jw].set(b_pay, mode="drop")
     job_map, job_ins_ok = hashmap.insert(state.job_map, job_base, j_slot, job_ins)
 
     # transitions: multi-column scatters share one op per dtype group
@@ -1056,9 +1072,7 @@ def step_kernel(
     job_i64_arr = job_i64_arr.at[jup, JBL_DEADLINE].set(
         batch.deadline, mode="drop"
     )
-    job_vt_arr = job_vt_arr.at[jup].set(batch.v_vt, mode="drop")
-    job_num_arr = job_num_arr.at[jup].set(batch.v_num, mode="drop")
-    job_str_arr = job_str_arr.at[jup].set(batch.v_str, mode="drop")
+    job_pay_arr = job_pay_arr.at[jup].set(b_pay, mode="drop")
 
     jfw = jnp.where(jfail_ok, jb_clip, m_cap)
     fail_cols = jnp.array([JB_STATE, JB_RETRIES], jnp.int32)
@@ -1068,9 +1082,9 @@ def step_kernel(
         ),
         mode="drop",
     )
-    job_vt_arr = job_vt_arr.at[jfw].set(fail_vt, mode="drop")
-    job_num_arr = job_num_arr.at[jfw].set(fail_num, mode="drop")
-    job_str_arr = job_str_arr.at[jfw].set(fail_sid, mode="drop")
+    job_pay_arr = job_pay_arr.at[jfw].set(
+        pack_payload(fail_vt, fail_sid, fail_num), mode="drop"
+    )
 
     job_i32_arr = job_i32_arr.at[
         jnp.where(jtime_ok, jb_clip, m_cap), JB_STATE
@@ -1190,13 +1204,11 @@ def step_kernel(
 
     new_state = EngineState(
         ei_i32=ei_i32_arr, ei_i64=ei_i64_arr,
-        ei_vt=ei_vt, ei_num=ei_num, ei_str=ei_str, ei_map=ei_map,
+        ei_pay=ei_pay, ei_map=ei_map,
         job_i32=job_i32_arr, job_i64=job_i64_arr,
-        job_vt=job_vt_arr, job_num=job_num_arr, job_str=job_str_arr,
-        job_map=job_map,
+        job_pay=job_pay_arr, job_map=job_map,
         join_key=join_key_arr, join_nin=join_nin_arr, join_arrived=arrived,
-        join_vt=join_vt, join_num=join_num, join_str=join_sid,
-        join_pos_stamp=stamp, join_map=join_map,
+        join_pay=join_pay, join_pos_stamp=stamp, join_map=join_map,
         timer_key=timer_key_arr, timer_due=timer_due_arr,
         timer_aik=timer_aik_arr, timer_instance_key=timer_ik_arr,
         timer_elem=timer_elem_arr, timer_wf=timer_wf_arr, timer_map=timer_map,
@@ -1223,7 +1235,9 @@ def step_kernel(
     return new_state, out, stats
 
 
-step_jit = jax.jit(step_kernel, donate_argnums=(1,))
+step_jit = jax.jit(
+    step_kernel, donate_argnums=(1,), static_argnames=("synthetic_workers",)
+)
 
 
 def tick_kernel(state: EngineState, now) -> Tuple[RecordBatch, jax.Array]:
@@ -1253,6 +1267,7 @@ def tick_kernel(state: EngineState, now) -> Tuple[RecordBatch, jax.Array]:
     jidx = jnp.clip(order - t_cap, 0, m_cap - 1)
 
     sel = jnp.arange(size, dtype=jnp.int32) < count
+    tick_jb_vt, tick_jb_sid, tick_jb_num = unpack_payload(state.job_pay[jidx])
     out = RecordBatch(
         valid=sel,
         rtype=jnp.full((size,), RT_CMD, jnp.int32),
@@ -1265,9 +1280,9 @@ def tick_kernel(state: EngineState, now) -> Tuple[RecordBatch, jax.Array]:
             is_timer, state.timer_instance_key[tidx], state.job_instance_key[jidx]
         ),
         scope_key=jnp.full((size,), -1, jnp.int64),
-        v_vt=jnp.where(is_timer[:, None], jnp.int8(0), state.job_vt[jidx]),
-        v_num=jnp.where(is_timer[:, None], 0.0, state.job_num[jidx]),
-        v_str=jnp.where(is_timer[:, None], 0, state.job_str[jidx]),
+        v_vt=jnp.where(is_timer[:, None], 0, tick_jb_vt).astype(jnp.int8),
+        v_num=jnp.where(is_timer[:, None], jnp.float32(0.0), tick_jb_num),
+        v_str=jnp.where(is_timer[:, None], 0, tick_jb_sid),
         req=jnp.full((size,), -1, jnp.int64),
         req_stream=jnp.full((size,), -1, jnp.int32),
         aux_key=jnp.where(is_timer, state.timer_aik[tidx], state.job_aik[jidx]),

@@ -80,7 +80,9 @@ def build_sharded_step(mesh: Mesh, exchange_slots: int = 128):
     ``sends`` carries hash-routed cross-partition command rows (row p,q =
     rows partition p addresses to partition q); the all_to_all delivers
     ``sends_in`` (rows arriving at each partition), which the caller
-    enqueues into the destination partition's queue next round — exactly
+    enqueues into the destination partition's queue next round (after
+    prefix-compaction: drive.enqueue requires valid rows contiguous at the
+    front, and all_to_all output interleaves them by source shard) — exactly
     the reference's subscription-transport hop, but over ICI.
     """
     axis = mesh.axis_names[0]

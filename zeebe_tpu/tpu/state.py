@@ -39,9 +39,9 @@ JB_STATE, JB_ELEM, JB_WF, JB_TYPE, JB_RETRIES, JB_WORKER = 0, 1, 2, 3, 4, 5
 JBL_KEY, JBL_IKEY, JBL_AIK, JBL_DEADLINE = 0, 1, 2, 3
 
 _STATE_FIELDS = [
-    "ei_i32", "ei_i64", "ei_vt", "ei_num", "ei_str", "ei_map",
-    "job_i32", "job_i64", "job_vt", "job_num", "job_str", "job_map",
-    "join_key", "join_nin", "join_arrived", "join_vt", "join_num", "join_str",
+    "ei_i32", "ei_i64", "ei_pay", "ei_map",
+    "job_i32", "job_i64", "job_pay", "job_map",
+    "join_key", "join_nin", "join_arrived", "join_pay",
     "join_pos_stamp", "join_map",
     "timer_key", "timer_due", "timer_aik", "timer_instance_key", "timer_elem",
     "timer_wf", "timer_map",
@@ -49,6 +49,40 @@ _STATE_FIELDS = [
     "sub_rr",
     "next_wf_key", "next_job_key",
 ]
+
+
+# ---------------------------------------------------------------------------
+# packed payload columns
+# ---------------------------------------------------------------------------
+# A table's payload (per-variable value type, interned string id, numeric
+# value) is ONE [cap, 3V] i32 matrix: cols [0,V) = value types, [V,2V) =
+# string ids, [2V,3V) = float32 numbers bitcast to i32. XLA lowers general
+# scatters to SERIAL per-index loops on TPU, so a payload write must be one
+# scatter, not three — and float32 (not 64) halves the emulated-64-bit op
+# cost throughout the kernel. Values that are not exactly representable in
+# f32 never reach the device: ``batch.payload_to_columns`` rejects them
+# into the host-oracle fallback path.
+
+
+def pack_payload(vt, sid, num):
+    """[..., V] (vt int, sid i32, num f32) → [..., 3V] i32."""
+    return jnp.concatenate(
+        [
+            vt.astype(jnp.int32),
+            sid.astype(jnp.int32),
+            jax.lax.bitcast_convert_type(num.astype(jnp.float32), jnp.int32),
+        ],
+        axis=-1,
+    )
+
+
+def unpack_payload(pay):
+    """[..., 3V] i32 → (vt i32, sid i32, num f32), each [..., V]."""
+    v = pay.shape[-1] // 3
+    vt = pay[..., :v]
+    sid = pay[..., v : 2 * v]
+    num = jax.lax.bitcast_convert_type(pay[..., 2 * v : 3 * v], jnp.float32)
+    return vt, sid, num
 
 
 @partial(jax.tree_util.register_dataclass, data_fields=_STATE_FIELDS, meta_fields=[])
@@ -59,9 +93,7 @@ class EngineState:
     # token count); ei_i64 cols = (key[-1 free], workflowInstanceKey, jobKey)
     ei_i32: jax.Array          # [N, 5] i32
     ei_i64: jax.Array          # [N, 3] i64
-    ei_vt: jax.Array           # [N, V] i8 payload value types
-    ei_num: jax.Array          # [N, V] f64
-    ei_str: jax.Array          # [N, V] i32
+    ei_pay: jax.Array          # [N, 3V] i32 packed payload (vt | sid | f32 bits)
     ei_map: hashmap.HashTable  # key → slot
 
     # jobs [M], packed: job_i32 cols = (state[-1 free], elem, wf, type,
@@ -69,18 +101,14 @@ class EngineState:
     # deadline)
     job_i32: jax.Array         # [M, 6] i32
     job_i64: jax.Array         # [M, 4] i64
-    job_vt: jax.Array          # [M, V]
-    job_num: jax.Array
-    job_str: jax.Array
+    job_pay: jax.Array         # [M, 3V] i32 packed payload
     job_map: hashmap.HashTable
 
     # parallel joins [J]
     join_key: jax.Array        # i64 composite (scope_key<<8 | gateway), -1 free
     join_nin: jax.Array        # i32
     join_arrived: jax.Array    # [J, F_in] bool
-    join_vt: jax.Array         # [J, V] merged payload
-    join_num: jax.Array
-    join_str: jax.Array
+    join_pay: jax.Array        # [J, 3V] i32 packed merged payload
     join_pos_stamp: jax.Array  # [J, V] i32: flow position that wrote each var
     join_map: hashmap.HashTable
 
@@ -151,7 +179,7 @@ class EngineState:
 
     @property
     def num_vars(self) -> int:
-        return self.ei_vt.shape[1]
+        return self.ei_pay.shape[1] // 3
 
 
 def _pow2(n: int) -> int:
@@ -175,29 +203,23 @@ def make_state(
     j = join_capacity or max(capacity // 8, 256)
     tm = timer_capacity or max(capacity // 8, 256)
     v = num_vars
-    i64, i32, i8, f64 = jnp.int64, jnp.int32, jnp.int8, jnp.float64
+    i64, i32 = jnp.int64, jnp.int32
 
     return EngineState(
         # ei_i32: elem=0, state=-1, wf=0, scope=-1, tokens=0
         ei_i32=jnp.tile(jnp.array([[0, -1, 0, -1, 0]], i32), (n, 1)),
         ei_i64=jnp.full((n, 3), -1, i64),
-        ei_vt=jnp.zeros((n, v), i8),
-        ei_num=jnp.zeros((n, v), f64),
-        ei_str=jnp.zeros((n, v), i32),
-        ei_map=hashmap.make(_pow2(4 * n)),
+        ei_pay=jnp.zeros((n, 3 * v), i32),
+        ei_map=hashmap.make(_pow2(8 * n)),
         # job_i32: state=-1, elem/wf/type/retries/worker=0
         job_i32=jnp.tile(jnp.array([[-1, 0, 0, 0, 0, 0]], i32), (m, 1)),
         job_i64=jnp.full((m, 4), -1, i64),
-        job_vt=jnp.zeros((m, v), i8),
-        job_num=jnp.zeros((m, v), f64),
-        job_str=jnp.zeros((m, v), i32),
-        job_map=hashmap.make(_pow2(4 * m)),
+        job_pay=jnp.zeros((m, 3 * v), i32),
+        job_map=hashmap.make(_pow2(8 * m)),
         join_key=jnp.full((j,), -1, i64),
         join_nin=jnp.zeros((j,), i32),
         join_arrived=jnp.zeros((j, max_join_in), bool),
-        join_vt=jnp.zeros((j, v), i8),
-        join_num=jnp.zeros((j, v), f64),
-        join_str=jnp.zeros((j, v), i32),
+        join_pay=jnp.zeros((j, 3 * v), i32),
         join_pos_stamp=jnp.full((j, v), -1, i32),
         join_map=hashmap.make(_pow2(4 * j)),
         timer_key=jnp.full((tm,), -1, i64),
