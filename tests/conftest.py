@@ -36,3 +36,22 @@ import pytest  # noqa: E402
 @pytest.fixture
 def tmp_log_dir(tmp_path):
     return str(tmp_path / "log")
+
+
+def make_tpu_broker(data_dir=None, clock=None, num_partitions=1):
+    """A single-node Broker whose partitions run the TPU device engine
+    (shared helper for the device-engine test classes)."""
+    from zeebe_tpu.engine.interpreter import WorkflowRepository
+    from zeebe_tpu.runtime import Broker, ControlledClock
+    from zeebe_tpu.tpu import TpuPartitionEngine
+
+    clock = clock or ControlledClock(start_ms=1_000_000)
+    repo = WorkflowRepository()
+    return Broker(
+        num_partitions=num_partitions,
+        data_dir=data_dir,
+        clock=clock,
+        engine_factory=lambda pid: TpuPartitionEngine(
+            pid, num_partitions, repository=repo, clock=clock
+        ),
+    )

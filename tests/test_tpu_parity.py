@@ -401,16 +401,9 @@ class TestPayloadContract:
     (``ClientApiMessageHandler.java:90-165``)."""
 
     def _tpu_broker(self):
-        clock = ControlledClock(start_ms=1_000_000)
-        repo = WorkflowRepository()
-        broker = Broker(
-            num_partitions=1,
-            clock=clock,
-            engine_factory=lambda pid: TpuPartitionEngine(
-                pid, 1, repository=repo, clock=clock
-            ),
-        )
-        return broker
+        from tests.conftest import make_tpu_broker
+
+        return make_tpu_broker()
 
     def test_inexact_float_create_is_rejected(self):
         from zeebe_tpu.protocol.enums import RejectionType
@@ -444,5 +437,178 @@ class TestPayloadContract:
                 int(r.metadata.record_type) == int(RecordType.COMMAND_REJECTION)
                 for r in broker.records(0)
             )
+        finally:
+            broker.close()
+
+
+class TestHostOnlyFallback:
+    """Device-incompatible workflows (message catch events this round) run
+    on the embedded host oracle of a TPU-backed partition — every deployed
+    workflow keeps executing (reference bar: the stream processor serves
+    the whole deployed set; `graph.check_device_compatible` decides WHERE
+    each one runs)."""
+
+    def _tpu_broker(self):
+        from tests.conftest import make_tpu_broker
+
+        return make_tpu_broker()
+
+    def test_mixed_deployment_both_complete(self):
+        broker = self._tpu_broker()
+        try:
+            client = ZeebeClient(broker)
+            client.deploy_model(order_process())
+            msg_model = (
+                Bpmn.create_process("wait-for-msg")
+                .start_event("s")
+                .message_catch_event(
+                    "wait", message_name="go", correlation_key="$.orderId"
+                )
+                .end_event("e")
+                .done()
+            )
+            client.deploy_model(msg_model)
+            engine = broker.partitions[0].engine
+            assert engine._host_only_keys, "message workflow should be host-only"
+            assert engine.graph is not None, "device workflow should compile"
+
+            # device workflow completes on the kernel
+            worker = JobWorker(broker, "payment-service", lambda ctx: {"ok": True})
+            client.create_instance("order-process", {"orderId": 1})
+            broker.run_until_idle()
+            assert len(worker.handled) == 1
+
+            # host-only workflow completes via message correlation
+            client.create_instance("wait-for-msg", {"orderId": 7})
+            broker.run_until_idle()
+            client.publish_message("go", correlation_key="7")
+            broker.run_until_idle()
+            completed = [
+                r for r in broker.records(0)
+                if int(r.metadata.value_type) == int(ValueType.WORKFLOW_INSTANCE)
+                and int(r.metadata.record_type) == int(RecordType.EVENT)
+                and int(r.metadata.intent) == int(WI.ELEMENT_COMPLETED)
+                and getattr(r.value, "activity_id", "") in ("order-process", "wait-for-msg")
+            ]
+            assert {r.value.activity_id for r in completed} == {
+                "order-process", "wait-for-msg"
+            }
+        finally:
+            broker.close()
+
+    def test_host_only_workflow_with_service_task(self):
+        """Jobs of host-only workflows are served through the embedded host
+        oracle's subscriptions (the device sub table only covers device
+        jobs) — a worker completes them like on a host partition."""
+        broker = self._tpu_broker()
+        try:
+            client = ZeebeClient(broker)
+            model = (
+                Bpmn.create_process("msg-then-work")
+                .start_event("s")
+                .message_catch_event(
+                    "wait", message_name="go2", correlation_key="$.k"
+                )
+                .service_task("work", type="late-service")
+                .end_event("e")
+                .done()
+            )
+            client.deploy_model(model)
+            assert broker.partitions[0].engine._host_only_keys
+            worker = JobWorker(broker, "late-service", lambda ctx: {"done": 1})
+            client.create_instance("msg-then-work", {"k": 5})
+            broker.run_until_idle()
+            client.publish_message("go2", correlation_key="5")
+            broker.run_until_idle()
+            assert len(worker.handled) == 1
+            events = [
+                (int(r.metadata.intent), getattr(r.value, "activity_id", ""))
+                for r in broker.records(0)
+                if int(r.metadata.value_type) == int(ValueType.WORKFLOW_INSTANCE)
+                and int(r.metadata.record_type) == int(RecordType.EVENT)
+            ]
+            assert (int(WI.ELEMENT_COMPLETED), "msg-then-work") in events
+        finally:
+            broker.close()
+
+    def test_mixed_deployment_survives_snapshot_restore(self, tmp_path):
+        """Snapshot + restart of a mixed (device + host-only) deployment
+        preserves the host-only split and workflow slot numbering — the
+        regression where restore compiled EVERYTHING into the device graph
+        wedged host-only instances at their catch events."""
+        from tests.conftest import make_tpu_broker
+
+        clock = ControlledClock(start_ms=1_000_000)
+        data = str(tmp_path / "data")
+
+        def make_broker():
+            return make_tpu_broker(data_dir=data, clock=clock)
+
+        broker = make_broker()
+        client = ZeebeClient(broker)
+        client.deploy_model(order_process())
+        msg_model = (
+            Bpmn.create_process("wait-for-msg")
+            .start_event("s")
+            .message_catch_event("wait", message_name="go3", correlation_key="$.k")
+            .end_event("e")
+            .done()
+        )
+        client.deploy_model(msg_model)
+        host_only_before = set(broker.partitions[0].engine._host_only_keys)
+        compiled_before = broker.partitions[0].engine._compiled_count
+        client.create_instance("wait-for-msg", {"k": 9})
+        broker.run_until_idle()
+        broker.snapshot()
+        broker.close()
+
+        broker = make_broker()
+        engine = broker.partitions[0].engine
+        assert set(engine._host_only_keys) == host_only_before
+        assert engine._compiled_count == compiled_before
+        client = ZeebeClient(broker)
+        client.publish_message("go3", correlation_key="9")
+        broker.run_until_idle()
+        completed = [
+            r for r in broker.records(0)
+            if int(r.metadata.value_type) == int(ValueType.WORKFLOW_INSTANCE)
+            and int(r.metadata.record_type) == int(RecordType.EVENT)
+            and int(r.metadata.intent) == int(WI.ELEMENT_COMPLETED)
+            and getattr(r.value, "activity_id", "") == "wait-for-msg"
+        ]
+        assert completed, "host-only instance must complete after restore"
+        # a device workflow still runs on the kernel after restore
+        worker = JobWorker(broker, "payment-service", lambda ctx: {"ok": 1})
+        client.create_instance("order-process", {"orderId": 3})
+        broker.run_until_idle()
+        assert len(worker.handled) == 1
+        broker.close()
+
+    def test_cancel_host_only_instance(self):
+        """CANCEL carries no workflow key — routing must recognize the
+        host-side instance by key (regression: it went to the device
+        kernel and vanished without a response)."""
+        broker = self._tpu_broker()
+        try:
+            client = ZeebeClient(broker)
+            client.deploy_model(order_process())  # device workflow too
+            msg_model = (
+                Bpmn.create_process("cancellable")
+                .start_event("s")
+                .message_catch_event("w", message_name="m9", correlation_key="$.k")
+                .end_event("e")
+                .done()
+            )
+            client.deploy_model(msg_model)
+            inst = client.create_instance("cancellable", {"k": 1})
+            broker.run_until_idle()
+            client.cancel_instance(inst.workflow_instance_key)
+            broker.run_until_idle()
+            canceled = [
+                r for r in broker.records(0)
+                if int(r.metadata.value_type) == int(ValueType.WORKFLOW_INSTANCE)
+                and int(r.metadata.intent) == int(WI.ELEMENT_TERMINATED)
+            ]
+            assert canceled
         finally:
             broker.close()

@@ -22,6 +22,7 @@ RocksDB checkpoints as raw byte streams.
 
 from __future__ import annotations
 
+import zlib
 from typing import Any, Dict, List
 
 import numpy as np
@@ -36,6 +37,11 @@ from zeebe_tpu.protocol.records import (
     TimerRecord,
     WorkflowInstanceRecord,
 )
+
+# compressed-envelope magic: snapshots are mostly sparse fixed-capacity
+# tables (device SoA state) — zlib turns multi-MB payloads into ~KBs,
+# which matters on the chunked snapshot-replication wire
+_ZMAGIC = b"ZBZ1"
 
 FORMAT_HOST_V1 = "zbtpu-host-state-v1"
 FORMAT_DEVICE_V1 = "zbtpu-device-state-v1"
@@ -334,21 +340,32 @@ def _decode_host_doc(doc: dict) -> Dict[str, Any]:
 
 
 def encode_state(state: Any) -> bytes:
-    """Engine-state → bytes. Dispatches on shape: a device-state envelope
-    (dict with 'fmt' already set by the device engine) passes through its
-    own encoder; a dict carrying KeyGenerators is host-engine state; any
-    other plain-data value is wrapped raw (msgpack.pack rejects non-data
-    objects, so nothing executable can sneak through this path either)."""
+    """Engine-state → bytes (zlib-compressed envelope). Dispatches on
+    shape: a device-state envelope (dict with 'fmt' already set by the
+    device engine) passes through its own encoder; a dict carrying
+    KeyGenerators is host-engine state; any other plain-data value is
+    wrapped raw (msgpack.pack rejects non-data objects, so nothing
+    executable can sneak through this path either)."""
     if isinstance(state, dict) and state.get("fmt") == FORMAT_DEVICE_V1:
-        return encode_device_state(state)
-    if isinstance(state, dict) and isinstance(state.get("wf_keys"), KeyGenerator):
-        return encode_host_state(state)
-    return msgpack.pack({"fmt": FORMAT_RAW_V1, "data": state})
+        raw = encode_device_state(state)
+    elif isinstance(state, dict) and isinstance(state.get("wf_keys"), KeyGenerator):
+        raw = encode_host_state(state)
+    else:
+        raw = msgpack.pack({"fmt": FORMAT_RAW_V1, "data": state})
+    return _ZMAGIC + zlib.compress(raw, level=1)
 
 
 def decode_state(payload: bytes) -> Any:
     if len(payload) > MAX_SNAPSHOT_BYTES:
         raise SnapshotFormatError("snapshot payload too large")
+    if payload[:4] == _ZMAGIC:
+        try:
+            d = zlib.decompressobj()
+            payload = d.decompress(payload[4:], MAX_SNAPSHOT_BYTES)
+            if d.unconsumed_tail:
+                raise SnapshotFormatError("snapshot decompresses too large")
+        except zlib.error as e:
+            raise SnapshotFormatError(f"corrupt snapshot: {e}") from None
     try:
         doc = msgpack.unpack(payload)
     except Exception as e:

@@ -142,12 +142,11 @@ class PartitionServer:
             self.broker.actor_control.run(self._uninstall_leader)
 
     def _install_leader(self, term: int) -> None:
-        self.engine = PartitionEngine(
-            partition_id=self.partition_id,
-            num_partitions=self.broker.cfg.cluster.partitions,
-            repository=self.broker.repository,
-            clock=self.broker.clock,
-        )
+        # the engine is the partition's stream processor — installed on
+        # leadership like the reference's PartitionInstallService installing
+        # TypedStreamProcessors (:106-291). Which engine (host oracle or
+        # TPU device engine) is the broker's engine_factory's choice.
+        self.engine = self.broker._new_engine(self.partition_id)
         # recovery: snapshot + replay of the committed log, side effects
         # suppressed (same contract as the single-node broker)
         state, meta = self.snapshots.recover(self.log.next_position - 1)
@@ -329,9 +328,13 @@ class ClusterBroker(Actor):
         data_dir: str,
         scheduler: Optional[ActorScheduler] = None,
         clock: Optional[Callable[[], int]] = None,
+        engine_factory: Optional[
+            Callable[[int, "ClusterBroker"], PartitionEngine]
+        ] = None,
     ):
         super().__init__(f"broker-{cfg.cluster.node_id}")
         self.cfg = cfg
+        self._engine_factory = engine_factory
         self.node_id = cfg.cluster.node_id
         self.data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
@@ -405,7 +408,9 @@ class ClusterBroker(Actor):
     # -- lifecycle ---------------------------------------------------------
     def on_actor_started(self) -> None:
         self.actor_control = self.actor
-        self.actor.run_at_fixed_rate(self._snapshot_period_ms, self.snapshot_all)
+        self.actor.run_at_fixed_rate(
+            self._snapshot_period_ms, self._snapshot_all_on_actor
+        )
         self.actor.run_at_fixed_rate(100, self._tick_engines)
         # disseminate this node's client endpoint so the topic orchestrator
         # can reach any member over the management plane (reference: local
@@ -481,6 +486,24 @@ class ClusterBroker(Actor):
             server.raft.bootstrap(raft_members)
 
         self.actor.run(do)
+
+    def _new_engine(self, partition_id: int):
+        """Build the stream-processing engine for a partition this node
+        leads. Default is the host oracle engine; pass ``engine_factory``
+        (e.g. ``TpuPartitionEngine``) to serve partitions from the device
+        kernel — the factory is the cluster analogue of the single-node
+        Broker's ``engine_factory``."""
+        if self._engine_factory is not None:
+            # fixed, documented signature: factory(partition_id, broker) —
+            # the broker gives factories access to the shared repository
+            # and clock without arity guessing
+            return self._engine_factory(partition_id, self)
+        return PartitionEngine(
+            partition_id=partition_id,
+            num_partitions=self.cfg.cluster.partitions,
+            repository=self.repository,
+            clock=self.clock,
+        )
 
     def close(self) -> None:
         self._closing = True
@@ -611,7 +634,7 @@ class ClusterBroker(Actor):
                 )
         payload, crc = cached
         offset = int(msg.get("offset", 0))
-        length = min(max(int(msg.get("length", 256 * 1024)), 0), 4 * 1024 * 1024)
+        length = min(max(int(msg.get("length", 1024 * 1024)), 0), 4 * 1024 * 1024)
         return msgpack.pack(
             {
                 "t": "ok",
@@ -1391,6 +1414,14 @@ class ClusterBroker(Actor):
 
     # -- periodic work -------------------------------------------------------
     def snapshot_all(self) -> None:
+        """Checkpoint every led partition. Safe from any thread: the work
+        runs on the broker actor, serialized with record processing — a
+        snapshot reads the same engine state processing mutates, and the
+        device engine additionally DONATES its buffers to XLA each step
+        (a concurrent read would hit deleted arrays)."""
+        self.actor.call(self._snapshot_all_on_actor).join(30)
+
+    def _snapshot_all_on_actor(self) -> None:
         for server in self.partitions.values():
             server.snapshot()
 

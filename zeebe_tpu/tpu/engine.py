@@ -14,9 +14,10 @@ reference likewise runs deployments on the system partition only,
 order, which preserves the oracle's append order (each record's follow-ups
 appended after the whole committed batch, record-major).
 
-Workflows must be device-compatible (``graph.check_device_compatible``);
-deploying an incompatible one raises — such topics belong on an
-oracle-backed partition instead.
+Device-incompatible workflows (``graph.check_device_compatible``) fall
+back per-workflow: their instance records route to the embedded host
+oracle, so a TPU-backed partition serves every deployed workflow — the
+device graph simply covers the compatible subset.
 """
 
 from __future__ import annotations
@@ -121,25 +122,53 @@ class TpuPartitionEngine:
             capacity=capacity, num_vars=num_vars, sub_capacity=sub_capacity
         )
         self._compiled_count = 0
-        self.records_by_position: Dict[int, Record] = {}
+        self._host_only_keys: set = set()
+        # ONE position→record cache shared with the embedded host oracle:
+        # the broker fills it during recovery, host-side incident
+        # resolution reads it (reference TypedStreamReader by position)
+        self.records_by_position: Dict[int, Record] = self._host.records_by_position
         self.last_processed_position = -1
 
     # -- routing ----------------------------------------------------------
     def partition_for_correlation_key(self, correlation_key: str) -> int:
         return self._host.partition_for_correlation_key(correlation_key)
 
+    # topic orchestration + subscription-ack state live on the embedded
+    # host oracle (system-partition control plane); the cluster broker
+    # reads them through the engine interface
+    @property
+    def topics(self):
+        return self._host.topics
+
+    @property
+    def topic_sub_acks(self):
+        return self._host.topic_sub_acks
+
     # -- deployment → graph recompile -------------------------------------
-    def _recompile(self) -> None:
+    def _recompile(self, extra_variables=None) -> None:
+        """Split the deployed set: device-compatible workflows compile into
+        the graph; incompatible ones (exotic conditions, non-flat JSONPath,
+        …) run their instances on the embedded host oracle instead — the
+        per-workflow fallback that makes a TPU-backed partition a drop-in
+        for the host engine (reference bar: every deployed workflow keeps
+        executing; where is an implementation detail)."""
         workflows = []
-        for wf in sorted(self.repository.by_key, key=lambda k: k):
-            workflows.append(self.repository.by_key[wf])
-        for wf in workflows:
-            reason = graph_mod.check_device_compatible(wf)
-            if reason is not None:
-                raise DeviceIneligible(
-                    f"workflow '{wf.id}' cannot run on a TPU partition: {reason}"
-                )
-        var_names = list(self.meta.varspace.names) if self.meta else []
+        host_only = set()
+        for key in sorted(self.repository.by_key):
+            wf = self.repository.by_key[key]
+            if graph_mod.check_device_compatible(wf) is not None:
+                host_only.add(key)
+            else:
+                workflows.append(wf)
+        self._host_only_keys = host_only
+        if not workflows:
+            self.graph = None
+            self._compiled_count = 0
+            return
+        if extra_variables is not None:
+            var_names = list(extra_variables)
+        else:
+            var_names = list(self.meta.varspace.names) if self.meta else []
         self.graph, self.meta = graph_mod.compile_graph(
             workflows, interns=self.interns, extra_variables=var_names
         )
@@ -150,6 +179,39 @@ class TpuPartitionEngine:
             )
         self._compiled_count = len(workflows)
 
+    def _routes_to_host(self, record: Record) -> bool:
+        """True when a device-value-type record belongs to a host-only
+        workflow (or a host-side instance) and must run on the oracle."""
+        if not self._host_only_keys:
+            return False
+        vt = int(record.metadata.value_type)
+        value = record.value
+        if vt == int(ValueType.WORKFLOW_INSTANCE):
+            wf_key = value.workflow_key
+            if wf_key <= 0 and int(record.metadata.intent) == int(WI.CREATE):
+                wf = self._resolve_workflow(value)
+                wf_key = wf.key if wf is not None else -1
+            if wf_key in self._host_only_keys:
+                return True
+            # key-addressed commands (CANCEL, UPDATE_PAYLOAD) carry no
+            # workflow key — route by instance ownership: host-side
+            # instances live in the oracle's element-instance index
+            instances = self._host.element_instances.instances
+            return (
+                record.key in instances
+                or value.workflow_instance_key in instances
+            )
+        if vt == int(ValueType.JOB):
+            return value.headers.workflow_key in self._host_only_keys
+        if vt == int(ValueType.TIMER):
+            # host-side instances own their timers
+            return (
+                record.key in self._host.timers
+                or value.activity_instance_key
+                in self._host.element_instances.instances
+            )
+        return False
+
     def _var_column(self, name: str) -> int:
         if self.meta is None:
             raise PayloadError("no workflows deployed")
@@ -159,27 +221,85 @@ class TpuPartitionEngine:
         return col
 
     # -- worker subscriptions (host-managed device table) ------------------
-    def add_job_subscription(self, sub: JobSubscription) -> None:
+    def add_job_subscription(self, sub: JobSubscription) -> List[Record]:
         """Idempotent per subscriber key (same contract as the interpreter
         engine): a re-subscribe replaces the previous slot rather than
-        double-registering it."""
+        double-registering it.
+
+        Returns ACTIVATE commands for the backlog of already-created
+        matching jobs (reference: ActivateJobStreamProcessor reads the log
+        from the start, so pre-existing CREATED / failed-with-retries /
+        timed-out jobs get assigned too — this is what lets workers find
+        their jobs again after a failover/restart). The caller appends the
+        returned commands to the partition log, exactly like the host
+        oracle's add_job_subscription.
+
+        The subscription registers in BOTH engines: the device table serves
+        device-workflow jobs, the embedded host oracle serves jobs of
+        host-only workflows. Each side draws on its own credit counter, so
+        the per-subscription in-flight bound is per-engine."""
         self.remove_job_subscription(sub.subscriber_key)
+        host_backlog = self._host.add_job_subscription(dataclasses.replace(sub))
         s = self.state
         valid = np.asarray(s.sub_valid)
         free = int(np.argmin(valid)) if not valid.all() else -1
         if free < 0 or valid[free]:
             raise RuntimeError("subscription table full")
+
+        # backlog scan over the device job table (host-side; not hot path).
+        # JB_STATE only ever holds CREATED/ACTIVATED/FAILED/TIMED_OUT (the
+        # kernel keeps state FAILED on UPDATE_RETRIES and bumps only the
+        # retries column), so FAILED + retries>0 covers retries-updated jobs
+        activatable = {int(JI.CREATED), int(JI.TIMED_OUT), int(JI.FAILED)}
+        type_id = self.interns.intern(sub.job_type)
+        job_i32 = np.asarray(s.job_i32)
+        job_keys = np.asarray(s.job_key)
+        backlog: List[Record] = []
+        credits = sub.credits
+        candidates = [
+            (int(job_keys[slot]), slot)
+            for slot in np.nonzero(
+                (job_i32[:, state_mod.JB_STATE] != -1)
+                & (job_i32[:, state_mod.JB_TYPE] == type_id)
+                & (job_i32[:, state_mod.JB_RETRIES] > 0)
+            )[0]
+            if int(job_i32[slot, state_mod.JB_STATE]) in activatable
+        ]
+        for key, slot in sorted(candidates):
+            if credits <= 0:
+                break
+            activated = self._job_value_from_slot(int(slot))
+            activated.deadline = self.clock() + sub.timeout
+            activated.worker = sub.worker
+            backlog.append(
+                Record(
+                    key=key,
+                    value=activated,
+                    metadata=RecordMetadata(
+                        record_type=RecordType.COMMAND,
+                        value_type=ValueType.JOB,
+                        intent=int(JI.ACTIVATE),
+                        request_stream_id=sub.subscriber_key,
+                    ),
+                )
+            )
+            credits -= 1
+
         self.state = dataclasses.replace(
             s,
             sub_key=s.sub_key.at[free].set(sub.subscriber_key),
-            sub_type=s.sub_type.at[free].set(self.interns.intern(sub.job_type)),
+            sub_type=s.sub_type.at[free].set(type_id),
             sub_worker=s.sub_worker.at[free].set(self.interns.intern(sub.worker)),
-            sub_credits=s.sub_credits.at[free].set(sub.credits),
+            # backlog activations consumed credits up front; the kernel
+            # returns them on ACTIVATE rejection like pool assignments
+            sub_credits=s.sub_credits.at[free].set(credits),
             sub_timeout=s.sub_timeout.at[free].set(sub.timeout),
             sub_valid=s.sub_valid.at[free].set(True),
         )
+        return host_backlog + backlog
 
     def remove_job_subscription(self, subscriber_key: int) -> None:
+        self._host.remove_job_subscription(subscriber_key)
         s = self.state
         match = np.asarray(s.sub_key) == subscriber_key
         self.state = dataclasses.replace(
@@ -187,6 +307,7 @@ class TpuPartitionEngine:
         )
 
     def increase_job_credits(self, subscriber_key: int, credits: int) -> None:
+        self._host.increase_job_credits(subscriber_key, credits)
         s = self.state
         match = jnp.asarray(np.asarray(s.sub_key) == subscriber_key)
         self.state = dataclasses.replace(
@@ -251,6 +372,91 @@ class TpuPartitionEngine:
     def check_message_ttls(self) -> List[Record]:
         return self._host.check_message_ttls()
 
+    # -- snapshot / restore (reference StateSnapshotController: RocksDB
+    # checkpoint keyed by last-processed position; here the SoA tables are
+    # device_get into the data-only device envelope of log/stateser.py,
+    # alongside the intern/varspace sidecars and the embedded host oracle's
+    # state. Restore + replay is the same contract as the host engine:
+    # the broker replays committed records after last_processed_position
+    # with side effects suppressed.) --------------------------------------
+    def snapshot_state(self) -> dict:
+        from zeebe_tpu.log import stateser
+
+        arrays: Dict[str, np.ndarray] = {}
+        for f in dataclasses.fields(self.state):
+            v = getattr(self.state, f.name)
+            if hasattr(v, "keys") and hasattr(v, "vals"):  # HashTable
+                arrays[f.name + ".keys"] = np.asarray(v.keys)
+                arrays[f.name + ".vals"] = np.asarray(v.vals)
+            else:
+                arrays[f.name] = np.asarray(v)
+        return {
+            "fmt": stateser.FORMAT_DEVICE_V1,
+            "arrays": arrays,
+            "meta": {
+                # interned strings in id order (id 0 is reserved NIL);
+                # restoring in order reproduces identical ids, which the
+                # table columns (job types, workers, string payloads) hold
+                "interns": [s or "" for s in self.interns._by_id[1:]],
+                "variables": (
+                    list(self.meta.varspace.names) if self.meta else []
+                ),
+                "last_processed_position": self.last_processed_position,
+            },
+            "host": self._host.snapshot_state(),
+        }
+
+    def restore_state(self, snap: dict) -> None:
+        from zeebe_tpu.log import stateser
+        from zeebe_tpu.tpu import hashmap
+
+        if snap.get("fmt") != stateser.FORMAT_DEVICE_V1:
+            raise ValueError("not a device-engine snapshot")
+        # host oracle first: restores the shared repository (workflows) and
+        # the control-plane state families
+        self._host.restore_state(snap["host"])
+        meta = snap.get("meta", {})
+        self.interns = InternTable()
+        for s in meta.get("interns", []):
+            self.interns.intern(s)
+        # recompile through the SAME path as deployments (_recompile):
+        # it re-derives the host-only split and compiles only the
+        # device-compatible subset, so workflow slot numbering matches the
+        # run that wrote the snapshot; the snapshot's variable-column order
+        # is forced (column ids live in the payload matrices, so order is
+        # part of the state)
+        self.meta = None
+        self.graph = None
+        if self.repository.by_key:
+            self._recompile(extra_variables=list(meta.get("variables", [])))
+        arrays = snap["arrays"]
+        kwargs = {}
+        for f in dataclasses.fields(self.state):
+            if f.name + ".keys" in arrays:
+                kwargs[f.name] = hashmap.HashTable(
+                    keys=jnp.asarray(arrays[f.name + ".keys"]),
+                    vals=jnp.asarray(arrays[f.name + ".vals"]),
+                )
+            else:
+                kwargs[f.name] = jnp.asarray(arrays[f.name])
+        st = state_mod.EngineState(**kwargs)
+        # job-worker subscriptions are transient client-session state: the
+        # reference drops them across failover (workers re-subscribe); the
+        # snapshot carries the columns but a restored partition starts with
+        # an empty subscription table
+        st = dataclasses.replace(
+            st,
+            sub_key=jnp.full_like(st.sub_key, -1),
+            sub_credits=jnp.zeros_like(st.sub_credits),
+            sub_valid=jnp.zeros_like(st.sub_valid),
+        )
+        self.state = st
+        self.capacity = st.capacity
+        self.num_vars = st.num_vars
+        self.last_processed_position = int(
+            meta.get("last_processed_position", -1)
+        )
+
     def _job_value_from_slot(self, slot: int) -> JobRecord:
         s = self.state
         wf_slot = int(np.asarray(s.job_wf)[slot])
@@ -290,14 +496,20 @@ class TpuPartitionEngine:
 
     def process_batch(self, records: List[Record]) -> ProcessingResult:
         for record in records:
+            # records_by_position aliases the host oracle's cache (one
+            # shared dict) — a single write covers both readers
             self.records_by_position[record.position] = record
-            self._host.records_by_position[record.position] = record
 
         per_record: List[ProcessingResult] = [None] * len(records)
         device_rows: List[int] = []
         for i, record in enumerate(records):
             vt = int(record.metadata.value_type)
-            if vt in _DEVICE_VALUE_TYPES and self.meta is not None:
+            if (
+                vt in _DEVICE_VALUE_TYPES
+                and self.meta is not None
+                and self.graph is not None
+                and not self._routes_to_host(record)
+            ):
                 # data contract of TPU-backed partitions: payload numbers
                 # must be exactly representable in float32 (device payload
                 # columns are f32). Commands violating it are REJECTED at

@@ -30,6 +30,7 @@ import socket
 import struct
 import threading
 import time
+from collections import deque
 from typing import Callable, Dict, Optional, Tuple
 
 from zeebe_tpu.runtime.actors import ActorFuture
@@ -89,7 +90,19 @@ class _Conn:
 
 
 class _IoLoop:
-    """Selector loop shared by server and client transports."""
+    """Selector loop shared by server and client transports.
+
+    Threading contract: ``selectors`` objects are NOT thread-safe, and the
+    send paths run on arbitrary caller threads. Every selector mutation
+    (register / modify / unregister) therefore executes ON the IO thread —
+    other threads post a command and wake the loop. An earlier revision
+    called ``selector.modify`` directly from caller threads with a blanket
+    ``except KeyError: pass``; two racing modifies could silently leave a
+    socket's write interest disabled with a non-empty write buffer, wedging
+    the connection until every in-flight request timed out (the
+    ``test_concurrent_callers`` 4-way stall). Reference analogue: all
+    channel interest changes run on the Sender/Receiver actors
+    (``transport/.../impl/selector/``)."""
 
     def __init__(self, name: str):
         self.selector = selectors.DefaultSelector()
@@ -97,6 +110,7 @@ class _IoLoop:
         self._wake_r.setblocking(False)
         self.selector.register(self._wake_r, selectors.EVENT_READ, ("wake", None))
         self._running = True
+        self._cmds: "deque" = deque()
         self.thread = threading.Thread(target=self._run, name=name, daemon=True)
 
     def start(self):
@@ -108,6 +122,14 @@ class _IoLoop:
             self._wake_w.send(b"\x00")
         except OSError:
             pass
+
+    def post(self, fn) -> None:
+        """Run ``fn`` on the IO thread (immediately when already on it)."""
+        if threading.current_thread() is self.thread:
+            fn()
+            return
+        self._cmds.append(fn)
+        self.wake()
 
     def stop(self):
         if not self._running:
@@ -122,9 +144,24 @@ class _IoLoop:
                 pass
         self.selector.close()
 
+    def _drain_cmds(self):
+        while True:
+            try:
+                fn = self._cmds.popleft()
+            except IndexError:
+                return
+            try:
+                fn()
+            except Exception:  # noqa: BLE001
+                import traceback
+
+                traceback.print_exc()
+
     def _run(self):
         while self._running:
+            self._drain_cmds()
             events = self.selector.select(timeout=0.05)
+            self._drain_cmds()
             for key, mask in events:
                 kind, ctx = key.data
                 try:
@@ -155,20 +192,36 @@ class _IoLoop:
 
     def register_conn(self, conn: _Conn, handler):
         conn.sock.setblocking(False)
-        self.selector.register(
-            conn.sock, selectors.EVENT_READ, ("conn", handler)
-        )
-        self.wake()
+
+        def _register():
+            if not conn.open:
+                return
+            # sync write interest from the buffer: a caller thread may have
+            # queued bytes (and a want_write that no-op'd) before this
+            # registration command ran
+            events = selectors.EVENT_READ | (
+                selectors.EVENT_WRITE if conn.wbuf else 0
+            )
+            try:
+                self.selector.register(conn.sock, events, ("conn", handler))
+            except (KeyError, ValueError, OSError):
+                pass
+
+        self.post(_register)
 
     def want_write(self, conn: _Conn, enable: bool):
-        try:
-            events = selectors.EVENT_READ | (selectors.EVENT_WRITE if enable else 0)
-            self.selector.modify(
-                conn.sock, events, self.selector.get_key(conn.sock).data
-            )
-            self.wake()
-        except (KeyError, ValueError, OSError, RuntimeError):
-            pass  # RuntimeError: selector closed during shutdown
+        def _modify():
+            try:
+                events = selectors.EVENT_READ | (
+                    selectors.EVENT_WRITE if enable else 0
+                )
+                key = self.selector.get_key(conn.sock)
+                if key.events != events:
+                    self.selector.modify(conn.sock, events, key.data)
+            except (KeyError, ValueError, OSError, RuntimeError):
+                pass  # closed/unregistered during shutdown
+
+        self.post(_modify)
 
     def send(self, conn: _Conn, data: bytes):
         with conn.lock:
