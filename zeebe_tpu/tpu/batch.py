@@ -204,3 +204,12 @@ def columns_to_payload(
         elif t == VT_STR:
             doc[name] = interns.string(int(sid[col]))
     return doc
+
+
+def compact(batch: RecordBatch) -> RecordBatch:
+    """Stable-reorder a batch so valid rows form a contiguous prefix
+    (drive.enqueue's precondition). Used for batches whose valid rows are
+    interleaved — e.g. the all_to_all exchange output, which groups rows by
+    source shard."""
+    order = jnp.argsort(~batch.valid, stable=True)
+    return jax.tree.map(lambda a: jnp.take(a, order, axis=0), batch)

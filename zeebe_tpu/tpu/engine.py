@@ -179,30 +179,261 @@ class TpuPartitionEngine:
             )
         self._compiled_count = len(workflows)
 
+    # -- instance demotion: rare imperative ops take the host path ---------
+    def _live_device_instance_slot(self, key: int) -> int:
+        """Slot of a live root element instance in the device table, -1
+        when absent (completed, unknown, or host-side)."""
+        if key < 0:
+            return -1
+        keys = np.asarray(self.state.ei_i64[:, 0])
+        states = np.asarray(self.state.ei_i32[:, state_mod.EI_STATE])
+        hits = np.nonzero((keys == key) & (states != -1))[0]
+        return int(hits[0]) if len(hits) else -1
+
+    def _demote_instance(self, root_key: int) -> None:
+        """Migrate a live instance's scope tree (+ its jobs and timers)
+        from the device SoA tables into the embedded host oracle.
+
+        CANCEL and UPDATE_PAYLOAD are rare imperative control operations;
+        running them host-side preserves the oracle's exact record cascade
+        (CancelWorkflowInstanceProcessor's termination order, child-by-key
+        sorting, job CANCEL commands) without teaching the SIMD kernel a
+        cold path. The device keeps the hot lifecycle; a demoted instance
+        finishes on the oracle — semantically invisible, since the oracle
+        IS the semantics."""
+        from zeebe_tpu.tpu import hashmap
+
+        s = self.state
+        ei_i32 = np.asarray(s.ei_i32)
+        ei_i64 = np.asarray(s.ei_i64)
+        ei_pay = np.asarray(s.ei_pay)
+        states = ei_i32[:, state_mod.EI_STATE]
+        live = states != -1
+
+        root_slot = self._live_device_instance_slot(root_key)
+        if root_slot < 0:
+            return
+        # collect the scope tree (parent-slot pointers, bounded depth)
+        tree = {root_slot}
+        changed = True
+        while changed:
+            changed = False
+            for slot in np.nonzero(live)[0]:
+                parent = int(ei_i32[slot, state_mod.EI_SCOPE])
+                if parent in tree and int(slot) not in tree:
+                    tree.add(int(slot))
+                    changed = True
+        slots_sorted = sorted(tree, key=lambda sl: int(ei_i64[sl, 0]))
+
+        names = self.meta.varspace.names if self.meta else []
+        by_slot: Dict[int, object] = {}
+        for slot in slots_sorted:
+            key = int(ei_i64[slot, 0])
+            parent_slot = int(ei_i32[slot, state_mod.EI_SCOPE])
+            parent = by_slot.get(parent_slot)
+            wf_slot = int(ei_i32[slot, state_mod.EI_WF])
+            workflow = (
+                self.meta.workflows[wf_slot]
+                if self.meta and 0 <= wf_slot < len(self.meta.workflows)
+                else None
+            )
+            value = WorkflowInstanceRecord(
+                bpmn_process_id=workflow.id if workflow else "",
+                version=workflow.version if workflow else -1,
+                workflow_key=workflow.key if workflow else -1,
+                workflow_instance_key=int(ei_i64[slot, 1]),
+                activity_id=(
+                    self.meta.element_id(
+                        wf_slot, int(ei_i32[slot, state_mod.EI_ELEM])
+                    )
+                    if self.meta else ""
+                ),
+                payload=rb.columns_to_payload(
+                    *_host_unpack_payload(ei_pay[slot]), names, self.interns
+                ),
+                scope_instance_key=(
+                    int(ei_i64[parent_slot, 0]) if parent_slot in tree else -1
+                ),
+            )
+            inst = self._host.element_instances.new_instance(
+                key, value, WI(int(states[slot])), parent=parent
+            )
+            inst.job_key = int(ei_i64[slot, 2])
+            inst.active_tokens = int(ei_i32[slot, state_mod.EI_TOKENS])
+            by_slot[slot] = inst
+
+        tree_keys = {int(ei_i64[sl, 0]) for sl in tree}
+
+        # migrate this tree's jobs
+        job_i64 = np.asarray(s.job_i64)
+        job_i32 = np.asarray(s.job_i32)
+        job_slots = [
+            int(sl)
+            for sl in np.nonzero(job_i32[:, state_mod.JB_STATE] != -1)[0]
+            if int(job_i64[sl, state_mod.JBL_AIK]) in tree_keys
+        ]
+        from zeebe_tpu.engine.interpreter import JobState
+
+        for sl in job_slots:
+            jkey = int(job_i64[sl, state_mod.JBL_KEY])
+            self._host.jobs[jkey] = JobState(
+                state=int(job_i32[sl, state_mod.JB_STATE]),
+                record=self._job_value_from_slot(sl),
+                deadline=int(job_i64[sl, state_mod.JBL_DEADLINE]),
+            )
+
+        # migrate this tree's timers
+        from zeebe_tpu.engine.interpreter import TimerState
+
+        timer_keys = np.asarray(s.timer_key)
+        timer_aik = np.asarray(s.timer_aik)
+        timer_slots = [
+            int(sl)
+            for sl in np.nonzero(timer_keys >= 0)[0]
+            if int(timer_aik[sl]) in tree_keys
+        ]
+        for sl in timer_slots:
+            tkey = int(timer_keys[sl])
+            wf_slot = int(np.asarray(s.timer_wf)[sl])
+            self._host.timers[tkey] = TimerState(
+                due_date=int(np.asarray(s.timer_due)[sl]),
+                activity_instance_key=int(timer_aik[sl]),
+                record=TimerRecord(
+                    activity_instance_key=int(timer_aik[sl]),
+                    workflow_instance_key=int(
+                        np.asarray(s.timer_instance_key)[sl]
+                    ),
+                    due_date=int(np.asarray(s.timer_due)[sl]),
+                    handler_element_id=self.meta.element_id(
+                        wf_slot, int(np.asarray(s.timer_elem)[sl])
+                    ) if self.meta else "",
+                ),
+            )
+
+        # migrate in-flight parallel joins: device join rows are keyed by
+        # (scope_key << 10 | gateway element). The device merges arrival
+        # payloads eagerly (flow-position-stamped), so the reconstructed
+        # per-flow arrival map carries the merged payload for every arrived
+        # position — exact for termination (which discards it) and for
+        # joins that complete after demotion with the merged document.
+        join_keys = np.asarray(s.join_key)
+        join_arr = np.asarray(s.join_arrived)
+        join_pay_np = np.asarray(s.join_pay)
+        join_slots = [
+            int(sl)
+            for sl in np.nonzero(join_keys >= 0)[0]
+            if int(join_keys[sl]) >> 10 in tree_keys
+        ]
+        for sl in join_slots:
+            scope_key = int(join_keys[sl]) >> 10
+            gw_elem = int(join_keys[sl]) & ((1 << 10) - 1)
+            scope = self._host.element_instances.get(scope_key)
+            if scope is None:
+                continue
+            merged = rb.columns_to_payload(
+                *_host_unpack_payload(join_pay_np[sl]), names, self.interns
+            )
+            arrivals = {
+                int(pos): dict(merged)
+                for pos in np.nonzero(join_arr[sl])[0]
+            }
+            if arrivals:
+                scope.join_arrivals[gw_elem] = arrivals
+
+        # clear the migrated rows from the device tables + hash maps
+        ei_idx = jnp.asarray(sorted(tree), jnp.int32)
+        ei_del_keys = jnp.asarray(
+            [int(ei_i64[sl, 0]) for sl in sorted(tree)], jnp.int64
+        )
+        new_state = dataclasses.replace(
+            s,
+            ei_i32=s.ei_i32.at[ei_idx, state_mod.EI_STATE].set(-1),
+            ei_i64=s.ei_i64.at[ei_idx, 0].set(-1),
+            ei_map=hashmap.delete(
+                s.ei_map, ei_del_keys, jnp.ones(ei_del_keys.shape, bool)
+            ),
+        )
+        if job_slots:
+            j_idx = jnp.asarray(job_slots, jnp.int32)
+            j_keys = jnp.asarray(
+                [int(job_i64[sl, state_mod.JBL_KEY]) for sl in job_slots],
+                jnp.int64,
+            )
+            new_state = dataclasses.replace(
+                new_state,
+                job_i32=new_state.job_i32.at[j_idx, state_mod.JB_STATE].set(-1),
+                job_i64=new_state.job_i64.at[j_idx, state_mod.JBL_KEY].set(-1),
+                job_map=hashmap.delete(
+                    new_state.job_map, j_keys, jnp.ones(j_keys.shape, bool)
+                ),
+            )
+        if timer_slots:
+            t_idx = jnp.asarray(timer_slots, jnp.int32)
+            t_keys = jnp.asarray(
+                [int(timer_keys[sl]) for sl in timer_slots], jnp.int64
+            )
+            new_state = dataclasses.replace(
+                new_state,
+                timer_key=new_state.timer_key.at[t_idx].set(-1),
+                timer_due=new_state.timer_due.at[t_idx].set(-1),
+                timer_map=hashmap.delete(
+                    new_state.timer_map, t_keys, jnp.ones(t_keys.shape, bool)
+                ),
+            )
+        if join_slots:
+            jo_idx = jnp.asarray(join_slots, jnp.int32)
+            jo_keys = jnp.asarray(
+                [int(join_keys[sl]) for sl in join_slots], jnp.int64
+            )
+            new_state = dataclasses.replace(
+                new_state,
+                join_key=new_state.join_key.at[jo_idx].set(-1),
+                join_nin=new_state.join_nin.at[jo_idx].set(0),
+                join_arrived=new_state.join_arrived.at[jo_idx].set(False),
+                join_pos_stamp=new_state.join_pos_stamp.at[jo_idx].set(-1),
+                join_map=hashmap.delete(
+                    new_state.join_map, jo_keys, jnp.ones(jo_keys.shape, bool)
+                ),
+            )
+        self.state = new_state
+
     def _routes_to_host(self, record: Record) -> bool:
         """True when a device-value-type record belongs to a host-only
-        workflow (or a host-side instance) and must run on the oracle."""
-        if not self._host_only_keys:
-            return False
+        workflow or a host-side (possibly demoted) instance and must run on
+        the oracle. Pure — no side effects: process_batch performs the
+        demotion for CANCEL / UPDATE_PAYLOAD after flushing the pending
+        device segment, so demotion always sees up-to-date state."""
         vt = int(record.metadata.value_type)
         value = record.value
         if vt == int(ValueType.WORKFLOW_INSTANCE):
             wf_key = value.workflow_key
-            if wf_key <= 0 and int(record.metadata.intent) == int(WI.CREATE):
+            intent = int(record.metadata.intent)
+            if wf_key <= 0 and intent == int(WI.CREATE):
                 wf = self._resolve_workflow(value)
                 wf_key = wf.key if wf is not None else -1
             if wf_key in self._host_only_keys:
                 return True
-            # key-addressed commands (CANCEL, UPDATE_PAYLOAD) carry no
-            # workflow key — route by instance ownership: host-side
-            # instances live in the oracle's element-instance index
+            if int(record.metadata.record_type) == int(RecordType.COMMAND) and (
+                intent in (int(WI.CANCEL), int(WI.UPDATE_PAYLOAD))
+            ):
+                # rare imperative ops always take the host path (with
+                # demotion of their live device instance, done by
+                # process_batch at the segment boundary)
+                return True
+            # EVENTS of host-side (host-only or demoted) instances route
+            # by instance ownership in the oracle's element-instance index
             instances = self._host.element_instances.instances
             return (
                 record.key in instances
                 or value.workflow_instance_key in instances
             )
         if vt == int(ValueType.JOB):
-            return value.headers.workflow_key in self._host_only_keys
+            return (
+                value.headers.workflow_key in self._host_only_keys
+                or record.key in self._host.jobs
+                or value.headers.workflow_instance_key
+                in self._host.element_instances.instances
+            )
         if vt == int(ValueType.TIMER):
             # host-side instances own their timers
             return (
@@ -501,9 +732,28 @@ class TpuPartitionEngine:
             self.records_by_position[record.position] = record
 
         per_record: List[ProcessingResult] = [None] * len(records)
-        device_rows: List[int] = []
+        # segment processing: device rows batch up, but whenever a
+        # host-routed record appears the pending device segment FLUSHES
+        # through the kernel first — state mutates in strict log order,
+        # exactly like the oracle's per-record loop (a host record may
+        # depend on state a preceding device record writes, e.g. a job
+        # COMPLETE followed by the instance's CANCEL)
+        pending: List[int] = []
+
+        def flush() -> None:
+            if not pending:
+                return
+            results = self._process_device(
+                [records[i] for i in pending],
+                [records[i].position for i in pending],
+            )
+            for i, res in zip(pending, results):
+                per_record[i] = res
+            pending.clear()
+
         for i, record in enumerate(records):
             vt = int(record.metadata.value_type)
+            md = record.metadata
             if (
                 vt in _DEVICE_VALUE_TYPES
                 and self.meta is not None
@@ -521,20 +771,27 @@ class TpuPartitionEngine:
                 if bad is not None:
                     per_record[i] = self._reject_payload(record, bad)
                     continue
-                device_rows.append(i)
+                pending.append(i)
             else:
+                flush()  # earlier device rows execute BEFORE this record
+                if (
+                    vt == int(ValueType.WORKFLOW_INSTANCE)
+                    and int(md.record_type) == int(RecordType.COMMAND)
+                ):
+                    # rare imperative ops demote their live device instance
+                    # to the host oracle, which then runs the exact
+                    # reference cascade (see _demote_instance)
+                    if int(md.intent) == int(WI.CANCEL):
+                        self._demote_instance(record.key)
+                    elif int(md.intent) == int(WI.UPDATE_PAYLOAD):
+                        self._demote_instance(
+                            record.value.workflow_instance_key
+                        )
                 deployed_before = len(self.repository.by_key)
                 per_record[i] = self._host.process(record)
                 if len(self.repository.by_key) != deployed_before:
                     self._recompile()
-
-        if device_rows:
-            results = self._process_device(
-                [records[i] for i in device_rows],
-                [records[i].position for i in device_rows],
-            )
-            for i, res in zip(device_rows, results):
-                per_record[i] = res
+        flush()
 
         merged = ProcessingResult()
         for res in per_record:

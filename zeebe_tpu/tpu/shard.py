@@ -153,3 +153,108 @@ def make_exchange(num_partitions: int, slots: int, num_vars: int) -> RecordBatch
         for _ in range(num_partitions)
     ]
     return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *shards)
+
+
+def build_sharded_drive(
+    mesh: Mesh, batch_size: int, synthetic_workers: bool = False,
+    max_rounds: int = 10_000,
+):
+    """The multi-partition drive-to-quiescence loop as ONE device program:
+    per-partition record queues feed the step kernel under ``shard_map``,
+    with a ``psum`` of pending counts deciding GLOBAL quiescence (all
+    shards iterate in lockstep; a partition with an empty queue simply
+    processes empty batches until every partition drains — the sharded
+    analogue of ``drive.run_to_quiescence``).
+
+    Returns ``drive(graph, state[P], queue[P], now) →
+    (state', queue', totals[P])`` where totals carries per-shard processed/
+    emitted/completed counts plus the shared overflow flag.
+    """
+    from zeebe_tpu.tpu import drive as drive_mod
+
+    axis = mesh.axis_names[0]
+
+    def shard_fn(graph, state, queue, now):
+        state = _squeeze(state)
+        queue = _squeeze(queue)
+
+        totals0 = {
+            "processed": jnp.zeros((), jnp.int64),
+            "emitted": jnp.zeros((), jnp.int64),
+            "completed_roots": jnp.zeros((), jnp.int64),
+            "rounds": jnp.zeros((), jnp.int32),
+            "overflow": jnp.zeros((), bool),
+        }
+        pending0 = jax.lax.psum(queue.count, axis)
+
+        def cond(carry):
+            _s, _q, t, pending = carry
+            return (
+                (pending > 0)
+                & (t["rounds"] < max_rounds)
+                & (~t["overflow"])
+            )
+
+        def body(carry):
+            s, q, t, _pending = carry
+            q, batch = drive_mod.dequeue(q, batch_size)
+            s, out, stats = step_kernel(
+                graph, s, batch, now, synthetic_workers=synthetic_workers
+            )
+            q = drive_mod.enqueue(q, out)
+            t = {
+                "processed": t["processed"] + stats["processed"].astype(jnp.int64),
+                "emitted": t["emitted"] + stats["emitted"].astype(jnp.int64),
+                "completed_roots": t["completed_roots"]
+                + stats["completed_roots"].astype(jnp.int64),
+                "rounds": t["rounds"] + 1,
+                # overflow anywhere aborts everywhere (lockstep)
+                "overflow": t["overflow"]
+                | (jax.lax.psum(
+                    (stats["overflow"] | q.overflow).astype(jnp.int32), axis
+                ) > 0),
+            }
+            pending = jax.lax.psum(q.count, axis)
+            return s, q, t, pending
+
+        state, queue, totals, _ = jax.lax.while_loop(
+            cond, body, (state, queue, totals0, pending0)
+        )
+        return _unsqueeze(state), _unsqueeze(queue), _unsqueeze(totals)
+
+    spec_sharded = P(axis)
+    spec_repl = P()
+
+    def specs(tree, spec):
+        return jax.tree.map(lambda _: spec, tree)
+
+    def drive(graph, state, queue, now):
+        fn = jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(
+                specs(graph, spec_repl),
+                specs(state, spec_sharded),
+                specs(queue, spec_sharded),
+                spec_repl,
+            ),
+            out_specs=(
+                specs(state, spec_sharded),
+                specs(queue, spec_sharded),
+                {k: spec_sharded for k in (
+                    "processed", "emitted", "completed_roots", "rounds",
+                    "overflow",
+                )},
+            ),
+            check_vma=False,
+        )
+        return fn(graph, state, queue, now)
+
+    return jax.jit(drive)
+
+
+def make_partitioned_queue(num_partitions: int, capacity: int, num_vars: int):
+    from zeebe_tpu.tpu import drive as drive_mod
+
+    shards = [drive_mod.make_queue(capacity, num_vars) for _ in range(num_partitions)]
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *shards)
