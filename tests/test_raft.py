@@ -62,10 +62,11 @@ def job_record(i):
 
 
 class Cluster:
-    def __init__(self, scheduler, tmp_path, n, config=FAST):
+    def __init__(self, scheduler, tmp_path, n, config=FAST, segment_size=None):
         self.scheduler = scheduler
         self.tmp_path = tmp_path
         self.config = config
+        self.segment_size = segment_size
         self.nodes = {}
         self.logs = {}
         for i in range(n):
@@ -75,7 +76,11 @@ class Cluster:
             node.bootstrap(members)
 
     def _make_node(self, nid, port=0):
-        storage = SegmentedLogStorage(os.path.join(str(self.tmp_path), f"log-{nid}-{time.monotonic_ns()}"))
+        kw = {"segment_size": self.segment_size} if self.segment_size else {}
+        storage = SegmentedLogStorage(
+            os.path.join(str(self.tmp_path), f"log-{nid}-{time.monotonic_ns()}"),
+            **kw,
+        )
         # raft mode: commit position is leader-driven, never recovered
         log = LogStream(storage, partition_id=0, recover_commit=False)
         raft = Raft(
@@ -314,5 +319,171 @@ class TestPersistence:
             assert storage.term == term
             assert storage.voted_for == "n0"
             assert "n0" in storage.members
+        finally:
+            cluster.close()
+
+
+class TestMembershipChange:
+    """Single-step configuration change via entries on the replicated log
+    (reference ``raft/.../event/RaftConfigurationEvent.java`` +
+    ``RaftJoinService``; the configuration takes effect on APPEND, raft
+    dissertation §4.1)."""
+
+    def test_add_member_live(self, scheduler, tmp_path):
+        cluster = Cluster(scheduler, tmp_path, 3)
+        try:
+            leader = cluster.await_leader()
+            leader, last = append_with_retry(cluster, [job_record(i) for i in range(5)])
+            assert wait_until(
+                lambda: cluster.logs[leader.node_id].commit_position >= last
+            )
+            # bring up a 4th node knowing the current members + itself
+            new = cluster._make_node("n3")
+            members = {nid: n.address for nid, n in cluster.nodes.items()}
+            new.bootstrap(members)
+            leader.add_member("n3", new.address).join(5)
+            assert "n3" in leader.persistent.members
+            # the new member catches up on the existing log + config entry
+            assert wait_until(
+                lambda: cluster.logs["n3"].commit_position >= last, timeout=15
+            ), cluster.logs["n3"].next_position
+            # and its replicated config entry teaches IT the membership
+            assert wait_until(
+                lambda: set(new.persistent.members) == set(members) | {"n3"},
+                timeout=10,
+            ), new.persistent.members
+            # the new member counts toward commit
+            leader2, last2 = append_with_retry(cluster, [job_record(99)])
+            assert wait_until(
+                lambda: cluster.logs["n3"].commit_position >= last2, timeout=15
+            )
+        finally:
+            cluster.close()
+
+    def test_remove_member_adjusts_quorum(self, scheduler, tmp_path):
+        cluster = Cluster(scheduler, tmp_path, 3)
+        try:
+            leader = cluster.await_leader()
+            gone = next(nid for nid in cluster.nodes if nid != leader.node_id)
+            leader.remove_member(gone).join(5)
+            assert gone not in leader.persistent.members
+            cluster.nodes[gone].close()
+            del cluster.nodes[gone]
+            # 2-node cluster: quorum 2 still commits without the removed one
+            leader2, last = append_with_retry(cluster, [job_record(1)])
+            assert wait_until(
+                lambda: cluster.logs[leader2.node_id].commit_position >= last,
+                timeout=15,
+            )
+        finally:
+            cluster.close()
+
+    def test_config_survives_in_log_replication(self, scheduler, tmp_path):
+        """The config entry is an ordinary replicated record: followers
+        apply it from the append stream."""
+        cluster = Cluster(scheduler, tmp_path, 3)
+        try:
+            leader = cluster.await_leader()
+            new = cluster._make_node("n3")
+            members = {nid: n.address for nid, n in cluster.nodes.items()}
+            new.bootstrap(members)
+            leader.add_member("n3", new.address).join(5)
+            followers = [
+                n for nid, n in cluster.nodes.items()
+                if nid not in (leader.node_id, "n3")
+            ]
+            assert wait_until(
+                lambda: all("n3" in f.persistent.members for f in followers),
+                timeout=10,
+            ), [f.persistent.members for f in followers]
+        finally:
+            cluster.close()
+
+
+class TestCompaction:
+    def test_compaction_is_segment_aligned_and_survives_restart(
+        self, scheduler, tmp_path
+    ):
+        import dataclasses as dc
+
+        from zeebe_tpu.log.storage import SegmentedLogStorage
+
+        d = str(tmp_path / "compact-log")
+        storage = SegmentedLogStorage(d, segment_size=4096)
+        log = LogStream(storage, partition_id=0)
+        for i in range(400):
+            log.append([job_record(i)])
+        assert len(storage._segments) > 3
+        segments_before = list(storage._segments)
+        base = log.compact(300)
+        assert 0 < base <= 300
+        assert log.record_at(base - 1) is None
+        assert log.record_at(base).position == base
+        assert len(storage._segments) < len(segments_before)
+        # readers start at the floor
+        positions = [r.position for r in log.reader(0)]
+        assert positions[0] == base and positions[-1] == 399
+        storage.flush()
+        storage.close()
+
+        # restart: recovery rebuilds EXACTLY the compacted view
+        storage2 = SegmentedLogStorage(d, segment_size=4096)
+        log2 = LogStream(storage2, partition_id=0)
+        assert log2.base_position == base
+        assert [r.position for r in log2.reader(0)] == positions
+        storage2.close()
+
+    def test_follower_rejoins_after_compaction_via_snapshot(
+        self, scheduler, tmp_path
+    ):
+        """A follower that slept through compaction cannot be served the
+        deleted records; it installs the leader's snapshot (fast_forward)
+        and replication resumes from the snapshot boundary — the raft-level
+        contract behind SnapshotReplicationService catch-up."""
+        cluster = Cluster(scheduler, tmp_path, 3, segment_size=8192)
+        try:
+            leader = cluster.await_leader()
+            slow_id = next(nid for nid in cluster.nodes if nid != leader.node_id)
+            cluster.nodes[slow_id].close()
+            slow_log = cluster.logs[slow_id]
+            del cluster.nodes[slow_id]
+
+            # many small batches so storage segments actually roll (one
+            # giant batch would land in a single oversized segment and
+            # leave nothing compactable)
+            for i in range(0, 600, 20):
+                leader, last = append_with_retry(
+                    cluster, [job_record(j) for j in range(i, i + 20)]
+                )
+            assert wait_until(
+                lambda: cluster.logs[leader.node_id].commit_position >= last,
+                timeout=20,
+            )
+            # snapshot taken at the commit point; compact the whole prefix
+            leader_log = cluster.logs[leader.node_id]
+            base = leader_log.compact(leader_log.commit_position)
+            assert base > 0
+
+            # the rejoining follower is below the floor: simulate its
+            # snapshot install (the cluster broker's replication service
+            # does the fetch), then rejoin
+            slow_log.fast_forward(base, term=leader_log.term_at(base - 1))
+            raft = Raft(
+                slow_id,
+                slow_log,
+                scheduler,
+                config=FAST,
+                storage_path=os.path.join(str(tmp_path), f"raft-{slow_id}.meta"),
+            )
+            cluster.nodes[slow_id] = raft
+            members = {nid: n.address for nid, n in cluster.nodes.items()}
+            for node in cluster.nodes.values():
+                node.bootstrap(members)
+            # the follower catches up from the snapshot boundary onward
+            leader2, last2 = append_with_retry(cluster, [job_record(777)])
+            assert wait_until(
+                lambda: slow_log.commit_position >= last2, timeout=20
+            ), (slow_log.next_position, slow_log.base_position)
+            assert slow_log.base_position >= base
         finally:
             cluster.close()

@@ -118,6 +118,14 @@ class Raft(Actor):
         self.match_position: Dict[str, int] = {}
         self._last_heartbeat_ms = 0
         self._election_deadline_ms = 0
+        # set when the leader probes us with snapshot_needed (we are below
+        # its compaction floor); the snapshot-replication service reads it
+        # to decide a log fast-forward is legitimate
+        self.snapshot_needed = False
+        # applied config entries (position, members) for truncate rollback:
+        # single-step membership applies ON APPEND, so removing the entry
+        # from the log must revert to the previous configuration
+        self._config_log: List[tuple] = []
         self._state_listeners: List[Callable[[RaftState, int], None]] = []
         self._stopped = False
 
@@ -174,6 +182,90 @@ class Raft(Actor):
         self.actor.run(do)
         return future
 
+    def add_member(self, member_id: str, addr: RemoteAddress) -> ActorFuture:
+        """Leader-only single-step membership change: appends a
+        configuration entry with the new member set; the configuration
+        takes effect ON APPEND (reference RaftConfigurationEvent /
+        RaftJoinService; raft dissertation §4.1 — one change in flight at
+        a time is the caller's responsibility)."""
+        return self._change_membership(lambda m: {**m, member_id: [addr.host, addr.port]})
+
+    def remove_member(self, member_id: str) -> ActorFuture:
+        return self._change_membership(
+            lambda m: {k: v for k, v in m.items() if k != member_id}
+        )
+
+    def _change_membership(self, mutate) -> ActorFuture:
+        from zeebe_tpu.protocol.enums import RecordType, ValueType
+        from zeebe_tpu.protocol.metadata import RecordMetadata
+        from zeebe_tpu.protocol.records import RaftConfigurationRecord, Record
+
+        future = ActorFuture()
+
+        def do():
+            if self.state != RaftState.LEADER:
+                future.complete_exceptionally(RuntimeError("not leader"))
+                return
+            new_members = mutate(dict(self.persistent.members))
+            record = Record(
+                metadata=RecordMetadata(
+                    record_type=RecordType.EVENT,
+                    value_type=ValueType.RAFT,
+                    intent=0,
+                ),
+                value=RaftConfigurationRecord(members=new_members),
+            )
+            record.raft_term = self.persistent.term
+            last = self.log.append([record], commit=False)
+            self.log.flush()
+            self._config_log.append((last, dict(self.persistent.members)))
+            self._apply_config(new_members)
+            self.match_position[self.node_id] = last
+            self._maybe_commit()
+            self._replicate_all()
+            future.complete(last)
+
+        self.actor.run(do)
+        return future
+
+    def _apply_config(self, members: Dict[str, list]) -> None:
+        self.persistent.members = dict(members)
+        self.persistent.save()
+        if self.state == RaftState.LEADER:
+            last, _ = self._last_entry()
+            for mid in self._other_members():
+                self.next_position.setdefault(mid, last + 1)
+                self.match_position.setdefault(mid, -1)
+            for mid in list(self.next_position):
+                if mid not in self.persistent.members:
+                    self.next_position.pop(mid, None)
+                    self.match_position.pop(mid, None)
+            if self.node_id not in self.persistent.members:
+                # removed self: step aside (the remaining members elect)
+                self._become(RaftState.FOLLOWER)
+
+    def _maybe_apply_config(self, record) -> None:
+        from zeebe_tpu.protocol.enums import ValueType
+
+        if int(record.metadata.value_type) == int(ValueType.RAFT):
+            members = getattr(record.value, "members", None)
+            if isinstance(members, dict) and members:
+                self._config_log.append(
+                    (record.position, dict(self.persistent.members))
+                )
+                self._apply_config(members)
+
+    def _rollback_config(self, position: int) -> None:
+        """Truncating a suffix that contained configuration entries must
+        revert to the configuration in force before them (raft dissertation
+        §4.1: config-on-append implies config-rollback-on-truncate)."""
+        reverted = None
+        while self._config_log and self._config_log[-1][0] >= position:
+            _pos, previous = self._config_log.pop()
+            reverted = previous
+        if reverted is not None:
+            self._apply_config(reverted)
+
     def close(self) -> None:
         self._stopped = True
         self.server.close()
@@ -228,8 +320,7 @@ class Raft(Actor):
         pos = self.log.next_position - 1
         if pos < 0:
             return -1, -1
-        record = self.log._records[pos]
-        return pos, record.raft_term
+        return pos, self.log.term_at(pos)
 
     def _start_poll(self) -> None:
         """Reference RaftPollService: ask peers whether they would grant a
@@ -347,10 +438,32 @@ class Raft(Actor):
 
     def _replicate_one(self, member_id: str, addr: RemoteAddress) -> None:
         next_pos = self.next_position.get(member_id, 0)
+        if next_pos < self.log.base_position:
+            # the member is behind the compaction floor: the records it
+            # needs are gone. It catches up out-of-band via snapshot
+            # replication (SnapshotReplicationService analogue) and its
+            # next append-response log_end hint fast-forwards next_position.
+            self._ask(
+                addr,
+                msgpack.pack(
+                    {
+                        "t": "append",
+                        "term": self.persistent.term,
+                        "leader": self.node_id,
+                        "prev_position": self.log.next_position - 1,
+                        "prev_term": self.log.term_at(self.log.next_position - 1),
+                        "commit": self.log.commit_position,
+                        "frames": b"",
+                        "snapshot_needed": True,
+                    }
+                ),
+                lambda msg, mid=member_id: self._on_append_response(
+                    mid, -1, msg
+                ),
+            )
+            return
         prev_pos = next_pos - 1
-        prev_term = -1
-        if 0 <= prev_pos < self.log.next_position:
-            prev_term = self.log._records[prev_pos].raft_term
+        prev_term = self.log.term_at(prev_pos) if prev_pos >= 0 else -1
         frames = b""
         count = 0
         for pos in range(
@@ -360,7 +473,7 @@ class Raft(Actor):
                 next_pos + self.config.replication_batch_records,
             ),
         ):
-            frames += codec.encode_record(self.log._records[pos])
+            frames += codec.encode_record(self.log.record_at(pos))
             count += 1
         request = msgpack.pack(
             {
@@ -398,12 +511,17 @@ class Raft(Actor):
             self.next_position[member_id] = self.match_position[member_id] + 1
             self._maybe_commit()
         else:
-            # follower diverged: back off (follower tells us its log end to
-            # skip the classic one-at-a-time walk)
+            # follower diverged: resume from ITS log end (skips the classic
+            # one-at-a-time walk-back). The hint may also JUMP FORWARD —
+            # a follower that installed a snapshot past our compaction
+            # floor reports its fast-forwarded end, and replication must
+            # resume there rather than stay pinned below the floor.
             hint = int(msg.get("log_end", self.next_position.get(member_id, 1)))
-            self.next_position[member_id] = max(
-                0, min(hint, self.next_position.get(member_id, 1) - 1)
-            )
+            cur = self.next_position.get(member_id, 1)
+            if hint > cur:
+                self.next_position[member_id] = hint
+            else:
+                self.next_position[member_id] = max(0, min(hint, cur - 1))
 
     def _maybe_commit(self) -> None:
         """Quorum commit (reference LeaderState.commit:171-199): sort match
@@ -415,7 +533,7 @@ class Raft(Actor):
         candidate = positions[len(positions) - self._quorum()]
         if candidate <= self.log.commit_position:
             return
-        if self.log._records[candidate].raft_term != self.persistent.term:
+        if self.log.term_at(candidate) != self.persistent.term:
             return
         self.log.set_commit_position(candidate)
 
@@ -504,6 +622,7 @@ class Raft(Actor):
         self._last_heartbeat_ms = self.scheduler.now_ms()
         self._reset_election_timer()
 
+        self.snapshot_needed = bool(msg.get("snapshot_needed", False))
         prev_position = int(msg.get("prev_position", -1))
         prev_term = int(msg.get("prev_term", -1))
         if prev_position >= 0:
@@ -516,9 +635,12 @@ class Raft(Actor):
                         "log_end": self.log.next_position,
                     }
                 )
-            if self.log._records[prev_position].raft_term != prev_term:
+            if prev_position >= self.log.base_position and (
+                self.log.term_at(prev_position) != prev_term
+            ):
                 # conflicting suffix: truncate it (uncommitted by definition)
                 self.log.truncate(prev_position)
+                self._rollback_config(prev_position)
                 return msgpack.pack(
                     {
                         "t": "append-rsp",
@@ -537,10 +659,11 @@ class Raft(Actor):
         appended = False
         for record in records:
             if record.position < self.log.next_position:
-                existing = self.log._records[record.position]
-                if existing.raft_term == record.raft_term:
-                    continue  # duplicate delivery
+                existing = self.log.record_at(record.position)
+                if existing is None or existing.raft_term == record.raft_term:
+                    continue  # duplicate delivery (or compacted-away)
                 self.log.truncate(record.position)
+                self._rollback_config(record.position)
             if record.position != self.log.next_position:
                 return msgpack.pack(
                     {
@@ -551,6 +674,7 @@ class Raft(Actor):
                     }
                 )
             self.log.append_replicated(record)
+            self._maybe_apply_config(record)
             appended = True
         if appended:
             self.log.flush()  # durable before acking (commit-is-final)

@@ -341,6 +341,19 @@ class PartitionEngine:
     # -- snapshot support (reference: ComposedSnapshot of the processor's
     # state resources — ElementInstanceIndex SerializableWrapper, job RocksDB
     # checkpoint, incident/message maps; SURVEY.md §5 checkpoint/resume) ----
+    def compaction_floor(self) -> int:
+        """Highest log position below which records may be compacted away
+        (exclusive). Open incidents re-read their failure event from the
+        log on resolution (reference TypedStreamReader by position), so
+        those positions must survive until the incident is deleted."""
+        floor = self.last_processed_position + 1
+        for incident in self.incidents.values():
+            if incident.failure_event_position >= 0:
+                floor = min(floor, incident.failure_event_position)
+            if incident.incident_event_position >= 0:
+                floor = min(floor, incident.incident_event_position)
+        return floor
+
     def snapshot_state(self) -> dict:
         """All log-derived state. Excludes transient client-session state
         (job subscriptions re-register after failover, as in the reference)

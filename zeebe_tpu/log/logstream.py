@@ -12,6 +12,8 @@ contract — strictly increasing, stable across replay — is the same).
 
 from __future__ import annotations
 
+import json
+import os
 import time
 from typing import Callable, Iterator, List, Optional
 
@@ -47,6 +49,15 @@ class LogStream:
 
         self._next_position = 0
         self._commit_position = -1
+        # compaction floor: first position still held (in memory AND on
+        # disk); everything below is covered by a snapshot
+        self._base_position = 0
+        self._base_prev_term = -1  # raft term of record base_position-1
+        # first record position per storage segment (compaction is
+        # segment-aligned: a segment is deleted only when ALL its records
+        # fall below the floor, so the in-memory view always matches what
+        # recovery rebuilds from the remaining segments)
+        self._segment_first_pos: dict = {}
         # sparse block index: (position, address); reference LogBlockIndex.java:44
         self._block_index: List[tuple] = []
         # in-memory tail: records by dense position (the hot read path; disk is
@@ -54,7 +65,37 @@ class LogStream:
         # serving readers before/alongside storage)
         self._records: List[Record] = []
         self._commit_listeners: List[Callable[[int], None]] = []
+        self._load_base_meta()
         self._recover()
+
+    def _base_meta_path(self) -> str:
+        return os.path.join(self.storage.directory, "base.meta")
+
+    def _load_base_meta(self) -> None:
+        try:
+            with open(self._base_meta_path()) as f:
+                data = json.load(f)
+            self._base_prev_term = int(data.get("base_prev_term", -1))
+            self._base_meta_position = int(data.get("base_position", 0))
+        except (OSError, ValueError):
+            self._base_meta_position = 0
+
+    def _save_base_meta(self) -> None:
+        tmp = self._base_meta_path() + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(
+                    {
+                        "base_position": self._base_position,
+                        "base_prev_term": self._base_prev_term,
+                    },
+                    f,
+                )
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._base_meta_path())
+        except OSError:
+            pass
 
     # -- recovery scan (reference FsLogStorage recovery + LogBlockIndexWriter)
     def _recover(self) -> None:
@@ -76,10 +117,23 @@ class LogStream:
                     break
                 if record.position % BLOCK_INDEX_DENSITY == 0:
                     self._block_index.append((record.position, base_address + offset))
+                seg = self.storage.segment_of(base_address)
+                self._segment_first_pos.setdefault(seg, record.position)
+                if not self._records:
+                    self._base_position = record.position
                 self._records.append(record)
                 last_position = record.position
                 offset = next_offset
         self._next_position = last_position + 1
+        if not self._records and self._base_meta_position > 0:
+            # empty log after a fast-forward (or compaction that emptied
+            # it) followed by a crash: resume at the persisted base — the
+            # prev-term of base-1 was loaded with it
+            self._base_position = self._base_meta_position
+            self._next_position = max(self._next_position, self._base_meta_position)
+        elif self._base_position != self._base_meta_position:
+            # the persisted prev-term belongs to a different base: stale
+            self._base_prev_term = -1
         # Single-writer mode: recovered records were durably written, commit
         # resumes at the log end. Raft mode: stay at -1 until the leader
         # advances it (see __init__).
@@ -89,6 +143,95 @@ class LogStream:
     @property
     def next_position(self) -> int:
         return self._next_position
+
+    @property
+    def base_position(self) -> int:
+        """First retained position (compaction floor)."""
+        return self._base_position
+
+    def record_at(self, position: int) -> Optional[Record]:
+        """Record by position, None when compacted away or not yet
+        appended — the supported random-access API (raft replication and
+        readers must not reach into the private list)."""
+        idx = position - self._base_position
+        if idx < 0 or idx >= len(self._records):
+            return None
+        return self._records[idx]
+
+    def term_at(self, position: int) -> int:
+        """Raft term at ``position``; for ``base_position - 1`` the term is
+        retained across compaction (replication prev-entry check). -1 when
+        unknown."""
+        if position == self._base_position - 1:
+            return self._base_prev_term
+        record = self.record_at(position)
+        return record.raft_term if record is not None else -1
+
+    def compact(self, position: int) -> int:
+        """Compaction floor: drop records below ``position``, SEGMENT
+        aligned — a storage segment is deleted only when every record in
+        it falls below the floor, and the in-memory tail drops exactly the
+        deleted segments' records. This keeps the live view identical to
+        what a restart recovers from the remaining segments. Only
+        positions covered by a durable snapshot may be compacted (the
+        caller's contract — reference: the broker deletes segments below
+        the snapshot position). Returns the new base position."""
+        position = min(position, self._next_position)
+        if position <= self._base_position:
+            return self._base_position
+        segs = sorted(self._segment_first_pos)
+        # a segment is fully below the floor when the NEXT segment starts
+        # at or below the floor position
+        new_base = self._base_position
+        first_kept = None
+        for i, seg in enumerate(segs):
+            next_first = (
+                self._segment_first_pos[segs[i + 1]]
+                if i + 1 < len(segs) else self._next_position + 1
+            )
+            if next_first <= position:
+                continue  # fully compactable
+            first_kept = seg
+            new_base = max(
+                self._base_position, self._segment_first_pos[seg]
+            )
+            break
+        if first_kept is None or new_base <= self._base_position:
+            return self._base_position
+        prev = self.record_at(new_base - 1)
+        self._base_prev_term = prev.raft_term if prev is not None else -1
+        del self._records[: new_base - self._base_position]
+        self._base_position = new_base
+        self._block_index = [e for e in self._block_index if e[0] >= new_base]
+        self.storage.delete_segments_before(first_kept)
+        self._segment_first_pos = {
+            s: p for s, p in self._segment_first_pos.items() if s >= first_kept
+        }
+        # the prev-term of base-1 must survive restarts (leaders advertise
+        # it in replication prev-entry checks; -1 would make followers
+        # truncate or wedge)
+        self._save_base_meta()
+        return self._base_position
+
+    def fast_forward(self, position: int, term: int = -1) -> None:
+        """Jump an empty-or-behind log to ``position`` (exclusive: next
+        append lands there) after installing a snapshot that covers
+        everything below — the follower side of snapshot catch-up
+        (reference SnapshotReplicationService + follower reset). Refuses
+        to rewind."""
+        if position <= self._next_position:
+            return
+        # the snapshot supersedes everything on disk: reset storage so a
+        # restart cannot resurrect the pre-gap records
+        self.storage.reset()
+        self._records.clear()
+        self._block_index = []
+        self._segment_first_pos = {}
+        self._base_position = position
+        self._base_prev_term = term
+        self._next_position = position
+        self._commit_position = max(self._commit_position, position - 1)
+        self._save_base_meta()
 
     @property
     def commit_position(self) -> int:
@@ -107,6 +250,10 @@ class LogStream:
             self._records.append(record)
             self._next_position += 1
         address = self.storage.append(b"".join(frames))
+        if records:
+            self._segment_first_pos.setdefault(
+                self.storage.segment_of(address), records[0].position
+            )
         offset = 0
         for record, frame in zip(records, frames):
             if record.position % BLOCK_INDEX_DENSITY == 0:
@@ -127,6 +274,9 @@ class LogStream:
             )
         frame = codec.encode_record(record)
         address = self.storage.append(frame)
+        self._segment_first_pos.setdefault(
+            self.storage.segment_of(address), record.position
+        )
         self._records.append(record)
         if record.position % BLOCK_INDEX_DENSITY == 0:
             self._block_index.append((record.position, address))
@@ -168,7 +318,13 @@ class LogStream:
             self._next_position = position
             self._commit_position = min(self._commit_position, position - 1)
             self._block_index = [e for e in self._block_index if e[0] < position]
-            del self._records[position:]
+            # purge segment bookkeeping for truncated-away content: a stale
+            # too-low first-position would later let compact() delete a
+            # segment still holding live records
+            self._segment_first_pos = {
+                s: p for s, p in self._segment_first_pos.items() if p < position
+            }
+            del self._records[position - self._base_position :]
 
 
 def _iter_disk_frames(log: LogStream, target: int) -> Iterator[tuple]:
@@ -213,8 +369,12 @@ class LogStreamReader:
         self._position = max(position, 0)
 
     def __iter__(self) -> Iterator[Record]:
-        while self._position < len(self.log._records):
-            record = self.log._records[self._position]
+        if self._position < self.log.base_position:
+            self._position = self.log.base_position
+        while True:
+            record = self.log.record_at(self._position)
+            if record is None:
+                return
             self._position = record.position + 1
             yield record
 
@@ -223,8 +383,12 @@ class LogStreamReader:
         (records past the commit position are not consumed)."""
         commit = self.log.commit_position
         out = []
-        while self._position <= commit and self._position < len(self.log._records):
-            record = self.log._records[self._position]
+        if self._position < self.log.base_position:
+            self._position = self.log.base_position
+        while self._position <= commit:
+            record = self.log.record_at(self._position)
+            if record is None:
+                break
             out.append(record)
             self._position = record.position + 1
         return out

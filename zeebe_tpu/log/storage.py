@@ -101,6 +101,23 @@ class SegmentedLogStorage:
         self._current_size += len(block)
         return address
 
+    def delete_segments_before(self, segment_id: int) -> int:
+        """Delete whole segment files with id < ``segment_id`` (log
+        compaction floor — reference: the broker deletes segments below the
+        committed snapshot position). Never deletes the current segment.
+        Returns the number of segments removed."""
+        removed = 0
+        for sid in list(self._segments):
+            if sid >= segment_id or sid == self._current_id:
+                break
+            try:
+                os.remove(self._segment_path(sid))
+            except OSError:
+                break
+            self._segments.remove(sid)
+            removed += 1
+        return removed
+
     def flush(self) -> None:
         if self._current_file is not None:
             self._current_file.flush()
@@ -135,6 +152,19 @@ class SegmentedLogStorage:
         return self.address(self._segments[0], SEGMENT_HEADER_SIZE)
 
     # -- truncate (test/failure injection; reference FsLogStorage.truncate) --
+    def reset(self) -> None:
+        """Delete ALL segments and roll a fresh one (snapshot fast-forward:
+        the installed snapshot supersedes everything on disk)."""
+        self._current_file.close()
+        self._current_file = None
+        for sid in list(self._segments):
+            try:
+                os.unlink(self._segment_path(sid))
+            except OSError:
+                pass
+        self._segments = []
+        self._roll_segment(0)
+
     def truncate(self, address: int) -> None:
         segment_id = self.segment_of(address)
         offset = self.offset_of(address)

@@ -278,8 +278,24 @@ class NoopRecord(RecordValue):
     VALUE_TYPE: ClassVar[ValueType] = ValueType.NOOP
 
 
+@dataclasses.dataclass
+class RaftConfigurationRecord(RecordValue):
+    """Membership-change entry on the replicated log (reference
+    ``raft/.../event/RaftConfigurationEvent.java``; single-step change —
+    the new configuration takes effect as soon as the entry is APPENDED,
+    raft dissertation §4.1)."""
+
+    VALUE_TYPE: ClassVar[ValueType] = ValueType.RAFT
+
+    # member id → [host, port]
+    members: Dict[str, Any] = dataclasses.field(
+        default_factory=dict, metadata={"key": "members"}
+    )
+
+
 VALUE_CLASS_BY_TYPE = {
     ValueType.NOOP: NoopRecord,
+    ValueType.RAFT: RaftConfigurationRecord,
     ValueType.WORKFLOW_INSTANCE: WorkflowInstanceRecord,
     ValueType.JOB: JobRecord,
     ValueType.INCIDENT: IncidentRecord,

@@ -207,6 +207,16 @@ class Broker:
                 term=partition.term,
             )
             partition.snapshots.take(partition.engine.snapshot_state(), metadata)
+            # compaction: the snapshot covers everything below its
+            # last-processed position — drop those records (bounded by the
+            # engine's floor: open incidents still re-read their failure
+            # events by position). Reference: segments below the snapshot
+            # are deleted; the log stops pinning every record in RAM.
+            floor = min(
+                metadata.last_processed_position + 1,
+                partition.engine.compaction_floor(),
+            )
+            partition.log.compact(floor)
 
     # -- client API (reference ClientApiMessageHandler) --------------------
     def write_command(

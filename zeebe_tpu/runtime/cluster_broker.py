@@ -303,14 +303,20 @@ class PartitionServer:
     def snapshot(self) -> None:
         if not self.is_leader or self.engine is None:
             return
-        self.snapshots.take(
-            self.engine.snapshot_state(),
-            SnapshotMetadata(
-                last_processed_position=self.next_read_position - 1,
-                last_written_position=self.log.next_position - 1,
-                term=self.raft.term,
-            ),
+        meta = SnapshotMetadata(
+            last_processed_position=self.next_read_position - 1,
+            last_written_position=self.log.next_position - 1,
+            term=self.raft.term,
         )
+        self.snapshots.take(self.engine.snapshot_state(), meta)
+        # leader-side compaction below the snapshot (bounded by the
+        # engine's incident floor). Followers that fall below the new base
+        # catch up via snapshot replication + log fast-forward.
+        floor = min(
+            meta.last_processed_position + 1,
+            self.engine.compaction_floor(),
+        )
+        self.raft.actor.run(lambda: self.log.compact(floor))
 
     def close(self) -> None:
         self.raft.close()
@@ -735,6 +741,23 @@ class ClusterBroker(Actor):
             except stateser.SnapshotFormatError:
                 return
             server.snapshots.storage.write(meta, payload)
+            # snapshot catch-up ONLY when the leader told us we are below
+            # its compaction floor (the snapshot_needed probe): a merely
+            # lagging follower must keep receiving ordinary replication —
+            # fast-forwarding it would discard records the snapshot does
+            # not cover and mark them committed. The jump lands at the
+            # snapshot's PROCESSED boundary; the tail (processed..written]
+            # still exists on the leader (its floor never passes the
+            # processed position) and replicates normally.
+            if (
+                server.raft.snapshot_needed
+                and meta.last_processed_position >= server.log.next_position
+            ):
+                server.raft.actor.run(
+                    lambda: server.log.fast_forward(
+                        meta.last_processed_position + 1, term=meta.term
+                    )
+                )
         except Exception:  # noqa: BLE001 - next poll retries
             pass
 

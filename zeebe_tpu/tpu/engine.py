@@ -603,6 +603,13 @@ class TpuPartitionEngine:
     def check_message_ttls(self) -> List[Record]:
         return self._host.check_message_ttls()
 
+    def compaction_floor(self) -> int:
+        """See PartitionEngine.compaction_floor — incident state lives on
+        the embedded host oracle."""
+        return min(
+            self.last_processed_position + 1, self._host.compaction_floor()
+        )
+
     # -- snapshot / restore (reference StateSnapshotController: RocksDB
     # checkpoint keyed by last-processed position; here the SoA tables are
     # device_get into the data-only device envelope of log/stateser.py,
