@@ -1,0 +1,26 @@
+# zeebe_tpu broker image (reference: Dockerfile — openjdk:8-jre-alpine with
+# ports 26500-26504; here the runtime is Python+JAX and the port set is the
+# same logical five: gateway/client/management/replication/subscription).
+#
+# For TPU-backed partitions run on a TPU VM base image instead and install
+# the matching jax[tpu] wheel; the CPU image below serves the host-oracle
+# engine and all control-plane roles.
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/zeebe-tpu
+COPY zeebe_tpu/ zeebe_tpu/
+COPY dist/ dist/
+COPY gateway-protocol/ gateway-protocol/
+
+RUN pip install --no-cache-dir jax flax optax grpcio protobuf numpy
+
+# client API, management, replication, subscription, gateway
+EXPOSE 26500 26501 26502 26503 26504
+
+ENV ZEEBE_CFG=/opt/zeebe-tpu/dist/zeebe.cfg.toml
+ENTRYPOINT ["python", "-m", "zeebe_tpu"]
+CMD ["--config", "/opt/zeebe-tpu/dist/zeebe.cfg.toml"]

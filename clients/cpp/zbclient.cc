@@ -1,0 +1,613 @@
+// zbclient — C++ client for the broker's native client protocol.
+//
+// Reference parity: the reference ships a full Java client speaking the
+// broker's native wire protocol (SBE over NIO TCP,
+// gateway/.../ZeebeClient.java) plus a thin Go client over gRPC
+// (clients/go/client.go). This is the second-language native-protocol
+// client: length-prefixed transport frames (transport/transport.py
+// framing), msgpack request maps, and the fixed-layout record frame codec
+// (protocol/codec.py) — implemented from the wire contract, not bound to
+// the Python implementation.
+//
+// Ops: topology, deploy a BPMN resource, create a workflow instance,
+// run a job worker (subscribe, receive pushes, complete) — enough to run
+// the order process end to end:
+//
+//   zbclient <host> <port> run-order-process <process.bpmn>
+//
+// Build: make -C clients/cpp   (g++ -std=c++17, no dependencies)
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <variant>
+#include <vector>
+
+namespace zb {
+
+// ---------------------------------------------------------------------------
+// msgpack (the subset the wire uses: nil/bool/int/str/bin/array/map/double)
+// ---------------------------------------------------------------------------
+
+struct Value;
+using ValuePtr = std::shared_ptr<Value>;
+
+struct Value {
+  enum Kind { NIL, BOOL, INT, DBL, STR, BIN, ARR, MAP } kind = NIL;
+  bool b = false;
+  int64_t i = 0;
+  double d = 0;
+  std::string s;                       // STR and BIN
+  std::vector<ValuePtr> arr;
+  std::vector<std::pair<std::string, ValuePtr>> map;  // string keys only
+
+  const Value* get(const std::string& key) const {
+    for (const auto& kv : map)
+      if (kv.first == key) return kv.second.get();
+    return nullptr;
+  }
+};
+
+class Packer {
+ public:
+  std::string out;
+
+  void pack_nil() { out.push_back('\xc0'); }
+  void pack_bool(bool v) { out.push_back(v ? '\xc3' : '\xc2'); }
+
+  void pack_int(int64_t v) {
+    if (v >= 0 && v < 128) {
+      out.push_back(static_cast<char>(v));
+    } else if (v < 0 && v >= -32) {
+      out.push_back(static_cast<char>(v & 0xff));
+    } else {
+      out.push_back('\xd3');
+      be64(static_cast<uint64_t>(v));
+    }
+  }
+
+  void pack_double(double v) {
+    out.push_back('\xcb');
+    uint64_t bits;
+    std::memcpy(&bits, &v, 8);
+    be64(bits);
+  }
+
+  void pack_str(const std::string& v) {
+    size_t n = v.size();
+    if (n < 32) {
+      out.push_back(static_cast<char>(0xa0 | n));
+    } else if (n < 256) {
+      out.push_back('\xd9');
+      out.push_back(static_cast<char>(n));
+    } else {
+      out.push_back('\xda');
+      be16(static_cast<uint16_t>(n));
+    }
+    out += v;
+  }
+
+  void pack_bin(const std::string& v) {
+    size_t n = v.size();
+    if (n < 256) {
+      out.push_back('\xc4');
+      out.push_back(static_cast<char>(n));
+    } else if (n < 65536) {
+      out.push_back('\xc5');
+      be16(static_cast<uint16_t>(n));
+    } else {
+      out.push_back('\xc6');
+      be32(static_cast<uint32_t>(n));
+    }
+    out += v;
+  }
+
+  void pack_map_header(size_t n) {
+    if (n < 16) {
+      out.push_back(static_cast<char>(0x80 | n));
+    } else {
+      out.push_back('\xde');
+      be16(static_cast<uint16_t>(n));
+    }
+  }
+
+  void pack_array_header(size_t n) {
+    if (n < 16) {
+      out.push_back(static_cast<char>(0x90 | n));
+    } else {
+      out.push_back('\xdc');
+      be16(static_cast<uint16_t>(n));
+    }
+  }
+
+ private:
+  void be16(uint16_t v) {
+    out.push_back(static_cast<char>(v >> 8));
+    out.push_back(static_cast<char>(v & 0xff));
+  }
+  void be32(uint32_t v) {
+    for (int s = 24; s >= 0; s -= 8) out.push_back(static_cast<char>((v >> s) & 0xff));
+  }
+  void be64(uint64_t v) {
+    for (int s = 56; s >= 0; s -= 8) out.push_back(static_cast<char>((v >> s) & 0xff));
+  }
+};
+
+class Unpacker {
+ public:
+  Unpacker(const uint8_t* data, size_t len) : p_(data), end_(data + len) {}
+
+  ValuePtr unpack() {
+    auto v = std::make_shared<Value>();
+    uint8_t c = next();
+    if (c < 0x80) { v->kind = Value::INT; v->i = c; return v; }
+    if (c >= 0xe0) { v->kind = Value::INT; v->i = static_cast<int8_t>(c); return v; }
+    if ((c & 0xf0) == 0x80) { read_map(v, c & 0x0f); return v; }
+    if ((c & 0xf0) == 0x90) { read_array(v, c & 0x0f); return v; }
+    if ((c & 0xe0) == 0xa0) { v->kind = Value::STR; v->s = take(c & 0x1f); return v; }
+    switch (c) {
+      case 0xc0: v->kind = Value::NIL; return v;
+      case 0xc2: v->kind = Value::BOOL; v->b = false; return v;
+      case 0xc3: v->kind = Value::BOOL; v->b = true; return v;
+      case 0xc4: v->kind = Value::BIN; v->s = take(u8()); return v;
+      case 0xc5: v->kind = Value::BIN; v->s = take(u16()); return v;
+      case 0xc6: v->kind = Value::BIN; v->s = take(u32()); return v;
+      case 0xca: { v->kind = Value::DBL; uint32_t b = u32(); float f;
+                   std::memcpy(&f, &b, 4); v->d = f; return v; }
+      case 0xcb: { v->kind = Value::DBL; uint64_t b = u64(); std::memcpy(&v->d, &b, 8); return v; }
+      case 0xcc: v->kind = Value::INT; v->i = u8(); return v;
+      case 0xcd: v->kind = Value::INT; v->i = u16(); return v;
+      case 0xce: v->kind = Value::INT; v->i = u32(); return v;
+      case 0xcf: v->kind = Value::INT; v->i = static_cast<int64_t>(u64()); return v;
+      case 0xd0: v->kind = Value::INT; v->i = static_cast<int8_t>(u8()); return v;
+      case 0xd1: v->kind = Value::INT; v->i = static_cast<int16_t>(u16()); return v;
+      case 0xd2: v->kind = Value::INT; v->i = static_cast<int32_t>(u32()); return v;
+      case 0xd3: v->kind = Value::INT; v->i = static_cast<int64_t>(u64()); return v;
+      case 0xd9: v->kind = Value::STR; v->s = take(u8()); return v;
+      case 0xda: v->kind = Value::STR; v->s = take(u16()); return v;
+      case 0xdb: v->kind = Value::STR; v->s = take(u32()); return v;
+      case 0xdc: read_array(v, u16()); return v;
+      case 0xdd: read_array(v, u32()); return v;
+      case 0xde: read_map(v, u16()); return v;
+      case 0xdf: read_map(v, u32()); return v;
+      default: throw std::runtime_error("msgpack: unsupported tag");
+    }
+  }
+
+ private:
+  const uint8_t* p_;
+  const uint8_t* end_;
+
+  uint8_t next() { if (p_ >= end_) throw std::runtime_error("msgpack: eof"); return *p_++; }
+  uint8_t u8() { return next(); }
+  uint16_t u16() { uint16_t v = 0; for (int i = 0; i < 2; i++) v = (v << 8) | next(); return v; }
+  uint32_t u32() { uint32_t v = 0; for (int i = 0; i < 4; i++) v = (v << 8) | next(); return v; }
+  uint64_t u64() { uint64_t v = 0; for (int i = 0; i < 8; i++) v = (v << 8) | next(); return v; }
+  std::string take(size_t n) {
+    if (p_ + n > end_) throw std::runtime_error("msgpack: eof in payload");
+    std::string s(reinterpret_cast<const char*>(p_), n);
+    p_ += n;
+    return s;
+  }
+  void read_array(ValuePtr& v, size_t n) {
+    v->kind = Value::ARR;
+    for (size_t i = 0; i < n; i++) v->arr.push_back(unpack());
+  }
+  void read_map(ValuePtr& v, size_t n) {
+    v->kind = Value::MAP;
+    for (size_t i = 0; i < n; i++) {
+      auto key = unpack();
+      v->map.emplace_back(key->s, unpack());
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// record frame codec (protocol/codec.py layout, little-endian, crc32)
+// ---------------------------------------------------------------------------
+
+constexpr int kHeaderSize = 72;
+constexpr int kAlign = 8;
+
+// crc32 (zlib polynomial)
+uint32_t crc32(const uint8_t* data, size_t len) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xedb88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t c = 0xffffffffu;
+  for (size_t i = 0; i < len; i++) c = table[(c ^ data[i]) & 0xff] ^ (c >> 8);
+  return c ^ 0xffffffffu;
+}
+
+struct RecordHeader {
+  int64_t position = -1, source_position = -1, key = -1, timestamp = -1;
+  int32_t producer_id = -1, raft_term = 0;
+  int64_t request_id = -1;
+  int32_t request_stream_id = -1;
+  int64_t incident_key = -1;
+  uint8_t record_type = 0, value_type = 0, intent = 0, rejection_type = 255;
+  std::string rejection_reason;
+  std::string value;  // msgpack document
+};
+
+void put_le(std::string& buf, size_t off, const void* src, size_t n) {
+  std::memcpy(&buf[off], src, n);  // x86-64: already little-endian
+}
+
+std::string encode_record(const RecordHeader& r) {
+  size_t body = kHeaderSize + 4 + r.rejection_reason.size() + 4 + r.value.size();
+  size_t frame = (body + kAlign - 1) / kAlign * kAlign;
+  std::string buf(frame, '\0');
+  int32_t flen = static_cast<int32_t>(frame);
+  size_t o = 0;
+  put_le(buf, o, &flen, 4); o += 4;
+  o += 4;  // crc placeholder
+  put_le(buf, o, &r.position, 8); o += 8;
+  put_le(buf, o, &r.source_position, 8); o += 8;
+  put_le(buf, o, &r.key, 8); o += 8;
+  put_le(buf, o, &r.timestamp, 8); o += 8;
+  put_le(buf, o, &r.producer_id, 4); o += 4;
+  put_le(buf, o, &r.raft_term, 4); o += 4;
+  put_le(buf, o, &r.request_id, 8); o += 8;
+  put_le(buf, o, &r.request_stream_id, 4); o += 4;
+  put_le(buf, o, &r.incident_key, 8); o += 8;
+  buf[o++] = static_cast<char>(r.record_type);
+  buf[o++] = static_cast<char>(r.value_type);
+  buf[o++] = static_cast<char>(r.intent);
+  buf[o++] = static_cast<char>(r.rejection_type);
+  uint32_t rl = static_cast<uint32_t>(r.rejection_reason.size());
+  put_le(buf, o, &rl, 4); o += 4;
+  std::memcpy(&buf[o], r.rejection_reason.data(), rl); o += rl;
+  uint32_t vl = static_cast<uint32_t>(r.value.size());
+  put_le(buf, o, &vl, 4); o += 4;
+  std::memcpy(&buf[o], r.value.data(), vl);
+  uint32_t crc = crc32(reinterpret_cast<const uint8_t*>(buf.data()) + 8, frame - 8);
+  put_le(buf, 4, &crc, 4);
+  return buf;
+}
+
+RecordHeader decode_record(const std::string& frame) {
+  RecordHeader r;
+  auto rd = [&](size_t off, void* dst, size_t n) { std::memcpy(dst, &frame[off], n); };
+  int32_t flen;
+  rd(0, &flen, 4);
+  uint32_t crc;
+  rd(4, &crc, 4);
+  if (crc32(reinterpret_cast<const uint8_t*>(frame.data()) + 8, flen - 8) != crc)
+    throw std::runtime_error("record frame crc mismatch");
+  size_t o = 8;
+  rd(o, &r.position, 8); o += 8;
+  rd(o, &r.source_position, 8); o += 8;
+  rd(o, &r.key, 8); o += 8;
+  rd(o, &r.timestamp, 8); o += 8;
+  rd(o, &r.producer_id, 4); o += 4;
+  rd(o, &r.raft_term, 4); o += 4;
+  rd(o, &r.request_id, 8); o += 8;
+  rd(o, &r.request_stream_id, 4); o += 4;
+  rd(o, &r.incident_key, 8); o += 8;
+  r.record_type = frame[o++]; r.value_type = frame[o++];
+  r.intent = frame[o++]; r.rejection_type = frame[o++];
+  uint32_t rl; rd(o, &rl, 4); o += 4;
+  r.rejection_reason = frame.substr(o, rl); o += rl;
+  uint32_t vl; rd(o, &vl, 4); o += 4;
+  r.value = frame.substr(o, vl);
+  return r;
+}
+
+// protocol enums (protocol/enums.py + intents.py)
+enum RecordType { EVENT = 0, COMMAND = 1, COMMAND_REJECTION = 2 };
+enum ValueTypeId { VT_JOB = 0, VT_DEPLOYMENT = 4, VT_WORKFLOW_INSTANCE = 5 };
+enum WorkflowInstanceIntent { WI_CREATE = 0 };
+enum DeploymentIntent { DEPLOY_CREATE = 0 };
+enum JobIntentId { JOB_COMPLETE = 4 };
+
+// ---------------------------------------------------------------------------
+// transport: u32 len | u8 type | u64 correlation id | payload
+// ---------------------------------------------------------------------------
+
+constexpr uint8_t FRAME_REQUEST = 1, FRAME_RESPONSE = 2, FRAME_MESSAGE = 3;
+
+class Connection {
+ public:
+  Connection(const std::string& host, int port) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) throw std::runtime_error("socket() failed");
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+      throw std::runtime_error("bad host " + host);
+    if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      throw std::runtime_error("connect to " + host + " failed");
+    int one = 1;
+    ::setsockopt(fd_, IPPROTO_TCP, 1 /*TCP_NODELAY*/, &one, sizeof(one));
+  }
+  ~Connection() { if (fd_ >= 0) ::close(fd_); }
+
+  // send a REQUEST, wait for the matching RESPONSE; MESSAGE frames seen in
+  // between are queued for the worker loop
+  ValuePtr request(const std::string& payload, int timeout_s = 15) {
+    uint64_t cid = ++correlation_;
+    send_frame(FRAME_REQUEST, cid, payload);
+    for (;;) {
+      Frame f = read_frame(timeout_s);
+      if (f.type == FRAME_RESPONSE && f.cid == cid) {
+        Unpacker u(reinterpret_cast<const uint8_t*>(f.payload.data()), f.payload.size());
+        return u.unpack();
+      }
+      if (f.type == FRAME_MESSAGE) pushes.push_back(f.payload);
+    }
+  }
+
+  // wait for the next MESSAGE frame (drains the queue first)
+  std::string next_message(int timeout_s = 15) {
+    if (!pushes.empty()) {
+      std::string m = pushes.front();
+      pushes.erase(pushes.begin());
+      return m;
+    }
+    for (;;) {
+      Frame f = read_frame(timeout_s);
+      if (f.type == FRAME_MESSAGE) return f.payload;
+    }
+  }
+
+  std::vector<std::string> pushes;
+
+ private:
+  struct Frame { uint8_t type; uint64_t cid; std::string payload; };
+
+  void send_frame(uint8_t type, uint64_t cid, const std::string& payload) {
+    uint32_t len = static_cast<uint32_t>(payload.size() + 9);
+    std::string buf(13, '\0');
+    std::memcpy(&buf[0], &len, 4);
+    buf[4] = static_cast<char>(type);
+    std::memcpy(&buf[5], &cid, 8);
+    buf += payload;
+    size_t off = 0;
+    while (off < buf.size()) {
+      ssize_t n = ::send(fd_, buf.data() + off, buf.size() - off, 0);
+      if (n <= 0) throw std::runtime_error("send failed");
+      off += static_cast<size_t>(n);
+    }
+  }
+
+  Frame read_frame(int timeout_s) {
+    timeval tv{timeout_s, 0};
+    ::setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    std::string hdr = read_exact(13);
+    uint32_t len;
+    std::memcpy(&len, hdr.data(), 4);
+    Frame f;
+    f.type = static_cast<uint8_t>(hdr[4]);
+    std::memcpy(&f.cid, hdr.data() + 5, 8);
+    f.payload = read_exact(len - 9);
+    return f;
+  }
+
+  std::string read_exact(size_t n) {
+    std::string buf(n, '\0');
+    size_t off = 0;
+    while (off < n) {
+      ssize_t got = ::recv(fd_, &buf[off], n - off, 0);
+      if (got <= 0) throw std::runtime_error("recv failed/timeout");
+      off += static_cast<size_t>(got);
+    }
+    return buf;
+  }
+
+  int fd_ = -1;
+  uint64_t correlation_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+// client ops
+// ---------------------------------------------------------------------------
+
+std::string command_request(int partition, const RecordHeader& record) {
+  Packer p;
+  p.pack_map_header(3);
+  p.pack_str("t"); p.pack_str("command");
+  p.pack_str("partition"); p.pack_int(partition);
+  p.pack_str("frame"); p.pack_bin(encode_record(record));
+  return p.out;
+}
+
+RecordHeader expect_command_rsp(const ValuePtr& rsp) {
+  const Value* t = rsp->get("t");
+  if (!t || t->s != "command-rsp")
+    throw std::runtime_error("unexpected response (not command-rsp)");
+  RecordHeader r = decode_record(rsp->get("frame")->s);
+  if (r.record_type == COMMAND_REJECTION)
+    throw std::runtime_error("rejected: " + r.rejection_reason);
+  return r;
+}
+
+int64_t deploy(Connection& conn, const std::string& bpmn_xml, const std::string& name) {
+  Packer value;
+  value.pack_map_header(2);
+  value.pack_str("topicName"); value.pack_str("");
+  value.pack_str("resources");
+  value.pack_array_header(1);
+  value.pack_map_header(3);
+  value.pack_str("resource"); value.pack_bin(bpmn_xml);
+  value.pack_str("resourceType"); value.pack_str("BPMN_XML");
+  value.pack_str("resourceName"); value.pack_str(name);
+
+  RecordHeader cmd;
+  cmd.record_type = COMMAND;
+  cmd.value_type = VT_DEPLOYMENT;
+  cmd.intent = DEPLOY_CREATE;
+  cmd.value = value.out;
+  RecordHeader rsp = expect_command_rsp(conn.request(command_request(0, cmd)));
+  return rsp.key;
+}
+
+int64_t create_instance(Connection& conn, const std::string& process_id,
+                        int64_t order_id) {
+  Packer value;
+  value.pack_map_header(2);
+  value.pack_str("bpmnProcessId"); value.pack_str(process_id);
+  value.pack_str("payload");
+  value.pack_map_header(1);
+  value.pack_str("orderId"); value.pack_int(order_id);
+
+  RecordHeader cmd;
+  cmd.record_type = COMMAND;
+  cmd.value_type = VT_WORKFLOW_INSTANCE;
+  cmd.intent = WI_CREATE;
+  cmd.value = value.out;
+  RecordHeader rsp = expect_command_rsp(conn.request(command_request(0, cmd)));
+  Unpacker u(reinterpret_cast<const uint8_t*>(rsp.value.data()), rsp.value.size());
+  auto doc = u.unpack();
+  const Value* key = doc->get("workflowInstanceKey");
+  return key ? key->i : rsp.key;
+}
+
+void subscribe_jobs(Connection& conn, const std::string& job_type, int64_t sub_key) {
+  Packer p;
+  p.pack_map_header(8);
+  p.pack_str("t"); p.pack_str("job-subscription");
+  p.pack_str("action"); p.pack_str("add");
+  p.pack_str("partition"); p.pack_int(0);
+  p.pack_str("subscriber_key"); p.pack_int(sub_key);
+  p.pack_str("job_type"); p.pack_str(job_type);
+  p.pack_str("worker"); p.pack_str("zbclient-cpp");
+  p.pack_str("credits"); p.pack_int(8);
+  p.pack_str("timeout"); p.pack_int(300000);
+  auto rsp = conn.request(p.out);
+  const Value* t = rsp->get("t");
+  if (!t || t->s != "ok") throw std::runtime_error("job subscription failed");
+}
+
+void complete_job(Connection& conn, int64_t job_key) {
+  Packer value;
+  value.pack_map_header(1);
+  value.pack_str("payload");
+  value.pack_map_header(1);
+  value.pack_str("paid"); value.pack_bool(true);
+
+  RecordHeader cmd;
+  cmd.record_type = COMMAND;
+  cmd.value_type = VT_JOB;
+  cmd.intent = JOB_COMPLETE;
+  cmd.key = job_key;
+  cmd.value = value.out;
+  expect_command_rsp(conn.request(command_request(0, cmd)));
+}
+
+int run_order_process(const std::string& host, int port, const std::string& bpmn_path) {
+  std::ifstream f(bpmn_path, std::ios::binary);
+  if (!f) { std::cerr << "cannot read " << bpmn_path << "\n"; return 2; }
+  std::stringstream ss;
+  ss << f.rdbuf();
+
+  Connection conn(host, port);
+
+  int64_t deployment_key = deploy(conn, ss.str(), "order-process.bpmn");
+  std::cout << "deployed key=" << deployment_key << std::endl;
+
+  subscribe_jobs(conn, "payment-service", 424242);
+
+  int64_t instance_key = create_instance(conn, "order-process", 31243);
+  std::cout << "instance key=" << instance_key << std::endl;
+
+  // worker loop: the broker pushes the activated job down this connection
+  for (;;) {
+    std::string payload = conn.next_message(20);
+    Unpacker u(reinterpret_cast<const uint8_t*>(payload.data()), payload.size());
+    auto msg = u.unpack();
+    const Value* t = msg->get("t");
+    if (!t || t->s != "pushed-record") continue;
+    RecordHeader job = decode_record(msg->get("frame")->s);
+    std::cout << "job pushed key=" << job.key << std::endl;
+    complete_job(conn, job.key);
+    std::cout << "job completed" << std::endl;
+    break;
+  }
+  std::cout << "ORDER-PROCESS-OK" << std::endl;
+  return 0;
+}
+
+int topology(const std::string& host, int port) {
+  Connection conn(host, port);
+  Packer p;
+  p.pack_map_header(1);
+  p.pack_str("t"); p.pack_str("topology");
+  auto rsp = conn.request(p.out);
+  const Value* t = rsp->get("t");
+  if (!t || t->s != "topology-rsp") { std::cerr << "no topology" << std::endl; return 2; }
+  const Value* leaders = rsp->get("leaders");
+  if (!leaders) { std::cerr << "no topology" << std::endl; return 2; }
+  for (const auto& kv : leaders->map) {
+    const Value* entry = kv.second.get();
+    std::cout << "partition " << kv.first;
+    const Value* addr = entry->get("addr");
+    if (addr && addr->kind == Value::ARR && addr->arr.size() >= 2)
+      std::cout << " leader " << addr->arr[0]->s << ":" << addr->arr[1]->i;
+    std::cout << std::endl;
+  }
+  return 0;
+}
+
+}  // namespace zb
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::cerr << "usage: zbclient <host> <port> topology\n"
+              << "       zbclient <host> <port> run-order-process <process.bpmn>\n";
+    return 2;
+  }
+  std::string host = argv[1];
+  int port = std::atoi(argv[2]);
+  std::string op = argv[3];
+  try {
+    if (op == "encode-demo") {
+      // test hook: emit the deploy command request payload for wire-level
+      // verification against the Python codec
+      zb::Packer value;
+      value.pack_map_header(2);
+      value.pack_str("topicName"); value.pack_str("");
+      value.pack_str("resources");
+      value.pack_array_header(1);
+      value.pack_map_header(3);
+      value.pack_str("resource"); value.pack_bin("<xml/>");
+      value.pack_str("resourceType"); value.pack_str("BPMN_XML");
+      value.pack_str("resourceName"); value.pack_str("demo.bpmn");
+      zb::RecordHeader cmd;
+      cmd.record_type = zb::COMMAND;
+      cmd.value_type = zb::VT_DEPLOYMENT;
+      cmd.intent = zb::DEPLOY_CREATE;
+      cmd.value = value.out;
+      std::string req = zb::command_request(0, cmd);
+      fwrite(req.data(), 1, req.size(), stdout);
+      return 0;
+    }
+    if (op == "topology") return zb::topology(host, port);
+    if (op == "run-order-process" && argc >= 5)
+      return zb::run_order_process(host, port, argv[4]);
+  } catch (const std::exception& e) {
+    std::cerr << "error: " << e.what() << std::endl;
+    return 1;
+  }
+  std::cerr << "unknown op " << op << std::endl;
+  return 2;
+}

@@ -8,6 +8,8 @@ import pytest
 
 from zeebe_tpu.gateway.cluster_client import ClusterClient
 from zeebe_tpu.gateway.grpc_gateway import GrpcGateway, GrpcGatewayClient
+from zeebe_tpu.gateway.proto import gateway_pb2 as pb
+from zeebe_tpu.protocol import msgpack
 from zeebe_tpu.models.bpmn.builder import Bpmn
 from zeebe_tpu.models.bpmn.xml import write_model
 from zeebe_tpu.runtime.cluster_broker import ClusterBroker
@@ -59,21 +61,27 @@ class TestGrpcGateway:
     def test_health_check_reports_topology(self, gateway):
         stub, broker = gateway
         health = stub.health_check()
-        assert health["brokers"], health
-        assert health["brokers"][0]["partition"] == 0
-        assert health["brokers"][0]["port"] == broker.client_address.port
+        assert health.brokers, health
+        assert health.brokers[0].partition_id == 0
+        assert health.brokers[0].port == broker.client_address.port
 
     def test_deploy_and_run_instance_over_grpc(self, gateway):
         stub, broker = gateway
-        deployed = stub.call("DeployWorkflow", {"resource": order_process_bytes()})
-        assert deployed["workflows"][0]["bpmn_process_id"] == "order-process"
+        deployed = stub.call(
+            "DeployWorkflow",
+            pb.DeployWorkflowRequest(resource=order_process_bytes()),
+        )
+        assert deployed.workflows[0].bpmn_process_id == "order-process"
 
         created = stub.call(
             "CreateWorkflowInstance",
-            {"bpmn_process_id": "order-process", "payload": {"orderId": 7},
-             "partition_id": 0},
+            pb.CreateWorkflowInstanceRequest(
+                bpmn_process_id="order-process",
+                payload_msgpack=msgpack.pack({"orderId": 7}),
+                partition_id=0,
+            ),
         )
-        instance_key = created["workflow_instance_key"]
+        instance_key = created.workflow_instance_key
         assert instance_key > 0
 
         # the job exists on the broker; complete it over gRPC
@@ -96,8 +104,13 @@ class TestGrpcGateway:
             and engine.jobs[job_key].state == 3,  # ACTIVATED
             10,
         )
-        stub.call("CompleteJob", {"partition_id": 0, "job_key": job_key,
-                                  "payload": {"paid": True}})
+        stub.call(
+            "CompleteJob",
+            pb.CompleteJobRequest(
+                partition_id=0, job_key=job_key,
+                payload_msgpack=msgpack.pack({"paid": True}),
+            ),
+        )
         assert wait_until(
             lambda: engine.element_instances.get(instance_key) is None, 10
         ), "instance must complete after the job is done"
@@ -107,8 +120,12 @@ class TestGrpcGateway:
 
         stub, _broker = gateway
         with pytest.raises(grpc.RpcError) as err:
-            stub.call("CreateWorkflowInstance", {"bpmn_process_id": "no-such",
-                                                 "partition_id": 0})
+            stub.call(
+                "CreateWorkflowInstance",
+                pb.CreateWorkflowInstanceRequest(
+                    bpmn_process_id="no-such", partition_id=0
+                ),
+            )
         assert err.value.code() in (
             grpc.StatusCode.FAILED_PRECONDITION, grpc.StatusCode.INTERNAL,
         )

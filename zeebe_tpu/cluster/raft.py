@@ -126,6 +126,7 @@ class Raft(Actor):
         # single-step membership applies ON APPEND, so removing the entry
         # from the log must revert to the previous configuration
         self._config_log: List[tuple] = []
+        self._self_removal_position: Optional[int] = None
         self._state_listeners: List[Callable[[RaftState, int], None]] = []
         self._stopped = False
 
@@ -220,6 +221,8 @@ class Raft(Actor):
             self.log.flush()
             self._config_log.append((last, dict(self.persistent.members)))
             self._apply_config(new_members)
+            if self.node_id not in new_members:
+                self._self_removal_position = last
             self.match_position[self.node_id] = last
             self._maybe_commit()
             self._replicate_all()
@@ -240,9 +243,12 @@ class Raft(Actor):
                 if mid not in self.persistent.members:
                     self.next_position.pop(mid, None)
                     self.match_position.pop(mid, None)
-            if self.node_id not in self.persistent.members:
-                # removed self: step aside (the remaining members elect)
-                self._become(RaftState.FOLLOWER)
+            # a leader removing ITSELF keeps leading until the removal
+            # entry COMMITS (dissertation §4.2.2: it manages the cluster
+            # through the transition, not counting itself toward quorum —
+            # _maybe_commit already iterates only current members), then
+            # steps aside. Stepping down immediately would orphan the
+            # un-replicated entry.
 
     def _maybe_apply_config(self, record) -> None:
         from zeebe_tpu.protocol.enums import ValueType
@@ -536,6 +542,13 @@ class Raft(Actor):
         if self.log.term_at(candidate) != self.persistent.term:
             return
         self.log.set_commit_position(candidate)
+        if (
+            self._self_removal_position is not None
+            and candidate >= self._self_removal_position
+        ):
+            # our own removal is committed: step aside now
+            self._self_removal_position = None
+            self._become(RaftState.FOLLOWER)
 
     # -- request handling (IO thread → actor hop) ---------------------------
     def _ask(self, addr: RemoteAddress, payload: bytes, callback) -> None:

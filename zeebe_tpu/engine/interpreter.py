@@ -352,6 +352,12 @@ class PartitionEngine:
                 floor = min(floor, incident.failure_event_position)
             if incident.incident_event_position >= 0:
                 floor = min(floor, incident.incident_event_position)
+        # durable topic subscriptions resume from their logged acks — the
+        # records past a subscriber's ack must survive compaction or the
+        # subscriber silently loses them (reference: segment deletion is
+        # bounded by exporter/subscriber positions)
+        for acked in self.topic_sub_acks.values():
+            floor = min(floor, acked + 1)
         return floor
 
     def snapshot_state(self) -> dict:

@@ -1,62 +1,70 @@
 """gRPC gateway: the cluster's front door for polyglot clients.
 
 Reference parity: ``gateway/.../Gateway.java`` (netty gRPC server embedded
-in the broker or standalone) + ``gateway-protocol/src/main/proto/
-gateway.proto:30-33`` — the reference tech-preview exposes ``Health``
-(topology); this gateway keeps that RPC and extends the service with the
-command surface the reference serves over its SBE client protocol
-(``EndpointManager`` / ``ResponseMapper`` would map them onto proto once a
-codegen toolchain is present; payloads here are msgpack maps over raw gRPC
-bytes since ``grpc_tools``/protoc codegen is not available in-image).
-
-Service: ``gateway_protocol.Gateway`` with unary RPCs
-HealthCheck, CreateTopic, DeployWorkflow, CreateWorkflowInstance,
-CancelWorkflowInstance, PublishMessage, CompleteJob, FailJob,
-UpdateJobRetries.
+in the broker or standalone) + the published schema
+``gateway-protocol/gateway.proto`` (reference:
+``gateway-protocol/src/main/proto/gateway.proto:30-33`` — the tech-preview
+exposes ``Health``; this service keeps that RPC and adds the command
+surface the reference serves over its SBE client protocol, typed with
+protobuf messages so any language with a gRPC stack can generate a
+client). Payload documents travel as msgpack bytes inside the proto
+messages — record values are msgpack documents end to end, forwarded
+opaquely like ``ClientApiMessageHandler`` does.
 """
 
 from __future__ import annotations
 
-import threading
 from concurrent import futures
-from typing import Any, Dict, Optional
 
 import grpc
 
 from zeebe_tpu.gateway.client import ClientException
+from zeebe_tpu.gateway.proto import gateway_pb2 as pb
 from zeebe_tpu.models.bpmn.xml import read_model
 from zeebe_tpu.protocol import msgpack
 
 _SERVICE = "gateway_protocol.Gateway"
 
 
-def _ident(b: bytes) -> bytes:
-    return b
+def _payload(msg_bytes: bytes) -> dict:
+    if not msg_bytes:
+        return {}
+    doc = msgpack.unpack(bytes(msg_bytes))
+    if not isinstance(doc, dict):
+        raise ValueError("payload document must be a msgpack map")
+    return doc
 
 
 class GrpcGateway:
-    """gRPC server bridging to a cluster (or in-process) client."""
+    """gRPC server bridging to a cluster (or in-process) client, speaking
+    the published gateway.proto."""
 
     def __init__(self, client, host: str = "127.0.0.1", port: int = 0,
                  max_workers: int = 8):
         self.client = client
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
         rpcs = {
-            "HealthCheck": self._health_check,
-            "CreateTopic": self._create_topic,
-            "DeployWorkflow": self._deploy_workflow,
-            "CreateWorkflowInstance": self._create_workflow_instance,
-            "CancelWorkflowInstance": self._cancel_workflow_instance,
-            "PublishMessage": self._publish_message,
-            "CompleteJob": self._complete_job,
-            "FailJob": self._fail_job,
-            "UpdateJobRetries": self._update_job_retries,
+            "HealthCheck": (self._health_check, pb.HealthCheckRequest),
+            "CreateTopic": (self._create_topic, pb.CreateTopicRequest),
+            "DeployWorkflow": (self._deploy_workflow, pb.DeployWorkflowRequest),
+            "CreateWorkflowInstance": (
+                self._create_workflow_instance, pb.CreateWorkflowInstanceRequest
+            ),
+            "CancelWorkflowInstance": (
+                self._cancel_workflow_instance, pb.CancelWorkflowInstanceRequest
+            ),
+            "PublishMessage": (self._publish_message, pb.PublishMessageRequest),
+            "CompleteJob": (self._complete_job, pb.CompleteJobRequest),
+            "FailJob": (self._fail_job, pb.FailJobRequest),
+            "UpdateJobRetries": (self._update_job_retries, pb.UpdateJobRetriesRequest),
         }
         handlers = {
             name: grpc.unary_unary_rpc_method_handler(
-                self._wrap(fn), request_deserializer=_ident, response_serializer=_ident
+                self._wrap(fn),
+                request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
             )
-            for name, fn in rpcs.items()
+            for name, (fn, req_cls) in rpcs.items()
         }
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
@@ -66,126 +74,144 @@ class GrpcGateway:
         self._server.start()
 
     def _wrap(self, fn):
-        def call(request: bytes, context: grpc.ServicerContext) -> bytes:
+        def call(request, context: grpc.ServicerContext):
             try:
-                msg = msgpack.unpack(request) if request else {}
-                return msgpack.pack(fn(msg))
+                return fn(request)
             except ClientException as e:
                 context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+            except ValueError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
             except Exception as e:  # noqa: BLE001
                 context.abort(grpc.StatusCode.INTERNAL, str(e))
 
         return call
 
     # -- RPC implementations ------------------------------------------------
-    def _health_check(self, msg: dict) -> dict:
+    def _health_check(self, _req) -> pb.HealthCheckResponse:
         # reference gateway.proto HealthCheck → topology (brokers/partitions)
         leaders = self.client.refresh_topology()
-        return {
-            "brokers": [
-                {"partition": pid, "host": addr.host, "port": addr.port}
+        return pb.HealthCheckResponse(
+            brokers=[
+                pb.Partition(partition_id=pid, host=addr.host, port=addr.port)
                 for pid, addr in sorted(leaders.items())
             ]
-        }
+        )
 
-    def _create_topic(self, msg: dict) -> dict:
+    def _create_topic(self, req) -> pb.CreateTopicResponse:
         record = self.client.create_topic(
-            str(msg["name"]),
-            partitions=int(msg.get("partitions", 1)),
-            replication_factor=int(msg.get("replication_factor", 1)),
+            req.name,
+            partitions=req.partitions or 1,
+            replication_factor=req.replication_factor or 1,
         )
-        return {"name": record.value.name, "partition_ids": record.value.partition_ids}
+        return pb.CreateTopicResponse(
+            name=record.value.name,
+            partition_ids=list(record.value.partition_ids),
+        )
 
-    def _deploy_workflow(self, msg: dict) -> dict:
-        model = read_model(bytes(msg["resource"]))
+    def _deploy_workflow(self, req) -> pb.DeployWorkflowResponse:
+        model = read_model(bytes(req.resource))
         record = self.client.deploy_model(
-            model, resource_name=str(msg.get("resource_name", "process.bpmn"))
+            model, resource_name=req.resource_name or "process.bpmn"
         )
-        return {
-            "key": record.key,
-            "workflows": [
-                {
-                    "bpmn_process_id": wf.bpmn_process_id,
-                    "version": wf.version,
-                    "workflow_key": wf.key,
-                }
+        return pb.DeployWorkflowResponse(
+            key=record.key,
+            workflows=[
+                pb.WorkflowMetadata(
+                    bpmn_process_id=wf.bpmn_process_id,
+                    version=wf.version,
+                    workflow_key=wf.key,
+                )
                 for wf in record.value.deployed_workflows
             ],
-        }
+        )
 
-    def _create_workflow_instance(self, msg: dict) -> dict:
+    def _create_workflow_instance(self, req) -> pb.CreateWorkflowInstanceResponse:
         record = self.client.create_instance(
-            str(msg["bpmn_process_id"]),
-            payload=dict(msg.get("payload", {})),
-            partition_id=msg.get("partition_id"),
+            req.bpmn_process_id,
+            payload=_payload(req.payload_msgpack),
+            partition_id=req.partition_id if req.partition_id >= 0 else None,
         )
-        return {
-            "workflow_instance_key": record.value.workflow_instance_key,
-            "bpmn_process_id": record.value.bpmn_process_id,
-            "version": record.value.version,
-        }
-
-    def _cancel_workflow_instance(self, msg: dict) -> dict:
-        self.client.cancel_instance(
-            int(msg.get("partition_id", 0)), int(msg["workflow_instance_key"])
+        return pb.CreateWorkflowInstanceResponse(
+            workflow_instance_key=record.value.workflow_instance_key,
+            bpmn_process_id=record.value.bpmn_process_id,
+            version=record.value.version,
         )
-        return {}
 
-    def _publish_message(self, msg: dict) -> dict:
+    def _cancel_workflow_instance(self, req) -> pb.CancelWorkflowInstanceResponse:
+        self.client.cancel_instance(req.partition_id, req.workflow_instance_key)
+        return pb.CancelWorkflowInstanceResponse()
+
+    def _publish_message(self, req) -> pb.PublishMessageResponse:
         self.client.publish_message(
-            str(msg["name"]),
-            str(msg["correlation_key"]),
-            payload=dict(msg.get("payload", {})),
-            time_to_live_ms=int(msg.get("time_to_live_ms", 0)),
+            req.name,
+            req.correlation_key,
+            payload=_payload(req.payload_msgpack),
+            time_to_live_ms=req.time_to_live_ms,
         )
-        return {}
+        return pb.PublishMessageResponse()
 
-    def _complete_job(self, msg: dict) -> dict:
+    def _complete_job(self, req) -> pb.CompleteJobResponse:
         self.client.complete_job(
-            int(msg.get("partition_id", 0)), int(msg["job_key"]),
-            dict(msg.get("payload", {})),
+            req.partition_id, req.job_key, _payload(req.payload_msgpack)
         )
-        return {}
+        return pb.CompleteJobResponse()
 
-    def _fail_job(self, msg: dict) -> dict:
-        self.client.fail_job(
-            int(msg.get("partition_id", 0)), int(msg["job_key"]),
-            int(msg.get("retries", 0)),
-        )
-        return {}
+    def _fail_job(self, req) -> pb.FailJobResponse:
+        self.client.fail_job(req.partition_id, req.job_key, req.retries)
+        return pb.FailJobResponse()
 
-    def _update_job_retries(self, msg: dict) -> dict:
+    def _update_job_retries(self, req) -> pb.UpdateJobRetriesResponse:
+        # retries passes through unmodified: the engine rejects
+        # non-positive values (RETRIES_NOT_POSITIVE), same as the native
+        # protocol — proto3 cannot distinguish unset from 0, so the proto
+        # documents retries >= 1
         self.client.update_job_retries(
-            int(msg.get("partition_id", 0)), int(msg["job_key"]),
-            int(msg.get("retries", 1)),
+            req.partition_id, req.job_key, req.retries
         )
-        return {}
+        return pb.UpdateJobRetriesResponse()
 
     def close(self) -> None:
         self._server.stop(grace=1)
 
 
 class GrpcGatewayClient:
-    """Minimal polyglot-style client over the gateway (reference
+    """Typed client over the published proto (reference
     ``clients/go/client.go``: gRPC dial + HealthCheck; any language with a
-    gRPC stack can speak this protocol)."""
+    gRPC stack generates the same surface from gateway-protocol/gateway.proto)."""
+
+    _REQUESTS = {
+        "HealthCheck": (pb.HealthCheckRequest, pb.HealthCheckResponse),
+        "CreateTopic": (pb.CreateTopicRequest, pb.CreateTopicResponse),
+        "DeployWorkflow": (pb.DeployWorkflowRequest, pb.DeployWorkflowResponse),
+        "CreateWorkflowInstance": (
+            pb.CreateWorkflowInstanceRequest, pb.CreateWorkflowInstanceResponse
+        ),
+        "CancelWorkflowInstance": (
+            pb.CancelWorkflowInstanceRequest, pb.CancelWorkflowInstanceResponse
+        ),
+        "PublishMessage": (pb.PublishMessageRequest, pb.PublishMessageResponse),
+        "CompleteJob": (pb.CompleteJobRequest, pb.CompleteJobResponse),
+        "FailJob": (pb.FailJobRequest, pb.FailJobResponse),
+        "UpdateJobRetries": (pb.UpdateJobRetriesRequest, pb.UpdateJobRetriesResponse),
+    }
 
     def __init__(self, host: str, port: int):
         self._channel = grpc.insecure_channel(f"{host}:{port}")
-        self._calls: Dict[str, Any] = {}
+        self._calls = {}
 
-    def call(self, method: str, body: Optional[dict] = None, timeout: float = 15.0) -> dict:
+    def call(self, method: str, request=None, timeout: float = 15.0):
+        req_cls, rsp_cls = self._REQUESTS[method]
         rpc = self._calls.get(method)
         if rpc is None:
             rpc = self._channel.unary_unary(
                 f"/{_SERVICE}/{method}",
-                request_serializer=_ident,
-                response_deserializer=_ident,
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=rsp_cls.FromString,
             )
             self._calls[method] = rpc
-        return msgpack.unpack(rpc(msgpack.pack(body or {}), timeout=timeout))
+        return rpc(request if request is not None else req_cls(), timeout=timeout)
 
-    def health_check(self) -> dict:
+    def health_check(self) -> "pb.HealthCheckResponse":
         return self.call("HealthCheck")
 
     def close(self) -> None:

@@ -94,6 +94,7 @@ class LogStream:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self._base_meta_path())
+            self._base_meta_position = self._base_position
         except OSError:
             pass
 
@@ -131,9 +132,9 @@ class LogStream:
             # prev-term of base-1 was loaded with it
             self._base_position = self._base_meta_position
             self._next_position = max(self._next_position, self._base_meta_position)
-        elif self._base_position != self._base_meta_position:
-            # the persisted prev-term belongs to a different base: stale
-            self._base_prev_term = -1
+        # (when base.meta disagrees with the recovered records, term_at
+        # consults _base_meta_position directly — no need to discard the
+        # persisted prev-term here)
         # Single-writer mode: recovered records were durably written, commit
         # resumes at the log end. Raft mode: stay at -1 until the leader
         # advances it (see __init__).
@@ -159,13 +160,21 @@ class LogStream:
         return self._records[idx]
 
     def term_at(self, position: int) -> int:
-        """Raft term at ``position``; for ``base_position - 1`` the term is
-        retained across compaction (replication prev-entry check). -1 when
-        unknown."""
-        if position == self._base_position - 1:
-            return self._base_prev_term
+        """Raft term at ``position``. For the position just below the
+        PERSISTED compaction base the term is retained across compaction
+        (replication prev-entry checks); live records win when still
+        present — this makes the answer correct on both sides of the
+        crash window between writing base.meta and deleting segments."""
         record = self.record_at(position)
-        return record.raft_term if record is not None else -1
+        if record is not None:
+            return record.raft_term
+        if position == self._base_meta_position - 1:
+            return self._base_prev_term
+        if position == self._base_position - 1:
+            return self._base_prev_term if (
+                self._base_meta_position == self._base_position
+            ) else -1
+        return -1
 
     def compact(self, position: int) -> int:
         """Compaction floor: drop records below ``position``, SEGMENT
@@ -203,14 +212,15 @@ class LogStream:
         del self._records[: new_base - self._base_position]
         self._base_position = new_base
         self._block_index = [e for e in self._block_index if e[0] >= new_base]
+        # persist the base metadata BEFORE deleting segments: the prev-term
+        # of base-1 must survive a crash anywhere in this sequence (leaders
+        # advertise it in replication prev-entry checks; -1 would make
+        # followers truncate committed records)
+        self._save_base_meta()
         self.storage.delete_segments_before(first_kept)
         self._segment_first_pos = {
             s: p for s, p in self._segment_first_pos.items() if s >= first_kept
         }
-        # the prev-term of base-1 must survive restarts (leaders advertise
-        # it in replication prev-entry checks; -1 would make followers
-        # truncate or wedge)
-        self._save_base_meta()
         return self._base_position
 
     def fast_forward(self, position: int, term: int = -1) -> None:

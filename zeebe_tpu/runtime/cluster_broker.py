@@ -604,6 +604,13 @@ class ClusterBroker(Actor):
                         "processed": m.last_processed_position,
                         "written": m.last_written_position,
                         "term": m.term,
+                        # raft term OF the last-processed record: the
+                        # fast-forwarded follower's last-entry term in
+                        # elections (the leader's own term would inflate
+                        # its log and let it depose better-logged peers)
+                        "lp_term": server.log.term_at(
+                            m.last_processed_position
+                        ),
                     }
                     for m in server.snapshots.storage.list()
                 ],
@@ -753,9 +760,10 @@ class ClusterBroker(Actor):
                 server.raft.snapshot_needed
                 and meta.last_processed_position >= server.log.next_position
             ):
+                lp_term = int(newest.get("lp_term", -1))
                 server.raft.actor.run(
                     lambda: server.log.fast_forward(
-                        meta.last_processed_position + 1, term=meta.term
+                        meta.last_processed_position + 1, term=lp_term
                     )
                 )
         except Exception:  # noqa: BLE001 - next poll retries
