@@ -287,8 +287,15 @@ std::string encode_record(const RecordHeader& r) {
 RecordHeader decode_record(const std::string& frame) {
   RecordHeader r;
   auto rd = [&](size_t off, void* dst, size_t n) { std::memcpy(dst, &frame[off], n); };
+  // Validate the embedded length against the actual buffer before any
+  // fixed-offset read: a truncated or corrupt frame must be rejected
+  // here, not read out of bounds on the way to the CRC check.
+  if (frame.size() < kHeaderSize + 8)
+    throw std::runtime_error("record frame truncated");
   int32_t flen;
   rd(0, &flen, 4);
+  if (flen < kHeaderSize + 8 || static_cast<size_t>(flen) > frame.size())
+    throw std::runtime_error("record frame length field out of range");
   uint32_t crc;
   rd(4, &crc, 4);
   if (crc32(reinterpret_cast<const uint8_t*>(frame.data()) + 8, flen - 8) != crc)
@@ -306,8 +313,12 @@ RecordHeader decode_record(const std::string& frame) {
   r.record_type = frame[o++]; r.value_type = frame[o++];
   r.intent = frame[o++]; r.rejection_type = frame[o++];
   uint32_t rl; rd(o, &rl, 4); o += 4;
+  if (rl > frame.size() - o - 4)
+    throw std::runtime_error("record rejection-reason length out of range");
   r.rejection_reason = frame.substr(o, rl); o += rl;
   uint32_t vl; rd(o, &vl, 4); o += 4;
+  if (vl > frame.size() - o)
+    throw std::runtime_error("record value length out of range");
   r.value = frame.substr(o, vl);
   return r;
 }

@@ -522,10 +522,14 @@ class Raft(Actor):
             # a follower that installed a snapshot past our compaction
             # floor reports its fast-forwarded end, and replication must
             # resume there rather than stay pinned below the floor.
+            # Clamp the forward jump to our own log end: a follower with a
+            # longer stale-term uncommitted suffix reports a log_end past
+            # anything we hold, and probing beyond our log would degrade
+            # into a one-record-per-round walk-back.
             hint = int(msg.get("log_end", self.next_position.get(member_id, 1)))
             cur = self.next_position.get(member_id, 1)
             if hint > cur:
-                self.next_position[member_id] = hint
+                self.next_position[member_id] = min(hint, self.log.next_position)
             else:
                 self.next_position[member_id] = max(0, min(hint, cur - 1))
 

@@ -129,7 +129,11 @@ class GrpcGateway:
         record = self.client.create_instance(
             req.bpmn_process_id,
             payload=_payload(req.payload_msgpack),
-            partition_id=req.partition_id if req.partition_id >= 0 else None,
+            partition_id=(
+                req.partition_id
+                if req.HasField("partition_id") and req.partition_id >= 0
+                else None
+            ),
         )
         return pb.CreateWorkflowInstanceResponse(
             workflow_instance_key=record.value.workflow_instance_key,

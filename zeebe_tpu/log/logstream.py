@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Callable, Iterator, List, Optional
 
@@ -64,6 +65,12 @@ class LogStream:
         # the durability path — mirrors the reference's dispatcher write buffer
         # serving readers before/alongside storage)
         self._records: List[Record] = []
+        # compaction/truncation mutate (_base_position, _records) as a
+        # compound update while readers on other actors index by
+        # position - base; the lock makes each record_at read and each
+        # compound mutation atomic (list.append alone is atomic under the
+        # GIL, so the append hot path stays lock-free)
+        self._view_lock = threading.Lock()
         self._commit_listeners: List[Callable[[int], None]] = []
         self._load_base_meta()
         self._recover()
@@ -154,10 +161,11 @@ class LogStream:
         """Record by position, None when compacted away or not yet
         appended — the supported random-access API (raft replication and
         readers must not reach into the private list)."""
-        idx = position - self._base_position
-        if idx < 0 or idx >= len(self._records):
-            return None
-        return self._records[idx]
+        with self._view_lock:
+            idx = position - self._base_position
+            if idx < 0 or idx >= len(self._records):
+                return None
+            return self._records[idx]
 
     def term_at(self, position: int) -> int:
         """Raft term at ``position``. For the position just below the
@@ -209,8 +217,9 @@ class LogStream:
             return self._base_position
         prev = self.record_at(new_base - 1)
         self._base_prev_term = prev.raft_term if prev is not None else -1
-        del self._records[: new_base - self._base_position]
-        self._base_position = new_base
+        with self._view_lock:
+            del self._records[: new_base - self._base_position]
+            self._base_position = new_base
         self._block_index = [e for e in self._block_index if e[0] >= new_base]
         # persist the base metadata BEFORE deleting segments: the prev-term
         # of base-1 must survive a crash anywhere in this sequence (leaders
@@ -234,10 +243,11 @@ class LogStream:
         # the snapshot supersedes everything on disk: reset storage so a
         # restart cannot resurrect the pre-gap records
         self.storage.reset()
-        self._records.clear()
+        with self._view_lock:
+            self._records.clear()
+            self._base_position = position
         self._block_index = []
         self._segment_first_pos = {}
-        self._base_position = position
         self._base_prev_term = term
         self._next_position = position
         self._commit_position = max(self._commit_position, position - 1)
@@ -334,7 +344,8 @@ class LogStream:
             self._segment_first_pos = {
                 s: p for s, p in self._segment_first_pos.items() if p < position
             }
-            del self._records[position - self._base_position :]
+            with self._view_lock:
+                del self._records[position - self._base_position :]
 
 
 def _iter_disk_frames(log: LogStream, target: int) -> Iterator[tuple]:

@@ -125,7 +125,18 @@ def _dec_instances(items: List[Any]) -> eng.ElementInstanceIndex:
     for d in items:
         if not isinstance(d, dict):
             raise SnapshotFormatError("bad element instance entry")
-        parent = index.get(int(d["p"])) if d.get("p") is not None else None
+        if d.get("p") is not None:
+            parent = index.get(int(d["p"]))
+            if parent is None:
+                # a child without its parent means the payload is
+                # internally inconsistent — fail the restore so
+                # SnapshotController.recover falls back to an older
+                # snapshot instead of silently promoting it to a root
+                raise SnapshotFormatError(
+                    f"element instance {d['k']} references missing parent {d['p']}"
+                )
+        else:
+            parent = None
         inst = eng.ElementInstance(int(d["k"]), parent)
         inst.state = WI(int(d["s"])) if d.get("s") is not None else None
         inst.value = (
@@ -172,6 +183,7 @@ def _dec_workflows(items: List[Any]):
             model = read_yaml_workflow(data.decode("utf-8"))
         else:
             model = read_model(data)
+        matched = False
         for wf in transform_model(model):
             if wf.id != d.get("id"):
                 continue
@@ -180,6 +192,13 @@ def _dec_workflows(items: List[Any]):
             wf.source_resource = data
             wf.source_type = d.get("st", "BPMN_XML")
             out.append(wf)
+            matched = True
+        if not matched:
+            # the recorded id must come back out of the re-transform;
+            # dropping the workflow silently would restore partial state
+            raise SnapshotFormatError(
+                f"workflow id {d.get('id')!r} not produced by re-transform"
+            )
     return out
 
 
