@@ -18,8 +18,8 @@ COPY gateway-protocol/ gateway-protocol/
 
 RUN pip install --no-cache-dir jax flax optax grpcio protobuf numpy
 
-# client API, management, replication, subscription, gateway
-EXPOSE 26500 26501 26502 26503 26504
+# client API, management, replication, subscription, gateway, metrics
+EXPOSE 26500 26501 26502 26503 26504 9600
 
 ENV ZEEBE_CFG=/opt/zeebe-tpu/dist/zeebe.cfg.toml
 ENTRYPOINT ["python", "-m", "zeebe_tpu"]

@@ -1,0 +1,118 @@
+"""Microbenchmark: pallas serial row-loop vs XLA scatter on TPU.
+
+Measures the primitive the mega-kernel design rests on: one serial pass
+over B records applying dynamic row updates to VMEM-resident tables,
+versus the XLA `.at[].set` scatter chain the current kernel pays per op.
+Run on the real chip: `python benchmarks/pallas_probe.py`.
+"""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+B = 16384
+CAP = 65536
+K = 8
+
+
+def timeit(fn, *args, iters=20):
+    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) else jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+# -- XLA scatter chain: N dependent scatters of B rows ----------------------
+@functools.partial(jax.jit, static_argnames=("n_ops",))
+def xla_scatter_chain(tbl, idx, rows, n_ops):
+    for i in range(n_ops):
+        tbl = tbl.at[idx].set(rows + i, mode="drop")
+    return tbl
+
+
+# -- pallas: ONE serial loop, each iteration does a row write ---------------
+def _row_loop_kernel(idx_ref, rows_ref, tbl_ref, n_writes: int):
+    def body(i, _):
+        t = idx_ref[i]
+        for w in range(n_writes):
+            tbl_ref[t, :] = rows_ref[i, :] + w
+        return 0
+
+    jax.lax.fori_loop(0, B, body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("n_writes",))
+def pallas_row_loop(tbl, idx, rows, n_writes):
+    return pl.pallas_call(
+        functools.partial(_row_loop_kernel, n_writes=n_writes),
+        out_shape=jax.ShapeDtypeStruct(tbl.shape, tbl.dtype),
+        input_output_aliases={2: 0},
+    )(idx, rows, tbl)
+
+
+# -- pallas: scalar probe loop (hash-lookup analogue) -----------------------
+def _probe_kernel(keys_ref, tkeys_ref, out_ref):
+    def body(i, _):
+        k = keys_ref[i]
+        h = (k * jnp.int32(0x9E3779B1)) & jnp.int32(CAP - 1)
+
+        def probe(carry):
+            j, slot = carry
+            idx = (h + j) & jnp.int32(CAP - 1)
+            tk = tkeys_ref[idx]
+            hit = tk == k
+            return jax.lax.cond(
+                hit | (tk == -1),
+                lambda: (jnp.int32(99), jnp.where(hit, idx, jnp.int32(-1))),
+                lambda: (j + 1, slot),
+            )
+
+        j, slot = jax.lax.while_loop(
+            lambda c: c[0] < 8, probe, (jnp.int32(0), jnp.int32(-1))
+        )
+        out_ref[i] = slot
+        return 0
+
+    jax.lax.fori_loop(0, B, body, 0)
+
+
+@jax.jit
+def pallas_probe(keys, tkeys):
+    return pl.pallas_call(
+        _probe_kernel,
+        out_shape=jax.ShapeDtypeStruct((B,), jnp.int32),
+    )(keys, tkeys)
+
+
+def main():
+    print("backend:", jax.default_backend())
+    key = jax.random.PRNGKey(0)
+    idx = jax.random.randint(key, (B,), 0, CAP, dtype=jnp.int32)
+    rows = jnp.ones((B, K), jnp.int32)
+    tbl = jnp.zeros((CAP, K), jnp.int32)
+
+    t = timeit(lambda: xla_scatter_chain(tbl, idx, rows, 1))
+    print(f"xla scatter x1:   {t*1e3:8.3f} ms  ({t/B*1e9:6.1f} ns/row)")
+    t = timeit(lambda: xla_scatter_chain(tbl, idx, rows, 10))
+    print(f"xla scatter x10:  {t*1e3:8.3f} ms  ({t/B/10*1e9:6.1f} ns/row/op)")
+
+    t = timeit(lambda: pallas_row_loop(tbl, idx, rows, 1))
+    print(f"pallas loop w=1:  {t*1e3:8.3f} ms  ({t/B*1e9:6.1f} ns/iter)")
+    t = timeit(lambda: pallas_row_loop(tbl, idx, rows, 10))
+    print(f"pallas loop w=10: {t*1e3:8.3f} ms  ({t/B*1e9:6.1f} ns/iter)")
+
+    tkeys = jnp.full((CAP,), -1, jnp.int32)
+    tkeys = tkeys.at[jnp.arange(0, CAP, 3)].set(jnp.arange(0, CAP, 3))
+    keys = jax.random.randint(key, (B,), 0, CAP, dtype=jnp.int32)
+    t = timeit(lambda: pallas_probe(keys, tkeys))
+    print(f"pallas probe:     {t*1e3:8.3f} ms  ({t/B*1e9:6.1f} ns/key)")
+
+
+if __name__ == "__main__":
+    main()

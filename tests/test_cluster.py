@@ -38,6 +38,10 @@ def order_process():
 
 def make_cfg(node_id, partitions=1):
     cfg = BrokerCfg()
+    cfg.network.client_port = 0
+    cfg.network.management_port = 0
+    cfg.network.subscription_port = 0
+    cfg.metrics.port = 0
     cfg.cluster.node_id = node_id
     cfg.cluster.partitions = partitions
     cfg.raft.heartbeat_interval_ms = 30

@@ -159,6 +159,14 @@ class TestWorkflowRepositoryQueries:
         from zeebe_tpu.runtime.config import BrokerCfg
 
         cfg = BrokerCfg()
+
+        cfg.network.client_port = 0
+
+        cfg.network.management_port = 0
+
+        cfg.network.subscription_port = 0
+
+        cfg.metrics.port = 0
         cfg.cluster.node_id = "repo-broker"
         cfg.raft.heartbeat_interval_ms = 30
         cfg.raft.election_timeout_ms = 200

@@ -42,6 +42,10 @@ def client_bin():
 @pytest.fixture
 def broker(tmp_path):
     cfg = BrokerCfg()
+    cfg.network.client_port = 0
+    cfg.network.management_port = 0
+    cfg.network.subscription_port = 0
+    cfg.metrics.port = 0
     cfg.cluster.node_id = "cpp-broker"
     cfg.raft.heartbeat_interval_ms = 30
     cfg.raft.election_timeout_ms = 200

@@ -28,6 +28,10 @@ def wait_until(predicate, timeout=20.0, interval=0.02):
 @pytest.fixture
 def gateway(tmp_path):
     cfg = BrokerCfg()
+    cfg.network.client_port = 0
+    cfg.network.management_port = 0
+    cfg.network.subscription_port = 0
+    cfg.metrics.port = 0
     cfg.cluster.node_id = "gw-broker"
     cfg.raft.heartbeat_interval_ms = 30
     cfg.raft.election_timeout_ms = 200

@@ -2,16 +2,19 @@
 
 Reference parity: ``StandaloneBroker.main``
 (broker-core/.../StandaloneBroker.java:32) + the dist launch scripts: read
-the TOML config (path as argv[1] or ZEEBE_CFG), start a broker node, join
-the configured contact points, self-bootstrap the cluster once the expected
-node count is present, optionally serve the gRPC gateway, run until
-SIGINT/SIGTERM.
+the TOML config, start a broker node, join the configured contact points,
+self-bootstrap the cluster once the expected node count is present, serve
+the gRPC gateway, run until SIGINT/SIGTERM. The engine serving led
+partitions (TPU device kernel or host oracle) comes from the ``[engine]``
+config section / ``ZEEBE_ENGINE_TYPE``.
 
-    python -m zeebe_tpu [zeebe.cfg.toml]
+    python -m zeebe_tpu [--config zeebe.cfg.toml] [--data-dir DIR]
+    python -m zeebe_tpu zeebe.cfg.toml            # positional also accepted
 """
 
 from __future__ import annotations
 
+import argparse
 import os
 import signal
 import sys
@@ -19,17 +22,52 @@ import threading
 
 
 def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    config_path = argv[0] if argv else os.environ.get("ZEEBE_CFG")
+    parser = argparse.ArgumentParser(
+        prog="python -m zeebe_tpu", description="zeebe-tpu standalone broker"
+    )
+    parser.add_argument(
+        "config_positional", nargs="?", default=None, metavar="CONFIG",
+        help="config file path (same as --config)",
+    )
+    parser.add_argument("--config", default=None, help="TOML config file path")
+    parser.add_argument(
+        "--data-dir", default=None,
+        help="data directory root (overrides [data] directory)",
+    )
+    args = parser.parse_args(sys.argv[1:] if argv is None else argv)
+    config_path = (
+        args.config or args.config_positional or os.environ.get("ZEEBE_CFG")
+    )
+
+    # Honor JAX_PLATFORMS even where a sitecustomize pre-injects another
+    # platform plugin: the engine choice must be the operator's.
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # Persistent XLA compile cache: the device kernel is a large program
+    # and recompiling it on every broker start is minutes of downtime.
+    if os.environ.get("ZEEBE_JAX_CACHE_DIR"):
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir", os.environ["ZEEBE_JAX_CACHE_DIR"]
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
 
     from zeebe_tpu.runtime.cluster_broker import ClusterBroker
     from zeebe_tpu.runtime.config import load_config
+    from zeebe_tpu.runtime.engines import engine_factory_from_config
 
     cfg = load_config(config_path)
+    if args.data_dir:
+        cfg.data.directory = args.data_dir
     data_dir = os.path.join(cfg.data.directory, cfg.cluster.node_id)
-    broker = ClusterBroker(cfg, data_dir)
+    broker = ClusterBroker(
+        cfg, data_dir, engine_factory=engine_factory_from_config(cfg)
+    )
     print(
-        f"zeebe-tpu broker {cfg.cluster.node_id}: "
+        f"zeebe-tpu broker {cfg.cluster.node_id}: engine={cfg.engine.type} "
         f"client={broker.client_address.host}:{broker.client_address.port} "
         f"gossip={broker.gossip_address.host}:{broker.gossip_address.port} "
         f"data={data_dir}",

@@ -183,53 +183,128 @@ class Raft(Actor):
         self.actor.run(do)
         return future
 
-    def add_member(self, member_id: str, addr: RemoteAddress) -> ActorFuture:
-        """Leader-only single-step membership change: appends a
-        configuration entry with the new member set; the configuration
-        takes effect ON APPEND (reference RaftConfigurationEvent /
-        RaftJoinService; raft dissertation §4.1 — one change in flight at
-        a time is the caller's responsibility)."""
-        return self._change_membership(lambda m: {**m, member_id: [addr.host, addr.port]})
+    # membership ops retry/forward for this long before giving up — a
+    # leadership flap mid-call must not surface "not leader" to callers
+    # (reference RaftJoinService retries joins until a leader accepts)
+    MEMBERSHIP_TIMEOUT_MS = 10_000
+    _MEMBERSHIP_RETRY_MS = 150
 
-    def remove_member(self, member_id: str) -> ActorFuture:
+    def add_member(self, member_id: str, addr: RemoteAddress) -> ActorFuture:
+        """Single-step membership change: appends a configuration entry
+        with the new member set; the configuration takes effect ON APPEND
+        (reference RaftConfigurationEvent / RaftJoinService; raft
+        dissertation §4.1 — one change in flight at a time is the caller's
+        responsibility). May be called on ANY node: a non-leader forwards
+        the op to the current leader and retries across leadership flaps
+        until ``MEMBERSHIP_TIMEOUT_MS``."""
         return self._change_membership(
-            lambda m: {k: v for k, v in m.items() if k != member_id}
+            {"op": "add", "member": member_id, "addr": [addr.host, addr.port]}
         )
 
-    def _change_membership(self, mutate) -> ActorFuture:
+    def remove_member(self, member_id: str) -> ActorFuture:
+        return self._change_membership({"op": "remove", "member": member_id})
+
+    @staticmethod
+    def _membership_mutation(op: dict):
+        if op["op"] == "add":
+            return lambda m: {**m, op["member"]: list(op["addr"])}
+        return lambda m: {k: v for k, v in m.items() if k != op["member"]}
+
+    def _change_membership(self, op: dict) -> ActorFuture:
+        future = ActorFuture()
+        deadline = self.scheduler.now_ms() + self.MEMBERSHIP_TIMEOUT_MS
+
+        def attempt():
+            if future.is_done():
+                return
+            if self._stopped:
+                future.complete_exceptionally(RuntimeError("raft closed"))
+                return
+            if self.state == RaftState.LEADER:
+                try:
+                    future.complete(self._apply_membership_as_leader(op))
+                except Exception as e:  # noqa: BLE001
+                    future.complete_exceptionally(e)
+                return
+            # not the leader: forward to the leader we know of, or wait
+            # out the election and retry
+            target = self._membership_forward_target()
+            if target is None:
+                retry_later()
+                return
+            request = msgpack.pack({"t": "membership", **op})
+
+            def on_response(msg):
+                if future.is_done():
+                    return
+                if msg is not None and msg.get("ok"):
+                    future.complete(int(msg.get("position", -1)))
+                elif msg is not None and msg.get("error"):
+                    # the leader ACCEPTED leadership of the op but failed
+                    # applying it (e.g. log write error) — that is a real
+                    # failure, not a redirect; surface it instead of
+                    # retrying into the same error for 10s
+                    future.complete_exceptionally(
+                        RuntimeError(f"membership change failed: {msg['error']}")
+                    )
+                else:
+                    retry_later()
+
+            self._ask(target, request, on_response)
+
+        def retry_later():
+            if self.scheduler.now_ms() >= deadline:
+                future.complete_exceptionally(
+                    RuntimeError(
+                        f"membership change {op['op']} {op['member']!r} "
+                        f"timed out after {self.MEMBERSHIP_TIMEOUT_MS}ms "
+                        "(no leader accepted it)"
+                    )
+                )
+                return
+            self.actor.run_delayed(self._MEMBERSHIP_RETRY_MS, attempt)
+
+        self.actor.run(attempt)
+        return future
+
+    def _membership_forward_target(self) -> Optional[RemoteAddress]:
+        """Address of the node to forward a membership op to: the current
+        leader if known, else None (caller retries after the election)."""
+        if self.leader_id is None or self.leader_id == self.node_id:
+            return None
+        entry = self.persistent.members.get(self.leader_id)
+        if entry is None:
+            return None
+        return RemoteAddress(entry[0], int(entry[1]))
+
+    def _apply_membership_as_leader(self, op: dict) -> int:
+        """Leader-side config append (must run on the raft actor while
+        leader). Returns the config entry's position."""
         from zeebe_tpu.protocol.enums import RecordType, ValueType
         from zeebe_tpu.protocol.metadata import RecordMetadata
         from zeebe_tpu.protocol.records import RaftConfigurationRecord, Record
 
-        future = ActorFuture()
-
-        def do():
-            if self.state != RaftState.LEADER:
-                future.complete_exceptionally(RuntimeError("not leader"))
-                return
-            new_members = mutate(dict(self.persistent.members))
-            record = Record(
-                metadata=RecordMetadata(
-                    record_type=RecordType.EVENT,
-                    value_type=ValueType.RAFT,
-                    intent=0,
-                ),
-                value=RaftConfigurationRecord(members=new_members),
-            )
-            record.raft_term = self.persistent.term
-            last = self.log.append([record], commit=False)
-            self.log.flush()
-            self._config_log.append((last, dict(self.persistent.members)))
-            self._apply_config(new_members)
-            if self.node_id not in new_members:
-                self._self_removal_position = last
-            self.match_position[self.node_id] = last
-            self._maybe_commit()
-            self._replicate_all()
-            future.complete(last)
-
-        self.actor.run(do)
-        return future
+        mutate = self._membership_mutation(op)
+        new_members = mutate(dict(self.persistent.members))
+        record = Record(
+            metadata=RecordMetadata(
+                record_type=RecordType.EVENT,
+                value_type=ValueType.RAFT,
+                intent=0,
+            ),
+            value=RaftConfigurationRecord(members=new_members),
+        )
+        record.raft_term = self.persistent.term
+        last = self.log.append([record], commit=False)
+        self.log.flush()
+        self._config_log.append((last, dict(self.persistent.members)))
+        self._apply_config(new_members)
+        if self.node_id not in new_members:
+            self._self_removal_position = last
+        self.match_position[self.node_id] = last
+        self._maybe_commit()
+        self._replicate_all()
+        return last
 
     def _apply_config(self, members: Dict[str, list]) -> None:
         self.persistent.members = dict(members)
@@ -584,6 +659,8 @@ class Raft(Actor):
             return self.actor.call(lambda: self._handle_vote(msg))
         if t == "append":
             return self.actor.call(lambda: self._handle_append(msg))
+        if t == "membership":
+            return self.actor.call(lambda: self._handle_membership(msg))
         return None
 
     def _log_up_to_date(self, msg: dict) -> bool:
@@ -592,6 +669,20 @@ class Raft(Actor):
             last_term,
             last_position,
         )
+
+    def _handle_membership(self, msg: dict) -> bytes:
+        """Forwarded membership op (reference RaftJoinService: the leader
+        accepts joins; non-leaders answer with a redirect hint and the
+        caller retries)."""
+        if self.state != RaftState.LEADER:
+            return msgpack.pack({"ok": False, "leader": self.leader_id})
+        try:
+            position = self._apply_membership_as_leader(
+                {k: msg[k] for k in ("op", "member", "addr") if k in msg}
+            )
+        except Exception as e:  # noqa: BLE001
+            return msgpack.pack({"ok": False, "error": str(e)})
+        return msgpack.pack({"ok": True, "position": position})
 
     def _handle_poll(self, msg: dict) -> bytes:
         # A current leader never grants pre-votes: _last_heartbeat_ms is

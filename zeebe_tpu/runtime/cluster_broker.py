@@ -358,6 +358,7 @@ class ClusterBroker(Actor):
         self.metrics_events_processed = self.metrics.counter(
             "stream_processor_events_processed", "Committed records processed"
         )
+        self.metrics_http = None
         if cfg.metrics.enabled:
             self.metrics_writer = MetricsFileWriter(
                 self.metrics,
@@ -365,6 +366,12 @@ class ClusterBroker(Actor):
                 self.scheduler,
                 cfg.metrics.flush_period_ms,
             )
+            if cfg.metrics.port:
+                from zeebe_tpu.runtime.metrics import MetricsHttpServer
+
+                self.metrics_http = MetricsHttpServer(
+                    self.metrics, host=cfg.network.host, port=cfg.metrics.port
+                )
 
         self.repository = WorkflowRepository()
         self.topology = Topology()
@@ -390,16 +397,22 @@ class ClusterBroker(Actor):
                 sync_interval_ms=cfg.gossip.sync_interval_ms,
             ),
             host=cfg.network.host,
+            port=cfg.network.management_port,
         )
         self.gossip.on_custom_event("partition-leader", self._on_leader_event)
         self.gossip.on_custom_event("node-info", self._on_node_info_event)
 
-        # client + subscription servers
+        # client + subscription servers on the configured socket bindings
+        # (reference zeebe.cfg.toml [network.*]; tests set the ports to 0
+        # for ephemeral binds, the reference EmbeddedBrokerRule pattern)
         self.client_server = ServerTransport(
-            host=cfg.network.host, request_handler=self._on_client_request
+            host=cfg.network.host,
+            port=cfg.network.client_port,
+            request_handler=self._on_client_request,
         )
         self.subscription_server = ServerTransport(
             host=cfg.network.host,
+            port=cfg.network.subscription_port,
             request_handler=self._on_subscription_request,
             message_handler=self._on_subscription_message,
         )
@@ -513,6 +526,8 @@ class ClusterBroker(Actor):
 
     def close(self) -> None:
         self._closing = True
+        if self.metrics_http is not None:
+            self.metrics_http.close()
         for server in self.partitions.values():
             server.close()
         self.gossip.close()

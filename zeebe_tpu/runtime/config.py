@@ -73,6 +73,25 @@ class MetricsCfg:
     enabled: bool = True
     file: str = "metrics/zeebe.prom"
     flush_period_ms: int = 5_000
+    # HTTP /metrics endpoint for prometheus scraping (0 disables; the
+    # file writer keeps running either way — the reference exposes the
+    # file via node exporter, here the broker serves it directly)
+    port: int = 9600
+
+
+@dataclasses.dataclass
+class EngineCfg:
+    """Which stream-processing engine serves the partitions this node
+    leads: ``host`` = the Python oracle interpreter, ``tpu`` = the batched
+    device kernel (``zeebe_tpu.tpu.TpuPartitionEngine``). The reference
+    has exactly one engine, installed unconditionally per partition
+    (broker-core/.../PartitionInstallService.java:106-291); here the
+    device engine is the flagship and the host oracle the fallback."""
+
+    type: str = "host"  # "host" | "tpu"
+    capacity: int = 1 << 12  # device table capacity (instances/jobs rows)
+    num_vars: int = 16  # payload variable columns on device
+    sub_capacity: int = 16  # sub-process nesting table rows
 
 
 @dataclasses.dataclass
@@ -106,6 +125,7 @@ class BrokerCfg:
     metrics: MetricsCfg = dataclasses.field(default_factory=MetricsCfg)
     gossip: GossipCfg = dataclasses.field(default_factory=GossipCfg)
     raft: RaftCfg = dataclasses.field(default_factory=RaftCfg)
+    engine: EngineCfg = dataclasses.field(default_factory=EngineCfg)
     topics: List[TopicCfg] = dataclasses.field(default_factory=list)
 
 
@@ -117,6 +137,7 @@ _SECTION_KEYS = {
     "metrics": MetricsCfg,
     "gossip": GossipCfg,
     "raft": RaftCfg,
+    "engine": EngineCfg,
 }
 
 # env overrides (reference Environment: ZEEBE_* wins over the file)
@@ -132,7 +153,15 @@ _ENV_OVERRIDES = {
         "initial_contact_points",
         lambda v: [p.strip() for p in v.split(",") if p.strip()],
     ),
+    # singular alias: both spellings appear in reference deployments
+    "ZEEBE_CONTACT_POINT": (
+        "cluster",
+        "initial_contact_points",
+        lambda v: [p.strip() for p in v.split(",") if p.strip()],
+    ),
     "ZEEBE_DATA_DIR": ("data", "directory", str),
+    "ZEEBE_ENGINE_TYPE": ("engine", "type", str),
+    "ZEEBE_METRICS_PORT": ("metrics", "port", int),
 }
 
 
@@ -154,13 +183,17 @@ def load_config(
     env: Optional[Dict[str, str]] = None,
 ) -> BrokerCfg:
     """Parse config (file path or literal text), then apply env overrides.
-    Missing file/sections keep defaults (the reference ships a fully
-    commented default file; every knob is optional)."""
+    Missing sections keep defaults (the reference ships a fully commented
+    default file; every knob is optional) — but an explicitly named file
+    that does not exist is an error: silently running on all-defaults is
+    how a container ignores its own config."""
     cfg = BrokerCfg()
     data: Dict[str, Any] = {}
     if toml_text is not None:
         data = tomllib.loads(toml_text)
-    elif path is not None and os.path.exists(path):
+    elif path is not None:
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"config file not found: {path}")
         with open(path, "rb") as f:
             data = tomllib.load(f)
 
@@ -182,4 +215,8 @@ def load_config(
             setattr(getattr(cfg, section), attr, conv(environment[var]))
 
     cfg.network.apply_offset()
+    # the metrics endpoint is a socket binding too: shift it with the rest
+    # so several brokers can share one host (reference portOffset contract)
+    if cfg.metrics.port:
+        cfg.metrics.port += cfg.network.port_offset * 10
     return cfg

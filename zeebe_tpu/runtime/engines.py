@@ -1,0 +1,48 @@
+"""Engine selection: config → partition engine factory.
+
+Reference parity: the reference has a single stream-processor engine,
+installed unconditionally per leader partition
+(broker-core/.../clustering/base/partitions/PartitionInstallService.java:106-291).
+Here the broker chooses between the batched TPU device kernel (the
+flagship) and the host oracle interpreter per the ``[engine]`` config
+section; both serve the same record contract.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from zeebe_tpu.runtime.config import BrokerCfg
+
+
+def engine_factory_from_config(
+    cfg: BrokerCfg,
+) -> Optional[Callable]:
+    """Build the ``engine_factory`` for :class:`ClusterBroker` /
+    :class:`Broker` from ``cfg.engine``. Returns ``None`` for the host
+    oracle (the brokers' built-in default)."""
+    etype = cfg.engine.type.lower()
+    if etype == "host":
+        return None
+    if etype == "tpu":
+        capacity = int(cfg.engine.capacity)
+        num_vars = int(cfg.engine.num_vars)
+        sub_capacity = int(cfg.engine.sub_capacity)
+
+        def factory(partition_id: int, broker):
+            from zeebe_tpu.tpu import TpuPartitionEngine
+
+            return TpuPartitionEngine(
+                partition_id,
+                broker.cfg.cluster.partitions,
+                repository=broker.repository,
+                clock=broker.clock,
+                capacity=capacity,
+                num_vars=num_vars,
+                sub_capacity=sub_capacity,
+            )
+
+        return factory
+    raise ValueError(
+        f"unknown engine type {cfg.engine.type!r} (expected 'host' or 'tpu')"
+    )

@@ -96,6 +96,47 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
+class MetricsHttpServer:
+    """Serves ``GET /metrics`` with the registry's Prometheus text dump.
+
+    The reference exposes the metrics file through a node exporter
+    (prometheus/prometheus.yml + MetricsFileWriter); here the broker
+    serves the same text directly so the compose stack needs no exporter
+    sidecar."""
+
+    def __init__(self, registry: MetricsRegistry, host: str = "0.0.0.0", port: int = 9600):
+        import http.server
+
+        registry_ref = registry
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - stdlib API name
+                if self.path.rstrip("/") not in ("", "/metrics", "/healthz"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = registry_ref.dump().encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        self._server = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="zb-metrics-http", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
 class MetricsFileWriter(Actor):
     """Periodically dumps the registry to a file (reference
     MetricsFileWriter: temp-write then rename so scrapers never see a torn
