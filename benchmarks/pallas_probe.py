@@ -3,6 +3,13 @@
 Measures the primitive the mega-kernel design rests on: one serial pass
 over B records applying dynamic row updates to VMEM-resident tables,
 versus the XLA `.at[].set` scatter chain the current kernel pays per op.
+
+TPU addressing constraints probed here (they shape the kernel design):
+- dynamic scalar loads must come from SMEM (per-record fields);
+- tables are 2D [rows, lanes]; dynamic indexing happens on the SUBLANE
+  (row) dim; a dynamic LANE is read/written via masked select over the
+  128-lane row (2-3 VPU ops).
+
 Run on the real chip: `python benchmarks/pallas_probe.py`.
 """
 
@@ -12,18 +19,19 @@ import time
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 B = 16384
 CAP = 65536
-K = 8
+K = 128  # table row width (lanes)
 
 
-def timeit(fn, *args, iters=20):
-    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) else jax.block_until_ready(fn(*args))
+def timeit(fn, iters=20):
+    jax.block_until_ready(fn())
     t0 = time.perf_counter()
     out = None
     for _ in range(iters):
-        out = fn(*args)
+        out = fn()
     jax.block_until_ready(out)
     return (time.perf_counter() - t0) / iters
 
@@ -36,12 +44,15 @@ def xla_scatter_chain(tbl, idx, rows, n_ops):
     return tbl
 
 
-# -- pallas: ONE serial loop, each iteration does a row write ---------------
-def _row_loop_kernel(idx_ref, rows_ref, tbl_ref, n_writes: int):
+# -- pallas: ONE serial loop, each iteration does n row writes --------------
+def _row_loop_kernel(idx_ref, rows_ref, tbl_ref, out_ref, *, n_writes: int):
+    del tbl_ref  # aliased with out_ref
+
     def body(i, _):
         t = idx_ref[i]
+        row = rows_ref[i, :]
         for w in range(n_writes):
-            tbl_ref[t, :] = rows_ref[i, :] + w
+            out_ref[t, :] = row + w
         return 0
 
     jax.lax.fori_loop(0, B, body, 0)
@@ -51,30 +62,49 @@ def _row_loop_kernel(idx_ref, rows_ref, tbl_ref, n_writes: int):
 def pallas_row_loop(tbl, idx, rows, n_writes):
     return pl.pallas_call(
         functools.partial(_row_loop_kernel, n_writes=n_writes),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(tbl.shape, tbl.dtype),
         input_output_aliases={2: 0},
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024
+        ),
     )(idx, rows, tbl)
 
 
 # -- pallas: scalar probe loop (hash-lookup analogue) -----------------------
+# table keys as [CAP/128, 128]; dynamic lane extracted by masked reduce
+LANES = 128
+
+
 def _probe_kernel(keys_ref, tkeys_ref, out_ref):
+    lane_iota = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+
     def body(i, _):
         k = keys_ref[i]
-        h = (k * jnp.int32(0x9E3779B1)) & jnp.int32(CAP - 1)
+        h = (k * jnp.uint32(0x9E3779B1).astype(jnp.int32)) & jnp.int32(CAP - 1)
 
         def probe(carry):
-            j, slot = carry
+            j, slot, done = carry
             idx = (h + j) & jnp.int32(CAP - 1)
-            tk = tkeys_ref[idx]
+            row = tkeys_ref[idx >> 7, :].reshape(1, LANES)
+            lane = idx & jnp.int32(LANES - 1)
+            tk = jnp.sum(jnp.where(lane_iota == lane, row, 0))
             hit = tk == k
-            return jax.lax.cond(
-                hit | (tk == -1),
-                lambda: (jnp.int32(99), jnp.where(hit, idx, jnp.int32(-1))),
-                lambda: (j + 1, slot),
+            return (
+                j + 1,
+                jnp.where(hit, idx, slot),
+                done | hit | (tk == -1),
             )
 
-        j, slot = jax.lax.while_loop(
-            lambda c: c[0] < 8, probe, (jnp.int32(0), jnp.int32(-1))
+        _, slot, _ = jax.lax.while_loop(
+            lambda c: (c[0] < 8) & ~c[2],
+            probe,
+            (jnp.int32(0), jnp.int32(-1), jnp.bool_(False)),
         )
         out_ref[i] = slot
         return 0
@@ -83,36 +113,82 @@ def _probe_kernel(keys_ref, tkeys_ref, out_ref):
 
 
 @jax.jit
-def pallas_probe(keys, tkeys):
+def pallas_probe(keys, tkeys2d):
     return pl.pallas_call(
         _probe_kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
         out_shape=jax.ShapeDtypeStruct((B,), jnp.int32),
-    )(keys, tkeys)
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024
+        ),
+    )(keys, tkeys2d)
 
 
 def main():
-    print("backend:", jax.default_backend())
+    print("backend:", jax.default_backend(), flush=True)
     key = jax.random.PRNGKey(0)
     idx = jax.random.randint(key, (B,), 0, CAP, dtype=jnp.int32)
     rows = jnp.ones((B, K), jnp.int32)
     tbl = jnp.zeros((CAP, K), jnp.int32)
 
     t = timeit(lambda: xla_scatter_chain(tbl, idx, rows, 1))
-    print(f"xla scatter x1:   {t*1e3:8.3f} ms  ({t/B*1e9:6.1f} ns/row)")
+    print(f"xla scatter x1:   {t*1e3:8.3f} ms  ({t/B*1e9:6.1f} ns/row)", flush=True)
     t = timeit(lambda: xla_scatter_chain(tbl, idx, rows, 10))
-    print(f"xla scatter x10:  {t*1e3:8.3f} ms  ({t/B/10*1e9:6.1f} ns/row/op)")
+    print(f"xla scatter x10:  {t*1e3:8.3f} ms  ({t/B/10*1e9:6.1f} ns/row/op)", flush=True)
 
     t = timeit(lambda: pallas_row_loop(tbl, idx, rows, 1))
-    print(f"pallas loop w=1:  {t*1e3:8.3f} ms  ({t/B*1e9:6.1f} ns/iter)")
+    print(f"pallas loop w=1:  {t*1e3:8.3f} ms  ({t/B*1e9:6.1f} ns/iter)", flush=True)
     t = timeit(lambda: pallas_row_loop(tbl, idx, rows, 10))
-    print(f"pallas loop w=10: {t*1e3:8.3f} ms  ({t/B*1e9:6.1f} ns/iter)")
+    print(f"pallas loop w=10: {t*1e3:8.3f} ms  ({t/B*1e9:6.1f} ns/iter)", flush=True)
 
     tkeys = jnp.full((CAP,), -1, jnp.int32)
     tkeys = tkeys.at[jnp.arange(0, CAP, 3)].set(jnp.arange(0, CAP, 3))
     keys = jax.random.randint(key, (B,), 0, CAP, dtype=jnp.int32)
-    t = timeit(lambda: pallas_probe(keys, tkeys))
-    print(f"pallas probe:     {t*1e3:8.3f} ms  ({t/B*1e9:6.1f} ns/key)")
+    t = timeit(lambda: pallas_probe(keys, tkeys.reshape(CAP // LANES, LANES)))
+    print(f"pallas probe:     {t*1e3:8.3f} ms  ({t/B*1e9:6.1f} ns/key)", flush=True)
 
 
 if __name__ == "__main__":
     main()
+
+
+# -- narrow-op cost model (the current kernel's dominant ops) ---------------
+@functools.partial(jax.jit, static_argnames=("n_ops",))
+def xla_narrow_scatter_chain(tbl1d, idx, vals, n_ops):
+    # dependent chain: each op's values derive from the previous table so
+    # nothing can be dead-code-eliminated or reordered
+    for _ in range(n_ops):
+        tbl1d = tbl1d.at[idx].set(vals + tbl1d[0], mode="drop")
+    return tbl1d
+
+
+@functools.partial(jax.jit, static_argnames=("n_ops",))
+def xla_gather_chain(tbl1d, idx, n_ops):
+    acc = jnp.int32(0)
+    for _ in range(n_ops):
+        got = tbl1d[(idx + acc) & (CAP - 1)]
+        acc = got[0]
+    return acc
+
+
+def narrow_main():
+    key = jax.random.PRNGKey(1)
+    idx = jax.random.randint(key, (B,), 0, CAP, dtype=jnp.int32)
+    vals = jnp.ones((B,), jnp.int32)
+    tbl1d = jnp.zeros((CAP,), jnp.int32)
+    t = timeit(lambda: xla_narrow_scatter_chain(tbl1d, idx, vals, 1))
+    print(f"xla 1d scatter x1:  {t*1e3:8.3f} ms ({t/B*1e9:6.1f} ns/idx)", flush=True)
+    t = timeit(lambda: xla_narrow_scatter_chain(tbl1d, idx, vals, 8))
+    print(f"xla 1d scatter x8:  {t*1e3:8.3f} ms ({t/B/8*1e9:6.1f} ns/idx/op)", flush=True)
+    t = timeit(lambda: xla_gather_chain(tbl1d, idx, 1))
+    print(f"xla 1d gather x1:   {t*1e3:8.3f} ms ({t/B*1e9:6.1f} ns/idx)", flush=True)
+    t = timeit(lambda: xla_gather_chain(tbl1d, idx, 8))
+    print(f"xla 1d gather x8:   {t*1e3:8.3f} ms ({t/B/8*1e9:6.1f} ns/idx/op)", flush=True)
+
+
+if __name__ == "__main__":
+    narrow_main()

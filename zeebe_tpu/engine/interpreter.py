@@ -80,7 +80,7 @@ class ElementInstance:
 
     __slots__ = (
         "key", "parent", "state", "value", "children", "job_key",
-        "active_tokens", "join_arrivals",
+        "active_tokens", "join_arrivals", "mi_outputs",
     )
 
     def __init__(self, key: int, parent: Optional["ElementInstance"]):
@@ -93,6 +93,8 @@ class ElementInstance:
         self.active_tokens = 0
         # parallel-join arrival payloads: gateway element idx → {flow idx → payload}
         self.join_arrivals: Dict[int, Dict[int, dict]] = {}
+        # multi-instance containers: loopCounter → extracted output value
+        self.mi_outputs: Dict[int, Any] = {}
         if parent is not None:
             parent.children.append(self)
 
@@ -317,6 +319,11 @@ class PartitionEngine:
         # timers (TPU-native)
         self.timers: Dict[int, TimerState] = {}
 
+        # interrupting-boundary continuations: host instance key →
+        # (boundary element id, trigger payload); set when the trigger
+        # terminates the host, consumed when ELEMENT_TERMINATED processes
+        self._pending_boundary: Dict[int, tuple] = {}
+
         # topic subscription ack state (reference TopicSubscriberState:
         # per-subscription last acked position, persisted in the log)
         self.topic_sub_acks: Dict[str, int] = {}
@@ -379,6 +386,7 @@ class PartitionEngine:
             "messages": self.messages,
             "message_subscriptions": self.message_subscriptions,
             "timers": self.timers,
+            "pending_boundary": self._pending_boundary,
             "topic_sub_acks": self.topic_sub_acks,
             "topics": self.topics,
             "next_partition_id": self.next_partition_id,
@@ -404,6 +412,7 @@ class PartitionEngine:
         self.messages = state["messages"]
         self.message_subscriptions = state["message_subscriptions"]
         self.timers = state["timers"]
+        self._pending_boundary = state.get("pending_boundary", {})
         self.topic_sub_acks = state.get("topic_sub_acks", {})
         self.topics = state.get("topics", {})
         self.next_partition_id = state.get("next_partition_id", 1)
@@ -687,6 +696,7 @@ class PartitionEngine:
             WI.START_EVENT_OCCURRED,
             WI.END_EVENT_OCCURRED,
             WI.GATEWAY_ACTIVATED,
+            WI.BOUNDARY_EVENT_OCCURRED,
         ):
             self._bpmn_step(record, intent, out)
 
@@ -830,6 +840,34 @@ class PartitionEngine:
         if not self._step_guard(intent, record, instance, scope_instance):
             return
 
+        # boundary-event arming/disarming rides the host activity's
+        # lifecycle events, independent of its bound step (the reference
+        # model defines BoundaryEvent but its engine never executes it;
+        # the continuation intent is BOUNDARY_EVENT_OCCURRED)
+        if element.boundary_events:
+            if intent == WI.ELEMENT_ACTIVATED:
+                self._arm_boundary_events(record, element, out)
+            elif intent in (WI.ELEMENT_COMPLETING, WI.ELEMENT_TERMINATING):
+                self._disarm_boundary_events(record, element, out)
+
+        if intent == WI.ELEMENT_TERMINATED and record.key in self._pending_boundary:
+            # interrupting boundary: the host terminated on behalf of the
+            # trigger — continue the token at the boundary event instead of
+            # propagating the termination. If the SCOPE started terminating
+            # in between (a cancel raced the boundary), drop the
+            # continuation and let normal termination propagation run.
+            boundary_id, payload = self._pending_boundary.pop(record.key)
+            if scope_instance is not None and scope_instance.state == WI.ELEMENT_ACTIVATED:
+                boundary_el = workflow.element_by_id(boundary_id)
+                if boundary_el is not None:
+                    new_value = value.copy()
+                    new_value.activity_id = boundary_el.id
+                    new_value.payload = dict(payload)
+                    self._write_new_wi_event(
+                        out, record, WI.BOUNDARY_EVENT_OCCURRED, new_value
+                    )
+                return
+
         step = element.get_step(intent)
         if step == BpmnStep.NONE:
             return
@@ -852,12 +890,24 @@ class PartitionEngine:
         if intent == WI.ELEMENT_TERMINATING:
             return True
         if intent == WI.ELEMENT_TERMINATED:
+            # pending interrupting-boundary continuations are processed
+            # while the scope stays ACTIVATED (the token moves to the
+            # boundary event, the scope does not terminate); when the
+            # scope is itself TERMINATING (boundary raced a cancel) the
+            # guard passes so normal termination propagation runs —
+            # _bpmn_step discards the stale pending entry
+            if record.key in self._pending_boundary:
+                return scope is not None and scope.state in (
+                    WI.ELEMENT_ACTIVATED,
+                    WI.ELEMENT_TERMINATING,
+                )
             return scope is not None and scope.state == WI.ELEMENT_TERMINATING
         if intent in (
             WI.END_EVENT_OCCURRED,
             WI.GATEWAY_ACTIVATED,
             WI.START_EVENT_OCCURRED,
             WI.SEQUENCE_FLOW_TAKEN,
+            WI.BOUNDARY_EVENT_OCCURRED,
         ):
             return scope is not None and scope.state == WI.ELEMENT_ACTIVATED
         return True
@@ -902,9 +952,36 @@ class PartitionEngine:
         # parallel flows: the scope completes when its last token is consumed
         value: WorkflowInstanceRecord = record.value
         scope_value = scope.value
-        scope_value.payload = dict(value.payload)
+        scope_el = workflow.element_by_id(scope_value.activity_id)
+        is_mi = scope_el is not None and scope_el.is_multi_instance
+        if is_mi:
+            # multi-instance container: iteration-local variables
+            # (loopCounter, the input element) must NOT leak into the
+            # container payload; per-iteration outputs are collected in
+            # loopCounter order instead
+            if scope_el.mi_output_collection:
+                # keyed by loopCounter when the iteration payload still
+                # carries it; a job result that replaced the payload
+                # (reference semantics: the job payload becomes the task
+                # payload) falls back to completion order
+                counter = value.payload.get("loopCounter")
+                if not isinstance(counter, int):
+                    counter = max(scope.mi_outputs, default=0) + 1
+                found, extracted = query_json_path(
+                    value.payload, scope_el.mi_output_element
+                )
+                scope.mi_outputs[counter] = extracted if found else None
+        else:
+            scope_value.payload = dict(value.payload)
         scope.active_tokens -= 1
         if scope.active_tokens <= 0:
+            if is_mi and scope_el.mi_output_collection:
+                payload = dict(scope_value.payload)
+                payload[scope_el.mi_output_collection] = [
+                    scope.mi_outputs[c] for c in sorted(scope.mi_outputs)
+                ]
+                scope_value.payload = payload
+                scope.mi_outputs = {}
             self._write_wi_followup(out, record, scope.key, WI.ELEMENT_COMPLETING, scope_value)
 
     def _h_exclusive_split(self, record, element, workflow, instance, scope, out):
@@ -1138,6 +1215,51 @@ class PartitionEngine:
             value.payload = merged
             self._write_new_wi_event(out, record, WI.GATEWAY_ACTIVATED, value)
 
+    def _h_multi_instance_split(self, record, element, workflow, instance, scope, out):
+        """Parallel multi-instance activation (reference model
+        MultiInstanceLoopCharacteristics.java — the reference engine never
+        executes it): spawn one body token per item; the container
+        completes when the last body token is consumed (token counting,
+        the same mechanism as the parallel join). Each iteration's payload
+        carries ``loopCounter`` (1-based) and, with an input collection,
+        ``input_element`` = collection[i]."""
+        value: WorkflowInstanceRecord = record.value
+        container = instance
+        items = None
+        if element.mi_input_collection:
+            found, coll = query_json_path(value.payload, element.mi_input_collection)
+            if not found or not isinstance(coll, list):
+                self._raise_incident(
+                    record,
+                    ErrorType.IO_MAPPING_ERROR,
+                    "Multi-instance input collection "
+                    f"'{element.mi_input_collection}' is not an array in the payload",
+                    out,
+                )
+                return
+            items = coll
+            n = len(items)
+        else:
+            n = int(element.mi_cardinality or 0)
+        if n <= 0:
+            # empty collection: the multi-instance body never runs and the
+            # container completes immediately
+            self._write_wi_followup(out, record, record.key, WI.ELEMENT_COMPLETING, value)
+            return
+        if container is not None:
+            container.active_tokens = n
+        start_event = element.start_event
+        for i in range(n):
+            child_value = value.copy()
+            child_value.activity_id = start_event.id
+            child_value.scope_instance_key = record.key
+            payload = dict(value.payload)
+            payload["loopCounter"] = i + 1
+            if items is not None and element.mi_input_element:
+                payload[element.mi_input_element] = items[i]
+            child_value.payload = payload
+            self._write_new_wi_event(out, record, WI.START_EVENT_OCCURRED, child_value)
+
     def _h_create_timer(self, record, element, workflow, instance, scope, out):
         # TPU-native: timer catch event
         # record.timestamp, not clock(): replay must rebuild identical state
@@ -1155,6 +1277,128 @@ class PartitionEngine:
 
     def _h_cancel_process(self, record, element, workflow, instance, scope, out):
         pass  # reference BpmnStep.CANCEL_PROCESS is unused in this version
+
+    # -- boundary events (reference model BoundaryEvent.java +
+    # cancelActivity; the continuation intent BOUNDARY_EVENT_OCCURRED is a
+    # TPU-native extension — the reference engine never executes boundary
+    # events) ----------------------------------------------------------------
+    def _arm_boundary_events(self, record: Record, element, out: ProcessingResult) -> None:
+        """On host ELEMENT_ACTIVATED: start a timer / open a message
+        subscription per attached boundary event."""
+        value: WorkflowInstanceRecord = record.value
+        for boundary in element.boundary_events:
+            if boundary.timer_duration_ms is not None:
+                due = record.timestamp + int(boundary.timer_duration_ms)
+                timer = TimerRecord(
+                    workflow_instance_key=value.workflow_instance_key,
+                    activity_instance_key=record.key,
+                    due_date=due,
+                    handler_element_id=boundary.id,
+                )
+                out.written.append(
+                    _record(RecordType.COMMAND, timer, TimerIntent.CREATE, -1, record.position)
+                )
+            elif boundary.message_name:
+                found, corr_value = query_json_path(
+                    value.payload, boundary.correlation_key_path
+                )
+                if not found or not isinstance(corr_value, (str, int)):
+                    self._raise_incident(
+                        record,
+                        ErrorType.IO_MAPPING_ERROR,
+                        "Failed to extract the correlation-key by "
+                        f"'{boundary.correlation_key_path}'",
+                        out,
+                    )
+                    continue
+                correlation_key = str(corr_value)
+                target = self.partition_for_correlation_key(correlation_key)
+                sub = MessageSubscriptionRecord(
+                    workflow_instance_partition_id=self.partition_id,
+                    workflow_instance_key=value.workflow_instance_key,
+                    activity_instance_key=record.key,
+                    message_name=boundary.message_name,
+                    correlation_key=correlation_key,
+                )
+                out.sends.append(
+                    (target, _record(RecordType.COMMAND, sub, MessageSubscriptionIntent.OPEN))
+                )
+
+    def _disarm_boundary_events(self, record: Record, element, out: ProcessingResult) -> None:
+        """On host COMPLETING/TERMINATING: cancel boundary timers and close
+        boundary message subscriptions that did not fire."""
+        value: WorkflowInstanceRecord = record.value
+        for timer_key, timer in list(self.timers.items()):
+            if timer.activity_instance_key == record.key:
+                out.written.append(
+                    _record(RecordType.COMMAND, timer.record, TimerIntent.CANCEL,
+                            timer_key, record.position)
+                )
+        for boundary in element.boundary_events:
+            if not boundary.message_name:
+                continue
+            found, corr_value = query_json_path(
+                value.payload, boundary.correlation_key_path
+            )
+            if not found:
+                continue
+            target = self.partition_for_correlation_key(str(corr_value))
+            close = MessageSubscriptionRecord(
+                workflow_instance_partition_id=self.partition_id,
+                workflow_instance_key=value.workflow_instance_key,
+                activity_instance_key=record.key,
+                message_name=boundary.message_name,
+                correlation_key=str(corr_value),
+            )
+            out.sends.append(
+                (target, _record(RecordType.COMMAND, close, MessageSubscriptionIntent.CLOSE))
+            )
+
+    def _fire_boundary_event(
+        self,
+        record: Record,
+        boundary,
+        host: ElementInstance,
+        payload: Dict,
+        out: ProcessingResult,
+    ) -> None:
+        """A boundary trigger fired while the host activity is active."""
+        host_value = host.value
+        new_value = host_value.copy()
+        new_value.activity_id = boundary.id
+        new_value.payload = dict(payload)
+        if boundary.cancel_activity:
+            # interrupting: terminate the host; the token continues at the
+            # boundary event when ELEMENT_TERMINATED processes
+            self._pending_boundary[host.key] = (boundary.id, dict(payload))
+            self._write_wi_followup(
+                out, record, host.key, WI.ELEMENT_TERMINATING, host_value
+            )
+        else:
+            scope = host.parent
+            if scope is not None:
+                scope.active_tokens += 1
+            self._write_new_wi_event(out, record, WI.BOUNDARY_EVENT_OCCURRED, new_value)
+
+    def _boundary_for(self, instance: ElementInstance, message_name: str = "",
+                      handler_element_id: str = ""):
+        """Resolve a trigger to the host element's attached boundary event
+        (by handler element id for timers, by message name for messages).
+        Returns (element, boundary) or (None, None)."""
+        if instance is None or instance.value is None:
+            return None, None
+        workflow = self.repository.by_key.get(instance.value.workflow_key)
+        if workflow is None:
+            return None, None
+        element = workflow.element_by_id(instance.value.activity_id)
+        if element is None:
+            return None, None
+        for boundary in element.boundary_events:
+            if handler_element_id and boundary.id == handler_element_id:
+                return element, boundary
+            if message_name and boundary.message_name == message_name:
+                return element, boundary
+        return element, None
 
     _STEP_HANDLERS = {
         BpmnStep.TAKE_SEQUENCE_FLOW: _h_take_sequence_flow,
@@ -1178,6 +1422,7 @@ class PartitionEngine:
         BpmnStep.PARALLEL_MERGE: _h_parallel_merge,
         BpmnStep.CREATE_TIMER: _h_create_timer,
         BpmnStep.TERMINATE_CATCH_EVENT: _h_terminate_catch_event,
+        BpmnStep.MULTI_INSTANCE_SPLIT: _h_multi_instance_split,
     }
 
     # ------------------------------------------------------------------
@@ -1740,16 +1985,26 @@ class PartitionEngine:
         if instance is None:
             self._job_rejection(record, "activity is not active anymore", out)
             return
-        wi_value = instance.value
-        wi_value.payload = dict(value.payload)
         out.written.append(
             _record(RecordType.EVENT, value.copy(),
                     WorkflowInstanceSubscriptionIntent.CORRELATED,
                     record.key, record.position)
         )
-        self._write_wi_followup(
-            out, record, value.activity_instance_key, WI.ELEMENT_COMPLETING, wi_value
-        )
+        _, boundary = self._boundary_for(instance, message_name=value.message_name)
+        if boundary is not None:
+            self._fire_boundary_event(
+                record, boundary, instance, dict(value.payload), out
+            )
+            if not boundary.cancel_activity:
+                # non-interrupting: the subscription stays open so the
+                # boundary can fire again for further messages
+                return
+        else:
+            wi_value = instance.value
+            wi_value.payload = dict(value.payload)
+            self._write_wi_followup(
+                out, record, value.activity_instance_key, WI.ELEMENT_COMPLETING, wi_value
+            )
         # close the now-consumed subscription on the message partition (the
         # reference leaks it in this version; see MessageSubscriptionIntent)
         if value.message_partition_id >= 0:
@@ -1793,9 +2048,18 @@ class PartitionEngine:
             )
             instance = self.element_instances.get(value.activity_instance_key)
             if instance is not None and instance.state == WI.ELEMENT_ACTIVATED:
-                self._write_wi_followup(
-                    out, record, instance.key, WI.ELEMENT_COMPLETING, instance.value
+                _, boundary = self._boundary_for(
+                    instance, handler_element_id=value.handler_element_id
                 )
+                if boundary is not None:
+                    self._fire_boundary_event(
+                        record, boundary, instance,
+                        dict(instance.value.payload), out,
+                    )
+                else:
+                    self._write_wi_followup(
+                        out, record, instance.key, WI.ELEMENT_COMPLETING, instance.value
+                    )
         elif intent == TimerIntent.CANCEL:
             timer = self.timers.pop(record.key, None)
             if timer is not None:

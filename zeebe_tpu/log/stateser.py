@@ -116,6 +116,7 @@ def _enc_instances(index: eng.ElementInstanceIndex) -> List[dict]:
             "j": inst.job_key,
             "t": inst.active_tokens,
             "a": inst.join_arrivals,
+            "mo": inst.mi_outputs,
         })
     return out
 
@@ -150,6 +151,7 @@ def _dec_instances(items: List[Any]) -> eng.ElementInstanceIndex:
             int(gw): {int(fl): dict(payload) for fl, payload in flows.items()}
             for gw, flows in arrivals.items()
         }
+        inst.mi_outputs = {int(c): v for c, v in (d.get("mo") or {}).items()}
         index.instances[inst.key] = inst
     return index
 
@@ -243,6 +245,10 @@ def encode_host_state(state: Dict[str, Any]) -> bytes:
                 "r": t.record.to_document()}
             for k, t in state["timers"].items()
         },
+        "pending_boundary": {
+            k: [bid, dict(payload)]
+            for k, (bid, payload) in state.get("pending_boundary", {}).items()
+        },
         "topic_sub_acks": dict(state["topic_sub_acks"]),
         "topics": {k: dict(v) for k, v in state["topics"].items()},
         "next_partition_id": state["next_partition_id"],
@@ -335,6 +341,10 @@ def _decode_host_doc(doc: dict) -> Dict[str, Any]:
                     record=TimerRecord.from_document(v["r"]),
                 )
                 for k, v in doc["timers"].items()
+            },
+            "pending_boundary": {
+                int(k): (str(v[0]), dict(v[1]))
+                for k, v in doc.get("pending_boundary", {}).items()
             },
             "topic_sub_acks": {
                 str(k): int(v) for k, v in doc["topic_sub_acks"].items()

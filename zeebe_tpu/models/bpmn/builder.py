@@ -18,6 +18,7 @@ import itertools
 from typing import Dict, List, Optional
 
 from zeebe_tpu.models.bpmn.model import (
+    BoundaryEvent,
     BpmnModel,
     EndEvent,
     ExclusiveGateway,
@@ -25,6 +26,7 @@ from zeebe_tpu.models.bpmn.model import (
     IntermediateCatchEvent,
     Mapping,
     MessageDefinition,
+    MultiInstanceLoopCharacteristics,
     OutputBehavior,
     ParallelGateway,
     Process,
@@ -162,10 +164,67 @@ class ProcessBuilder:
             ReceiveTask(id=element_id or self._gen_id("receive"), message=msg)
         )
 
-    def sub_process(self, element_id: Optional[str] = None) -> "SubProcessBuilder":
-        sub = SubProcess(id=element_id or self._gen_id("subprocess"))
+    def sub_process(
+        self,
+        element_id: Optional[str] = None,
+        *,
+        multi_instance: Optional[dict] = None,
+    ) -> "SubProcessBuilder":
+        """``multi_instance``: dict with ``input_collection`` /
+        ``input_element`` / ``cardinality`` / ``output_collection`` keys
+        (reference: MultiInstanceLoopCharacteristics on the activity)."""
+        sub = SubProcess(
+            id=element_id or self._gen_id("subprocess"),
+            multi_instance=(
+                MultiInstanceLoopCharacteristics(**multi_instance)
+                if multi_instance is not None
+                else None
+            ),
+        )
         self._add_node(sub)
         return SubProcessBuilder(self, sub)
+
+    def boundary_event(
+        self,
+        element_id: Optional[str] = None,
+        *,
+        attached_to: Optional[str] = None,
+        duration_ms: Optional[int] = None,
+        message_name: Optional[str] = None,
+        correlation_key: str = "",
+        interrupting: bool = True,
+    ) -> "ProcessBuilder":
+        """Attach a boundary event to an activity (the cursor by default).
+        The cursor moves onto the boundary event, so the next builder call
+        chains the boundary flow; use ``move_to(activity)`` to return to
+        the main path (reference builder: ``boundaryEvent`` +
+        ``moveToActivity``)."""
+        host = (
+            self.model.element(attached_to)
+            if attached_to is not None
+            else self._cursor
+        )
+        if not isinstance(host, (ServiceTask, SubProcess, ReceiveTask)):
+            raise ValueError(
+                "boundary events attach to service tasks, receive tasks or sub-processes"
+            )
+        if (duration_ms is None) == (message_name is None):
+            raise ValueError("boundary event needs exactly one of duration_ms / message_name")
+        msg = None
+        if message_name is not None:
+            msg = MessageDefinition(name=message_name, correlation_key=correlation_key)
+            self.model.messages[message_name] = msg
+        node = BoundaryEvent(
+            id=element_id or self._gen_id(f"boundary-{host.id}"),
+            attached_to_id=host.id,
+            cancel_activity=interrupting,
+            timer_duration_ms=duration_ms,
+            message=msg,
+        )
+        node.scope_id = host.scope_id
+        self.model.add(node)
+        self._cursor = node
+        return self
 
     # -- branching ---------------------------------------------------------
     def branch(self, condition: Optional[str] = None, default: bool = False) -> "BranchBuilder":

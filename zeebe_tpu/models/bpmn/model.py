@@ -30,6 +30,7 @@ class ElementType(enum.IntEnum):
     INTERMEDIATE_CATCH_EVENT = 7
     SUB_PROCESS = 8
     RECEIVE_TASK = 9
+    BOUNDARY_EVENT = 10
 
 
 @dataclasses.dataclass
@@ -140,7 +141,44 @@ class ReceiveTask(FlowNode):
 
 
 @dataclasses.dataclass
+class BoundaryEvent(FlowNode):
+    """An event attached to an activity's boundary (reference model:
+    ``bpmn-model/.../instance/BoundaryEvent.java`` + cancelActivity
+    attribute). Timer or message triggered; interrupting
+    (``cancel_activity=True``) terminates the host activity before the
+    token continues on the boundary flow."""
+
+    attached_to_id: str = ""
+    cancel_activity: bool = True  # interrupting by default (BPMN spec)
+    message: Optional[MessageDefinition] = None
+    timer_duration_ms: Optional[int] = None
+
+    def __post_init__(self):
+        self.element_type = ElementType.BOUNDARY_EVENT
+
+
+@dataclasses.dataclass
+class MultiInstanceLoopCharacteristics:
+    """Reference model:
+    ``bpmn-model/.../instance/MultiInstanceLoopCharacteristics.java``.
+    Parallel multi-instance: the activity body runs once per item of the
+    input collection (JSONPath into the payload) or ``cardinality`` times;
+    ``input_element`` names the per-iteration variable."""
+
+    input_collection: str = ""  # JSONPath to an array in the payload
+    input_element: str = "item"  # variable holding collection[i]
+    cardinality: Optional[int] = None  # fixed N (used when no collection)
+    output_collection: str = ""  # variable collecting per-iteration results
+    # JSONPath into each finished iteration's payload whose value is
+    # appended (in loopCounter order) to output_collection; defaults to
+    # the input element variable
+    output_element: str = ""
+
+
+@dataclasses.dataclass
 class SubProcess(FlowNode):
+    multi_instance: Optional[MultiInstanceLoopCharacteristics] = None
+
     def __post_init__(self):
         self.element_type = ElementType.SUB_PROCESS
 
@@ -173,6 +211,7 @@ NODE_TYPES = (
     IntermediateCatchEvent,
     ReceiveTask,
     SubProcess,
+    BoundaryEvent,
 )
 
 

@@ -12,6 +12,7 @@ import dataclasses
 from typing import List
 
 from zeebe_tpu.models.bpmn.model import (
+    BoundaryEvent,
     BpmnModel,
     ExclusiveGateway,
     FlowNode,
@@ -72,6 +73,17 @@ def validate_model(model: BpmnModel) -> List[ValidationError]:
                         element.id, "sub-process must have exactly one start event"
                     )
                 )
+            mi = element.multi_instance
+            if mi is not None and not mi.input_collection and not (
+                mi.cardinality is not None and mi.cardinality > 0
+            ):
+                errors.append(
+                    ValidationError(
+                        element.id,
+                        "multi-instance activity must have an input collection "
+                        "or a positive cardinality",
+                    )
+                )
         elif isinstance(element, ExclusiveGateway):
             for flow in element.outgoing:
                 if (
@@ -101,6 +113,32 @@ def validate_model(model: BpmnModel) -> List[ValidationError]:
                         element.id, "message subscription must have a correlation key"
                     )
                 )
+        elif isinstance(element, BoundaryEvent):
+            host = model.elements.get(element.attached_to_id)
+            if not isinstance(host, (ServiceTask, SubProcess, ReceiveTask)):
+                errors.append(
+                    ValidationError(
+                        element.id,
+                        "boundary event must be attached to a service task, "
+                        "receive task or sub-process",
+                    )
+                )
+            has_timer = element.timer_duration_ms is not None
+            has_msg = element.message is not None
+            if has_timer == has_msg:
+                errors.append(
+                    ValidationError(
+                        element.id,
+                        "boundary event must have exactly one of a timer or "
+                        "message definition",
+                    )
+                )
+            elif has_msg and not element.message.correlation_key:
+                errors.append(
+                    ValidationError(
+                        element.id, "message subscription must have a correlation key"
+                    )
+                )
         elif isinstance(element, SequenceFlow):
             if element.condition_expression is not None:
                 try:
@@ -108,7 +146,11 @@ def validate_model(model: BpmnModel) -> List[ValidationError]:
                 except ConditionParseError as e:
                     errors.append(ValidationError(element.id, str(e)))
 
-        if isinstance(element, FlowNode) and not isinstance(element, StartEvent):
+        if isinstance(element, FlowNode) and not isinstance(
+            element, (StartEvent, BoundaryEvent)
+        ):
+            # boundary events have no incoming flow: the token arrives via
+            # the trigger, not a sequence flow
             if not element.incoming and element.scope_id:
                 errors.append(
                     ValidationError(element.id, "flow node has no incoming sequence flow")

@@ -14,6 +14,7 @@ import xml.etree.ElementTree as ET
 from typing import Dict, Optional, Union
 
 from zeebe_tpu.models.bpmn.model import (
+    BoundaryEvent,
     BpmnModel,
     EndEvent,
     ExclusiveGateway,
@@ -21,6 +22,7 @@ from zeebe_tpu.models.bpmn.model import (
     IntermediateCatchEvent,
     Mapping,
     MessageDefinition,
+    MultiInstanceLoopCharacteristics,
     OutputBehavior,
     ParallelGateway,
     Process,
@@ -107,9 +109,27 @@ def _read_scope(model: BpmnModel, scope_el, scope_id: str, messages_by_id) -> No
         elif tag == "receiveTask":
             node = ReceiveTask(id=el_id, name=child.get("name", ""))
             node.message = messages_by_id.get(child.get("messageRef", ""))
+        elif tag == "boundaryEvent":
+            node = BoundaryEvent(
+                id=el_id,
+                name=child.get("name", ""),
+                attached_to_id=child.get("attachedToRef", ""),
+                cancel_activity=child.get("cancelActivity", "true") == "true",
+            )
+            msg_def = child.find(_q("messageEventDefinition"))
+            if msg_def is not None:
+                node.message = messages_by_id.get(msg_def.get("messageRef", ""))
+            timer_def = child.find(_q("timerEventDefinition"))
+            if timer_def is not None:
+                dur = timer_def.find(_q("timeDuration"))
+                if dur is not None and dur.text:
+                    node.timer_duration_ms = _parse_iso_duration_ms(dur.text.strip())
         elif tag == "subProcess":
             node = SubProcess(id=el_id, name=child.get("name", ""))
             node.scope_id = scope_id
+            mi_el = child.find(_q("multiInstanceLoopCharacteristics"))
+            if mi_el is not None:
+                node.multi_instance = _read_multi_instance(mi_el)
             model.add(node)
             _read_io_mappings(child, node)
             _read_scope(model, child, el_id, messages_by_id)
@@ -136,6 +156,25 @@ def _read_scope(model: BpmnModel, scope_el, scope_id: str, messages_by_id) -> No
     for flow in flows:
         model.add(flow)
         model.connect(flow)
+
+
+def _read_multi_instance(mi_el) -> MultiInstanceLoopCharacteristics:
+    """<multiInstanceLoopCharacteristics> with the zeebe loop-definition
+    extension (inputCollection/inputElement/outputCollection) or a
+    <loopCardinality> child."""
+    mi = MultiInstanceLoopCharacteristics()
+    card = mi_el.find(_q("loopCardinality"))
+    if card is not None and card.text:
+        mi.cardinality = int(card.text.strip())
+    ext = mi_el.find(_q("extensionElements"))
+    if ext is not None:
+        loop_def = ext.find(_q("loopCharacteristics", ZEEBE_NS))
+        if loop_def is not None:
+            mi.input_collection = loop_def.get("inputCollection", "")
+            mi.input_element = loop_def.get("inputElement", "item") or "item"
+            mi.output_collection = loop_def.get("outputCollection", "")
+            mi.output_element = loop_def.get("outputElement", "")
+    return mi
 
 
 def _read_task_extensions(task_el, node: ServiceTask) -> None:
@@ -264,8 +303,35 @@ def _write_scope(model: BpmnModel, scope_el, scope_id: str, msg_ids) -> None:
             el = ET.SubElement(scope_el, _q("receiveTask"))
             if node.message is not None:
                 el.set("messageRef", msg_ids.get(node.message.name, ""))
+        elif isinstance(node, BoundaryEvent):
+            el = ET.SubElement(scope_el, _q("boundaryEvent"))
+            el.set("attachedToRef", node.attached_to_id)
+            el.set("cancelActivity", "true" if node.cancel_activity else "false")
+            if node.message is not None:
+                md = ET.SubElement(el, _q("messageEventDefinition"))
+                md.set("messageRef", msg_ids.get(node.message.name, ""))
+            if node.timer_duration_ms is not None:
+                td = ET.SubElement(el, _q("timerEventDefinition"))
+                dur = ET.SubElement(td, _q("timeDuration"))
+                dur.text = _format_iso_duration(node.timer_duration_ms)
         elif isinstance(node, SubProcess):
             el = ET.SubElement(scope_el, _q("subProcess"))
+            if node.multi_instance is not None:
+                mi = node.multi_instance
+                mi_el = ET.SubElement(el, _q("multiInstanceLoopCharacteristics"))
+                if mi.cardinality is not None:
+                    card = ET.SubElement(mi_el, _q("loopCardinality"))
+                    card.text = str(mi.cardinality)
+                if mi.input_collection or mi.output_collection:
+                    ext = ET.SubElement(mi_el, _q("extensionElements"))
+                    loop_def = ET.SubElement(ext, _q("loopCharacteristics", ZEEBE_NS))
+                    if mi.input_collection:
+                        loop_def.set("inputCollection", mi.input_collection)
+                        loop_def.set("inputElement", mi.input_element)
+                    if mi.output_collection:
+                        loop_def.set("outputCollection", mi.output_collection)
+                    if mi.output_element:
+                        loop_def.set("outputElement", mi.output_element)
             _write_scope(model, el, node.id, msg_ids)
         else:
             continue

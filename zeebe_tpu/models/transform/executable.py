@@ -56,6 +56,21 @@ class ExecutableFlowElement:
     # timer catch
     timer_duration_ms: Optional[int] = None
 
+    # boundary events (reference BoundaryEvent.java + cancelActivity)
+    attached_to: Optional["ExecutableFlowElement"] = None
+    boundary_events: List["ExecutableFlowElement"] = dataclasses.field(
+        default_factory=list
+    )
+    cancel_activity: bool = True
+
+    # multi-instance (reference MultiInstanceLoopCharacteristics.java)
+    mi_input_collection: str = ""
+    mi_input_element: str = ""
+    mi_cardinality: Optional[int] = None
+    mi_output_collection: str = ""
+    mi_output_element: str = ""
+    is_multi_instance: bool = False
+
     def bind(self, state: WorkflowInstanceIntent, step: BpmnStep) -> None:
         # Reference: ExecutableFlowElement.bindLifecycleState
         self.steps[state] = step

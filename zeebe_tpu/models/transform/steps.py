@@ -51,6 +51,10 @@ class BpmnStep(enum.IntEnum):
     PARALLEL_MERGE = 19
     CREATE_TIMER = 20
     TERMINATE_CATCH_EVENT = 21
+    # multi-instance activation: spawn one body instance per item
+    # (reference model MultiInstanceLoopCharacteristics; the reference
+    # engine never executes it)
+    MULTI_INSTANCE_SPLIT = 22
 
 
 STEP_COUNT = len(BpmnStep)

@@ -28,6 +28,7 @@ from __future__ import annotations
 from typing import List
 
 from zeebe_tpu.models.bpmn.model import (
+    BoundaryEvent,
     BpmnModel,
     ElementType,
     EndEvent,
@@ -103,6 +104,22 @@ def _transform_process(model: BpmnModel, process: Process) -> ExecutableWorkflow
                 el.correlation_key_path = node.message.correlation_key
             if isinstance(node, IntermediateCatchEvent):
                 el.timer_duration_ms = node.timer_duration_ms
+        if isinstance(node, BoundaryEvent):
+            el.cancel_activity = node.cancel_activity
+            el.timer_duration_ms = node.timer_duration_ms
+            if node.message is not None:
+                el.message_name = node.message.name
+                el.correlation_key_path = node.message.correlation_key
+        if isinstance(node, SubProcess) and node.multi_instance is not None:
+            mi = node.multi_instance
+            el.is_multi_instance = True
+            el.mi_input_collection = mi.input_collection
+            el.mi_input_element = mi.input_element or "item"
+            el.mi_cardinality = mi.cardinality
+            el.mi_output_collection = mi.output_collection
+            el.mi_output_element = (
+                mi.output_element or f"$.{el.mi_input_element}"
+            )
         workflow.add(el)
 
     for flow in flows:
@@ -171,8 +188,21 @@ def _transform_process(model: BpmnModel, process: Process) -> ExecutableWorkflow
                 el.bind(WI.ELEMENT_TERMINATING, BpmnStep.TERMINATE_ELEMENT)
         elif isinstance(node, SubProcess):
             _bind_activity(el, outgoing_step)
-            el.bind(WI.ELEMENT_ACTIVATED, BpmnStep.TRIGGER_START_EVENT)
+            el.bind(
+                WI.ELEMENT_ACTIVATED,
+                BpmnStep.MULTI_INSTANCE_SPLIT
+                if el.is_multi_instance
+                else BpmnStep.TRIGGER_START_EVENT,
+            )
             el.bind(WI.ELEMENT_TERMINATING, BpmnStep.TERMINATE_CONTAINED_INSTANCES)
+        elif isinstance(node, BoundaryEvent):
+            # the boundary event itself only carries the continuation: the
+            # token appears at it via BOUNDARY_EVENT_OCCURRED after the
+            # trigger (and, when interrupting, the host's termination)
+            el.bind(WI.BOUNDARY_EVENT_OCCURRED, outgoing_step)
+            host = workflow.by_id[node.attached_to_id]
+            el.attached_to = host
+            host.boundary_events.append(el)
 
     # sequence flow steps (reference SequenceFlowHandler.bindLifecycle,
     # extended with parallel-gateway targets)

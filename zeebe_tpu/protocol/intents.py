@@ -37,6 +37,13 @@ class WorkflowInstanceIntent(enum.IntEnum):
     UPDATE_PAYLOAD = 14
     PAYLOAD_UPDATED = 15
 
+    # TPU-native extension: a boundary event attached to an activity fired
+    # (the reference model defines BoundaryEvent —
+    # bpmn-model/.../instance/BoundaryEvent.java — but its tech-preview
+    # engine never executes one; this engine does, so the token needs a
+    # lifecycle event to continue from)
+    BOUNDARY_EVENT_OCCURRED = 16
+
 
 # Lifecycle state sets.
 # Reference: broker-core/.../workflow/processor/WorkflowInstanceLifecycle.java

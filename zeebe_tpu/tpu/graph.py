@@ -37,7 +37,7 @@ from zeebe_tpu.protocol.intents import WorkflowInstanceIntent as WI
 from zeebe_tpu.tpu.conditions import DeviceIneligible, ProgramPool
 from zeebe_tpu.tpu.intern import InternTable
 
-NUM_WI_INTENTS = 16
+NUM_WI_INTENTS = 17  # includes the BOUNDARY_EVENT_OCCURRED extension
 
 _DEVICE_ELEMENT_TYPES = {
     ElementType.PROCESS,
@@ -189,6 +189,10 @@ def check_device_compatible(workflow: ExecutableWorkflow) -> Optional[str]:
                 return f"element type {el.element_type.name} ({el.id})"
             if el.message_name:
                 return f"message catch event ({el.id}) — host-only in this round"
+            if el.is_multi_instance:
+                return f"multi-instance activity ({el.id}) — host-only in this round"
+            if el.boundary_events:
+                return f"boundary events on {el.id} — host-only in this round"
             _compile_mappings(varspace, el.input_mappings, f"input mapping of {el.id}")
             _compile_mappings(varspace, el.output_mappings, f"output mapping of {el.id}")
             if el.condition is not None:
