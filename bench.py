@@ -23,10 +23,20 @@ import time
 import numpy as np
 
 
-def build_graph():
-    from zeebe_tpu.models.bpmn.builder import Bpmn
+def _compile(model):
     from zeebe_tpu.models.transform.transformer import transform_model
     from zeebe_tpu.tpu import graph as graph_mod
+
+    workflows = transform_model(model)
+    for wf in workflows:
+        wf.key = 9
+        wf.version = 1
+    return graph_mod.compile_graph(workflows)
+
+
+def build_graph():
+    """Config 1: single service-task sequence (order-process)."""
+    from zeebe_tpu.models.bpmn.builder import Bpmn
 
     model = (
         Bpmn.create_process("order-process")
@@ -35,11 +45,45 @@ def build_graph():
         .end_event("end")
         .done()
     )
-    workflows = transform_model(model)
-    for i, wf in enumerate(workflows):
-        wf.key = 9
-        wf.version = 1
-    return graph_mod.compile_graph(workflows)
+    return _compile(model)
+
+
+def build_graph_xor():
+    """Config 2: exclusive-gateway 2-way split/merge with json-el
+    conditions (BASELINE.json configs[1])."""
+    from zeebe_tpu.models.bpmn.builder import Bpmn
+
+    builder = (
+        Bpmn.create_process("xor-process")
+        .start_event("start")
+        .exclusive_gateway("split")
+    )
+    builder.branch('$.orderValue > 50').service_task(
+        "big", type="payment-service"
+    ).end_event("end-big")
+    builder.branch(default=True).service_task(
+        "small", type="payment-service"
+    ).end_event("end-small")
+    return _compile(builder.done())
+
+
+def build_graph_forkjoin():
+    """Config 3: parallel-gateway fork/join (BASELINE.json configs[2])."""
+    from zeebe_tpu.models.bpmn.builder import Bpmn
+    from zeebe_tpu.models.bpmn.model import ParallelGateway
+
+    builder = (
+        Bpmn.create_process("fork-process")
+        .start_event("start")
+        .parallel_gateway("fork")
+    )
+    join = ParallelGateway(id="join")
+    join.scope_id = "fork-process"
+    builder.model.add(join)
+    builder.branch().service_task("task-a", type="payment-service").connect_to("join")
+    builder.branch().service_task("task-b", type="payment-service").connect_to("join")
+    builder.move_to("join").end_event("end")
+    return _compile(builder.done())
 
 
 def stage_creates(meta, wave, num_vars, interns):
@@ -73,51 +117,214 @@ def stage_creates(meta, wave, num_vars, interns):
     )
 
 
-def main():
-    import os
-    import sys
+def run_host_config(label, build_model, drive_fn, n_instances=512):
+    """Host-oracle engine bench for configs the device demotes this round
+    (message/boundary correlation, multi-instance). Measures the actual
+    serving interpreter: records processed per second through the broker
+    hot loop."""
+    import tempfile
+    import time as _time
 
-    def _progress(msg):
-        if os.environ.get("BENCH_PROGRESS"):
-            print(msg, file=sys.stderr, flush=True)
+    from zeebe_tpu.gateway import JobWorker, ZeebeClient
+    from zeebe_tpu.runtime import Broker, ControlledClock
 
-    from zeebe_tpu import tpu as _tpu  # noqa: F401  (enables x64)
+    clock = ControlledClock(start_ms=1_000_000)
+    broker = Broker(
+        num_partitions=1, data_dir=tempfile.mkdtemp(), clock=clock
+    )
+    try:
+        client = ZeebeClient(broker)
+        client.deploy_model(build_model())
+        JobWorker(broker, "bench-service", lambda ctx: {})
+        t0 = _time.perf_counter()
+        drive_fn(client, broker, clock, n_instances)
+        elapsed = _time.perf_counter() - t0
+        records = sum(1 for _ in broker.records(0))
+        return {
+            "config": label,
+            "engine": "host",
+            "instances": n_instances,
+            "records": records,
+            "elapsed_sec": round(elapsed, 3),
+            "transitions_per_sec": round(records / elapsed, 1),
+        }
+    finally:
+        broker.close()
+
+
+def _config4_model():
+    """Message catch + interrupting timer boundary (BASELINE configs[3])."""
+    from zeebe_tpu.models.bpmn.builder import Bpmn
+
+    return (
+        Bpmn.create_process("c4")
+        .start_event("start")
+        .receive_task("wait-pay", message_name="paid", correlation_key="$.oid")
+        .boundary_event("deadline", duration_ms=30_000)
+        .end_event("expired")
+        .move_to("wait-pay")
+        .end_event("done")
+        .done()
+    )
+
+
+def _config4_drive(client, broker, clock, n):
+    for i in range(n):
+        client.create_instance("c4", {"oid": f"o-{i}"})
+    broker.run_until_idle()
+    # correlate half, let the boundary timer fire for the other half
+    for i in range(0, n, 2):
+        client.publish_message("paid", f"o-{i}", {"paid": True})
+    broker.run_until_idle()
+    clock.advance(31_000)
+    broker.tick()
+    broker.run_until_idle()
+
+
+def _config5_model():
+    """Multi-instance subprocess (BASELINE configs[4])."""
+    from zeebe_tpu.models.bpmn.builder import Bpmn
+
+    builder = Bpmn.create_process("c5")
+    sub = builder.start_event("start").sub_process(
+        "each", multi_instance={"cardinality": 4}
+    )
+    sub.start_event("s").service_task("work", type="bench-service").end_event("e")
+    return sub.embedded_done().end_event("done").done()
+
+
+def _config5_drive(client, broker, clock, n):
+    for i in range(n):
+        client.create_instance("c5", {"batch": i})
+    broker.run_until_idle()
+
+
+def run_serving_path(n_instances=2048, engine="tpu", threads=8):
+    """The PRODUCT path, not the kernel: client → TCP → log append →
+    commit → partition engine → worker push → job complete → responses
+    (reference hot loop spans ClientApiMessageHandler.java:90-165 →
+    processors → responders). Quantifies host-side overhead around the
+    device kernel."""
+    import tempfile
+    import threading as _threading
+    import time as _time
+
+    from zeebe_tpu.gateway.cluster_client import ClusterClient
+    from zeebe_tpu.models.bpmn.builder import Bpmn
+    from zeebe_tpu.runtime.cluster_broker import ClusterBroker
+    from zeebe_tpu.runtime.config import BrokerCfg
+    from zeebe_tpu.runtime.engines import engine_factory_from_config
+
+    cfg = BrokerCfg()
+    cfg.network.client_port = 0
+    cfg.network.management_port = 0
+    cfg.network.subscription_port = 0
+    cfg.metrics.port = 0
+    cfg.metrics.enabled = False
+    cfg.engine.type = engine
+    cfg.engine.capacity = max(4096, 2 * n_instances)
+    broker = ClusterBroker(
+        cfg, tempfile.mkdtemp(),
+        engine_factory=engine_factory_from_config(cfg),
+    )
+    try:
+        broker.open_partition(0).join(30)
+        broker.bootstrap_partition(0, {})
+        deadline = _time.time() + 30
+        while _time.time() < deadline and not broker.partitions[0].is_leader:
+            _time.sleep(0.02)
+        client = ClusterClient(
+            [broker.client_address], num_partitions=1,
+            request_timeout_ms=300_000,
+        )
+        try:
+            model = (
+                Bpmn.create_process("serve-bench")
+                .start_event()
+                .service_task("work", type="payment-service")
+                .end_event()
+                .done()
+            )
+            client.deploy_model(model)
+            done = []
+            worker = client.open_job_worker(
+                "payment-service",
+                lambda pid, rec: done.append(rec.key) or {},
+                credits=256,
+            )
+            # warm the kernel compile outside the timed window
+            client.create_instance("serve-bench", payload={"w": 1})
+            t_w = _time.time() + 240
+            while _time.time() < t_w and not done:
+                _time.sleep(0.05)
+
+            t0 = _time.perf_counter()
+
+            def pump(k):
+                for _ in range(n_instances // threads):
+                    client.create_instance("serve-bench", payload={"k": k})
+
+            ts = [
+                _threading.Thread(target=pump, args=(k,)) for k in range(threads)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            total = (n_instances // threads) * threads + 1
+            t_done = _time.time() + 300
+            while _time.time() < t_done and len(done) < total:
+                _time.sleep(0.05)
+            elapsed = _time.perf_counter() - t0
+            worker.close()
+            records = broker.partitions[0].log.next_position
+            return {
+                "config": "serving-path-1-service-task",
+                "engine": engine,
+                "instances": total,
+                "completed_jobs": len(done),
+                "records": int(records),
+                "elapsed_sec": round(elapsed, 3),
+                "transitions_per_sec": round(int(records) / elapsed, 1),
+                "instances_per_sec": round(total / elapsed, 1),
+            }
+        finally:
+            client.close()
+    finally:
+        broker.close()
+
+
+def run_device_config(build_fn, label, total_instances, wave, progress):
+    """One device-engine bench: stage CREATE waves, drive to quiescence
+    with synthetic workers, count transitions."""
+    import dataclasses as _dc
+    import time as _time
+
     import jax
     import jax.numpy as jnp
 
     from zeebe_tpu.tpu import drive, hashmap, state as state_mod
 
-    backend = jax.default_backend()
-    accel = backend not in ("cpu",)
-    # wave sizing: the drive loop runs entirely on device (lax.while_loop),
-    # so throughput saturates well below huge waves; 2^14 keeps XLA's
-    # compile of the loop program fast (~40s) — larger waves blow up the
-    # TPU backend's compile time on the in-loop compaction scans
-    total_instances = 1 << 20 if accel else 1 << 12
-    wave = 1 << 14 if accel else 1 << 10
     batch_size = wave
     capacity = 4 * wave
-
-    graph, meta = build_graph()
+    graph, meta = build_fn()
     meta.varspace.column("orderId")
     meta.varspace.column("orderValue")
     meta.varspace.column("paid")
     num_vars = max(graph.num_vars, 8)
-    graph = dataclasses.replace(graph, num_vars=num_vars)
+    graph = _dc.replace(graph, num_vars=num_vars)
 
     state = state_mod.make_state(
         capacity=capacity,
         num_vars=num_vars,
         job_capacity=capacity,
+        join_capacity=capacity,
         sub_capacity=8,
     )
-    # one worker subscription with unbounded credits
-    state = dataclasses.replace(
+    state = _dc.replace(
         state,
         sub_key=state.sub_key.at[0].set(1),
-        sub_type=state.sub_type.at[0].set(
-            meta.interns.intern("payment-service")
-        ),
+        sub_type=state.sub_type.at[0].set(meta.interns.intern("payment-service")),
         sub_worker=state.sub_worker.at[0].set(meta.interns.intern("bench-worker")),
         sub_credits=state.sub_credits.at[0].set(np.int32(2**31 - 1)),
         sub_timeout=state.sub_timeout.at[0].set(300_000),
@@ -127,7 +334,7 @@ def main():
     creates = stage_creates(meta, wave, num_vars, meta.interns)
     enqueue_jit = jax.jit(drive.enqueue, donate_argnums=(0,))
     rebuild_jit = jax.jit(
-        lambda st: dataclasses.replace(
+        lambda st: _dc.replace(
             st,
             ei_map=hashmap.rebuild_from(
                 st.ei_map.keys.shape[0],
@@ -152,24 +359,17 @@ def main():
             sync=sync,
         )
 
-    # warmup wave: compiles the kernel, populates caches
-    _progress("compiling warmup wave...")
+    progress(f"[{label}] compiling warmup wave...")
     state, queue, warm = run_wave(state, queue)
-    _progress("warmup wave done; compiling rebuild...")
     state = rebuild_jit(state)
-    _progress("rebuild done; timing waves...")
+    progress(f"[{label}] timing...")
 
     waves = max(total_instances // wave - 1, 1)
-    # tombstone budget: each wave retires ~2 element instances + 1 job per
-    # created instance; at map capacity 16x wave a rebuild every 3rd wave
-    # keeps live+dead load under hashmap.REBUILD_LOAD with margin
     rebuild_every = 3
-    # totals accumulate as device scalars: zero host round trips inside the
-    # timed loop, one device_get at the end
     processed_dev = jnp.zeros((), jnp.int64)
     completed_dev = jnp.zeros((), jnp.int64)
     overflow_dev = jnp.zeros((), bool)
-    t0 = time.perf_counter()
+    t0 = _time.perf_counter()
     for i in range(waves):
         state, queue, totals = run_wave(state, queue, sync=False)
         processed_dev = processed_dev + totals["processed"]
@@ -178,29 +378,125 @@ def main():
         if (i + 1) % rebuild_every == 0:
             state = rebuild_jit(state)
         if i % 16 == 0:
-            _progress(f"wave {i}/{waves} dispatched")
+            progress(f"[{label}] wave {i}/{waves}")
     jax.block_until_ready(state.ei_state)
-    elapsed = time.perf_counter() - t0
+    elapsed = _time.perf_counter() - t0
 
-    host = jax.device_get({"p": processed_dev, "c": completed_dev, "o": overflow_dev})
+    host = jax.device_get(
+        {"p": processed_dev, "c": completed_dev, "o": overflow_dev}
+    )
     processed, completed = int(host["p"]), int(host["c"])
-    assert not bool(host["o"]), "device table overflow"
-    assert completed == waves * wave, (completed, waves * wave)
-    tps = processed / elapsed
+    assert not bool(host["o"]), f"{label}: device table overflow"
+    assert completed == waves * wave, (label, completed, waves * wave)
+    return {
+        "config": label,
+        "engine": "tpu-kernel",
+        "instances": waves * wave,
+        "records": processed,
+        "elapsed_sec": round(elapsed, 3),
+        "wave": wave,
+        "transitions_per_instance": round(processed / (waves * wave), 1),
+        "transitions_per_sec": round(processed / elapsed, 1),
+    }
+
+
+def main():
+    import os
+    import sys
+
+    def _progress(msg):
+        if os.environ.get("BENCH_PROGRESS"):
+            print(msg, file=sys.stderr, flush=True)
+
+    from zeebe_tpu import tpu as _tpu  # noqa: F401  (enables x64)
+    import jax
+
+    # honor JAX_PLATFORMS even where a sitecustomize pre-injects another
+    # platform plugin (same contract as the broker launcher)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    backend = jax.default_backend()
+    accel = backend not in ("cpu",)
+    # wave sizing: the drive loop runs entirely on device (lax.while_loop),
+    # so throughput saturates well below huge waves; 2^14 keeps XLA's
+    # compile of the loop program fast — larger waves blow up the TPU
+    # backend's compile time on the in-loop compaction scans
+    total_instances = 1 << 20 if accel else 1 << 12
+    wave = 1 << 14 if accel else 1 << 10
+
+    # headline: config 1 (the north-star number the driver records)
+    c1 = run_device_config(build_graph, "1-service-task", total_instances, wave, _progress)
+
+    configs = [c1]
+    if os.environ.get("BENCH_CONFIGS", "all") != "headline":
+        side_total = max(total_instances // 4, wave * 2)
+        try:
+            configs.append(
+                run_device_config(
+                    build_graph_xor, "2-xor-split-merge", side_total, wave, _progress
+                )
+            )
+        except Exception as e:  # noqa: BLE001 - report, keep the matrix going
+            configs.append({"config": "2-xor-split-merge", "error": str(e)[:200]})
+        try:
+            configs.append(
+                run_device_config(
+                    build_graph_forkjoin, "3-parallel-fork-join", side_total, wave,
+                    _progress,
+                )
+            )
+        except Exception as e:  # noqa: BLE001
+            configs.append({"config": "3-parallel-fork-join", "error": str(e)[:200]})
+        # configs 4-5 exercise message/boundary correlation and
+        # multi-instance — host-engine-served this round (the device graph
+        # demotes those workflows); numbers are the oracle interpreter's
+        try:
+            configs.append(
+                run_host_config(
+                    "4-message-timer-boundary", _config4_model, _config4_drive,
+                    n_instances=1024 if accel else 128,
+                )
+            )
+        except Exception as e:  # noqa: BLE001
+            configs.append({"config": "4-message-timer-boundary", "error": str(e)[:200]})
+        try:
+            configs.append(
+                run_host_config(
+                    "5-multi-instance-subprocess", _config5_model, _config5_drive,
+                    n_instances=1024 if accel else 128,
+                )
+            )
+        except Exception as e:  # noqa: BLE001
+            configs.append({"config": "5-multi-instance-subprocess", "error": str(e)[:200]})
+        # the full serving path (client → log → commit → device engine →
+        # responses) — quantifies host overhead around the kernel number
+        try:
+            configs.append(
+                run_serving_path(
+                    n_instances=4096 if accel else 256,
+                    engine="tpu",
+                )
+            )
+        except Exception as e:  # noqa: BLE001
+            configs.append({"config": "serving-path-1-service-task", "error": str(e)[:200]})
+
+    tps = c1["transitions_per_sec"]
     print(
         json.dumps(
             {
                 "metric": "bpmn_token_transitions_per_sec",
-                "value": round(tps, 1),
+                "value": tps,
                 "unit": "transitions/sec",
                 "vs_baseline": round(tps / 10e6, 4),
                 "detail": {
                     "backend": backend,
-                    "instances": waves * wave,
-                    "records": processed,
-                    "elapsed_sec": round(elapsed, 3),
-                    "wave": wave,
-                    "transitions_per_instance": round(processed / (waves * wave), 1),
+                    "instances": c1["instances"],
+                    "records": c1["records"],
+                    "elapsed_sec": c1["elapsed_sec"],
+                    "wave": c1.get("wave"),
+                    "transitions_per_instance": c1.get("transitions_per_instance"),
+                    "configs": configs,
                 },
             }
         )

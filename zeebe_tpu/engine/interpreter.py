@@ -960,17 +960,18 @@ class PartitionEngine:
             # container payload; per-iteration outputs are collected in
             # loopCounter order instead
             if scope_el.mi_output_collection:
-                # keyed by loopCounter when the iteration payload still
-                # carries it; a job result that replaced the payload
-                # (reference semantics: the job payload becomes the task
-                # payload) falls back to completion order
-                counter = value.payload.get("loopCounter")
-                if not isinstance(counter, int):
-                    counter = max(scope.mi_outputs, default=0) + 1
+                # keyed by COMPLETION order (log order — deterministic and
+                # replay-stable). loopCounter cannot key the collection: a
+                # job result replaces the iteration payload (reference
+                # semantics), dropping it for some iterations, and a
+                # mixed keyspace would let a surviving loopCounter collide
+                # with an order-assigned key and silently drop an output
                 found, extracted = query_json_path(
                     value.payload, scope_el.mi_output_element
                 )
-                scope.mi_outputs[counter] = extracted if found else None
+                scope.mi_outputs[len(scope.mi_outputs) + 1] = (
+                    extracted if found else None
+                )
         else:
             scope_value.payload = dict(value.payload)
         scope.active_tokens -= 1
@@ -1243,8 +1244,17 @@ class PartitionEngine:
             n = int(element.mi_cardinality or 0)
         if n <= 0:
             # empty collection: the multi-instance body never runs and the
-            # container completes immediately
-            self._write_wi_followup(out, record, record.key, WI.ELEMENT_COMPLETING, value)
+            # container completes immediately — with an EMPTY output
+            # collection, so downstream readers of the variable see []
+            done_value = value
+            if element.mi_output_collection:
+                done_value = value.copy()
+                payload = dict(done_value.payload)
+                payload[element.mi_output_collection] = []
+                done_value.payload = payload
+            self._write_wi_followup(
+                out, record, record.key, WI.ELEMENT_COMPLETING, done_value
+            )
             return
         if container is not None:
             container.active_tokens = n

@@ -38,6 +38,7 @@ from zeebe_tpu.protocol.intents import (
 )
 from zeebe_tpu.tpu import batch as rb
 from zeebe_tpu.tpu import hashmap
+from zeebe_tpu.tpu import pallas_ops as pops
 from zeebe_tpu.tpu.batch import RecordBatch
 from zeebe_tpu.tpu.conditions import ERROR as TRI_ERROR
 from zeebe_tpu.tpu.conditions import TRUE as TRI_TRUE
@@ -120,10 +121,59 @@ def _last_writer(slots, mask, size):
 
 def _scatter_pay(pay, slots, mask, b_pay, size):
     """Write packed batch payload rows ([B, 3V] i32) into table rows at
-    ``slots`` (last writer wins) — ONE scatter for the whole payload."""
+    ``slots`` (last writer wins). The explicit last-writer dedup keeps the
+    XLA fallback deterministic on duplicate slots (XLA duplicate-index
+    scatter order is implementation-defined) and matches the pallas
+    path's serial batch order exactly."""
     win = _last_writer(slots, mask, size)
-    idx = jnp.where(win, slots, size)
-    return pay.at[idx].set(b_pay, mode="drop")
+    return pops.masked_row_update(pay, slots, win, b_pay)
+
+
+def _col_update(tbl, slots, active, col, val):
+    """Single-column table update: ``tbl[slot, col] = val`` for active
+    records (replaces ``.at[where(active, slot, cap), col].set``)."""
+    b = slots.shape[0]
+    k = tbl.shape[1]
+    if jnp.ndim(val) == 0:
+        val = jnp.full((b,), val, tbl.dtype)
+    vals = jnp.zeros((b, k), tbl.dtype).at[:, col].set(val)
+    mask = jnp.zeros((b, k), bool).at[:, col].set(True)
+    return pops.masked_row_update(tbl, slots, active, vals, mask)
+
+
+def _cols_update(tbl, slots, active, cols, col_vals):
+    """Multi-column variant: cols is a static tuple, col_vals matching
+    [B]-vectors (or scalars)."""
+    b = slots.shape[0]
+    k = tbl.shape[1]
+    vals = jnp.zeros((b, k), tbl.dtype)
+    mask = jnp.zeros((b, k), bool)
+    for col, val in zip(cols, col_vals):
+        if jnp.ndim(val) == 0:
+            val = jnp.full((b,), val, tbl.dtype)
+        vals = vals.at[:, col].set(val.astype(tbl.dtype))
+        mask = mask.at[:, col].set(True)
+    return pops.masked_row_update(tbl, slots, active, vals, mask)
+
+
+def _col64_update(planes, slots, active, col, val64):
+    """Single-i64-column update on a planes view ([N, 2C] i32)."""
+    b = slots.shape[0]
+    if jnp.ndim(val64) == 0:
+        val64 = jnp.full((b,), val64, jnp.int64)
+    v2 = pops.vec64_to_planes(val64.astype(jnp.int64))
+    k = planes.shape[1]
+    vals = (
+        jnp.zeros((b, k), jnp.int32)
+        .at[:, 2 * col].set(v2[:, 0])
+        .at[:, 2 * col + 1].set(v2[:, 1])
+    )
+    mask = (
+        jnp.zeros((b, k), bool)
+        .at[:, 2 * col].set(True)
+        .at[:, 2 * col + 1].set(True)
+    )
+    return pops.masked_row_update(planes, slots, active, vals, mask)
 
 
 def _apply_mappings(graph, wf, elem, src_vt, src_num, src_sid, is_input):
@@ -213,7 +263,7 @@ def step_kernel(
     # activity key) probe the same table — ONE batched probe loop over the
     # concatenated keys costs the same gather volume but a third of the
     # serialized loop iterations
-    ei3_found, ei3_slot = hashmap.lookup(
+    ei3_found, ei3_slot = pops.lookup(
         state.ei_map,
         jnp.concatenate([batch.key, batch.scope_key, batch.aux_key]),
         jnp.concatenate(
@@ -223,11 +273,11 @@ def step_kernel(
     ei_found, ei_slot = ei3_found[:b], ei3_slot[:b]
     sc_found, sc_slot = ei3_found[b : 2 * b], ei3_slot[b : 2 * b]
     aik_found, aik_slot = ei3_found[2 * b :], ei3_slot[2 * b :]
-    jb_found, jb_slot = hashmap.lookup(
+    jb_found, jb_slot = pops.lookup(
         state.job_map, batch.key, job_cmd & (batch.key >= 0)
     )
     if graph.has_timers:
-        tm_found, tm_slot = hashmap.lookup(
+        tm_found, tm_slot = pops.lookup(
             state.timer_map, batch.key, timer_cmd & (batch.key >= 0)
         )
     else:
@@ -453,7 +503,7 @@ def step_kernel(
         join_key = jnp.where(
             m_pmerge, (batch.scope_key << jnp.int64(10)) | gw_clip.astype(jnp.int64), -1
         )
-        jn_found, jn_slot = hashmap.lookup(state.join_map, join_key, m_pmerge)
+        jn_found, jn_slot = pops.lookup(state.join_map, join_key, m_pmerge)
         # leaders: first batch occurrence of each missing join key (sort-dedup)
         missing = m_pmerge & ~jn_found
         sort_k = jnp.where(missing, join_key, jnp.int64(2**62))
@@ -468,33 +518,43 @@ def step_kernel(
         l_rank = _excl_cumsum(leader.astype(jnp.int32))
         l_slot = join_free[jnp.clip(l_rank, 0, b - 1)]
         join_overflow = jnp.any(leader & (l_slot >= j_cap))
-        lw = jnp.where(leader, l_slot, j_cap)
-        join_key_arr = state.join_key.at[lw].set(join_key, mode="drop")
+        join_key_arr = pops.masked_vec64_update(
+            state.join_key, l_slot, leader, join_key
+        )
         nin_here = graph.join_nin[wf_c, gw_clip]
-        join_nin_arr = state.join_nin.at[lw].set(nin_here, mode="drop")
-        jmap, jins = hashmap.insert(state.join_map, join_key, l_slot, leader)
+        join_nin_arr = pops.masked_lane_update(
+            state.join_nin, l_slot, leader, nin_here
+        )
+        jmap, jins = pops.insert(state.join_map, join_key, l_slot, leader)
         # re-lookup so every arrival sees its slot
-        jn_found2, jn_slot2 = hashmap.lookup(jmap, join_key, m_pmerge)
+        jn_found2, jn_slot2 = pops.lookup(jmap, join_key, m_pmerge)
         arr_slot = jnp.clip(jn_slot2, 0, j_cap - 1)
         my_pos = graph.join_pos[wf_c, el_c]
-        aw = jnp.where(m_pmerge & jn_found2, arr_slot, j_cap)
-        arrived = state.join_arrived.at[
-            aw, jnp.clip(my_pos, 0, state.join_arrived.shape[1] - 1)
-        ].set(True, mode="drop")
+        arrival = m_pmerge & jn_found2
+        aw = jnp.where(arrival, arr_slot, j_cap)
+        # dynamic column one-hot; arrivals are monotonic so a row MAX
+        # composes concurrent arrivals at the same join slot
+        fcols = jnp.arange(state.join_arrived.shape[1], dtype=jnp.int32)
+        pos_hot = fcols[None, :] == jnp.clip(
+            my_pos, 0, state.join_arrived.shape[1] - 1
+        )[:, None]
+        arrived = pops.masked_row_max(
+            state.join_arrived.astype(jnp.int32), arr_slot, arrival,
+            pos_hot.astype(jnp.int32),
+        ).astype(bool)
         # flow-position-stamped payload merge: higher flow pos wins per variable
-        stamp = state.join_pos_stamp.at[aw].max(
-            jnp.where(src_present, my_pos[:, None], -1), mode="drop"
+        stamp = pops.masked_row_max(
+            state.join_pos_stamp, arr_slot, arrival,
+            jnp.where(src_present, my_pos[:, None], -1),
         )
         win_var = m_pmerge[:, None] & src_present & (
             stamp[jnp.clip(aw, 0, j_cap - 1)] == my_pos[:, None]
         )
         win3 = jnp.concatenate([win_var, win_var, win_var], axis=1)
-        aw_var3 = jnp.where(win3, aw[:, None], j_cap)
-        cols3 = jnp.broadcast_to(
-            jnp.arange(3 * v, dtype=jnp.int32)[None, :], (b, 3 * v)
-        )
         b_pay_join = pack_payload(batch.v_vt, batch.v_str, batch.v_num)
-        join_pay = state.join_pay.at[aw_var3, cols3].set(b_pay_join, mode="drop")
+        join_pay = pops.masked_row_update(
+            state.join_pay, arr_slot, arrival, b_pay_join, win3
+        )
         # completion: all incoming arrived; completer = last arrival in batch
         arr_count = jnp.sum(arrived, axis=1).astype(jnp.int32)
         complete_slot = (join_nin_arr > 0) & (arr_count >= join_nin_arr)
@@ -959,55 +1019,62 @@ def step_kernel(
     # ---------------- state scatters ----------------
     # token counters
     tok_delta = jnp.zeros((n_cap,), jnp.int32)
-    tok_delta = tok_delta.at[jnp.where(m_consume, sc_clip, n_cap)].add(-1, mode="drop")
-    tok_delta = tok_delta.at[jnp.where(m_psplit, sc_clip, n_cap)].add(
-        out_count - 1, mode="drop"
+    tok_delta = pops.masked_lane_accum(
+        tok_delta, sc_clip, m_consume, jnp.full((b,), -1, jnp.int32)
+    )
+    tok_delta = pops.masked_lane_accum(
+        tok_delta, sc_clip, m_psplit, out_count - 1
     )
     nin_rec = join_nin_arr[arr_slot]
-    tok_delta = tok_delta.at[jnp.where(completer, sc_clip, n_cap)].add(
-        -(nin_rec - 1), mode="drop"
+    tok_delta = pops.masked_lane_accum(
+        tok_delta, sc_clip, completer, -(nin_rec - 1)
     )
     ei_i32_arr = state.ei_i32.at[:, EI_TOKENS].add(tok_delta)
-    ei_i32_arr = ei_i32_arr.at[
-        jnp.where(m_trigstart, ei_clip, n_cap), EI_TOKENS
-    ].set(1, mode="drop")
+    ei_i32_arr = _col_update(ei_i32_arr, ei_clip, m_trigstart, EI_TOKENS, 1)
+
+    # i64 columns operate on the planes view until the end of the phase
+    # (TPU i64 is emulated; the pallas kernels take i32 planes)
+    ei_i64_pl = pops.i64_to_planes(state.ei_i64)
 
     # scope payload on consume (oracle: scope value.payload = record payload)
     b_pay = pack_payload(batch.v_vt, batch.v_str, batch.v_num)
     ei_pay = _scatter_pay(state.ei_pay, sc_clip, m_consume, b_pay, n_cap)
     # scope state transition by consume completer
-    ei_i32_arr = ei_i32_arr.at[
-        jnp.where(consume_completer, sc_clip, n_cap), EI_STATE
-    ].set(int(WI.ELEMENT_COMPLETING), mode="drop")
+    ei_i32_arr = _col_update(
+        ei_i32_arr, sc_clip, consume_completer, EI_STATE,
+        int(WI.ELEMENT_COMPLETING),
+    )
     # own-instance transitions
-    ei_i32_arr = ei_i32_arr.at[jnp.where(inmap_ok, ei_clip, n_cap), EI_STATE].set(
-        int(WI.ELEMENT_ACTIVATED), mode="drop"
+    ei_i32_arr = _col_update(
+        ei_i32_arr, ei_clip, inmap_ok, EI_STATE, int(WI.ELEMENT_ACTIVATED)
     )
     ei_pay = _scatter_pay(
         ei_pay, ei_clip, inmap_ok, pack_payload(in_vt, in_sid, in_num), n_cap
     )
     # job completed → instance completing
-    ei_i32_arr = ei_i32_arr.at[jnp.where(jev_completed, aik_clip, n_cap), EI_STATE].set(
-        int(WI.ELEMENT_COMPLETING), mode="drop"
+    ei_i32_arr = _col_update(
+        ei_i32_arr, aik_clip, jev_completed, EI_STATE,
+        int(WI.ELEMENT_COMPLETING),
     )
     ei_pay = _scatter_pay(ei_pay, aik_clip, jev_completed, b_pay, n_cap)
-    ei_i64_arr = state.ei_i64.at[
-        jnp.where(jev_completed, aik_clip, n_cap), EIL_JOB_KEY
-    ].set(-1, mode="drop")
-    ei_i64_arr = ei_i64_arr.at[
-        jnp.where(jev_created & aik_found, aik_clip, n_cap), EIL_JOB_KEY
-    ].set(batch.key, mode="drop")
+    ei_i64_pl = _col64_update(
+        ei_i64_pl, aik_clip, jev_completed, EIL_JOB_KEY, jnp.int64(-1)
+    )
+    ei_i64_pl = _col64_update(
+        ei_i64_pl, aik_clip, jev_created & aik_found, EIL_JOB_KEY, batch.key
+    )
     # timer trigger → instance completing
-    ei_i32_arr = ei_i32_arr.at[jnp.where(ttrig_inst, aik_clip, n_cap), EI_STATE].set(
-        int(WI.ELEMENT_COMPLETING), mode="drop"
+    ei_i32_arr = _col_update(
+        ei_i32_arr, aik_clip, ttrig_inst, EI_STATE, int(WI.ELEMENT_COMPLETING)
     )
 
     # removals (final states written this round)
     ei_remove = outmap_ok | m_complete_proc
-    rm_w = jnp.where(ei_remove, ei_clip, n_cap)
-    ei_i32_arr = ei_i32_arr.at[rm_w, EI_STATE].set(-1, mode="drop")
-    ei_i64_arr = ei_i64_arr.at[rm_w, EIL_KEY].set(-1, mode="drop")
-    ei_map = hashmap.delete(state.ei_map, batch.key, ei_remove)
+    ei_i32_arr = _col_update(ei_i32_arr, ei_clip, ei_remove, EI_STATE, -1)
+    ei_i64_pl = _col64_update(
+        ei_i64_pl, ei_clip, ei_remove, EIL_KEY, jnp.int64(-1)
+    )
+    ei_map = pops.delete(state.ei_map, batch.key, ei_remove)
 
     # inserts: CREATE command roots + START_STATEFUL children (+ replayed
     # CREATED events whose instance is missing)
@@ -1023,20 +1090,22 @@ def step_kernel(
     ins_rank = _excl_cumsum(ins.astype(jnp.int32))
     ins_slot = free[jnp.clip(ins_rank, 0, b - 1)]
     ei_overflow = jnp.any(ins & (ins_slot >= n_cap))
-    iw = jnp.where(ins, ins_slot, n_cap)
-    # one row scatter per dtype group (the point of the packed layout)
+    # one row pass per dtype group (the point of the packed layout)
     ei_i32_rows = jnp.stack(
         [ins_elem,
          jnp.full((b,), int(WI.ELEMENT_READY), jnp.int32),
          batch.wf, ins_parent, jnp.zeros((b,), jnp.int32)], axis=-1,
     )
-    ei_i32_arr = ei_i32_arr.at[iw].set(ei_i32_rows, mode="drop")
+    ei_i32_arr = pops.masked_row_update(ei_i32_arr, ins_slot, ins, ei_i32_rows)
     ei_i64_rows = jnp.stack(
         [ins_key, ins_ikey, jnp.full((b,), -1, jnp.int64)], axis=-1
     )
-    ei_i64_arr = ei_i64_arr.at[iw].set(ei_i64_rows, mode="drop")
-    ei_pay = ei_pay.at[iw].set(b_pay, mode="drop")
-    ei_map, ei_ins_ok = hashmap.insert(ei_map, ins_key, ins_slot, ins)
+    ei_i64_pl = pops.masked_row_update(
+        ei_i64_pl, ins_slot, ins, pops.i64_to_planes(ei_i64_rows)
+    )
+    ei_pay = pops.masked_row_update(ei_pay, ins_slot, ins, b_pay)
+    ei_map, ei_ins_ok = pops.insert(ei_map, ins_key, ins_slot, ins)
+    ei_i64_arr = pops.planes_to_i64(ei_i64_pl)
 
     # ---------------- job table ----------------
     job_ins = m_jcreate
@@ -1044,68 +1113,78 @@ def step_kernel(
     j_rank = _excl_cumsum(job_ins.astype(jnp.int32))
     j_slot = jfree[jnp.clip(j_rank, 0, b - 1)]
     job_overflow = jnp.any(job_ins & (j_slot >= m_cap))
-    jw = jnp.where(job_ins, j_slot, m_cap)
     job_i32_rows = jnp.stack(
         [jnp.full((b,), int(JI.CREATED), jnp.int32),
          batch.elem, batch.wf, batch.type_id, batch.retries,
          jnp.zeros((b,), jnp.int32)], axis=-1,
     )
-    job_i32_arr = state.job_i32.at[jw].set(job_i32_rows, mode="drop")
+    job_i32_arr = pops.masked_row_update(
+        state.job_i32, j_slot, job_ins, job_i32_rows
+    )
+    job_i64_pl = pops.i64_to_planes(state.job_i64)
     job_i64_rows = jnp.stack(
         [job_base, batch.instance_key, batch.aux_key,
          jnp.full((b,), -1, jnp.int64)], axis=-1,
     )
-    job_i64_arr = state.job_i64.at[jw].set(job_i64_rows, mode="drop")
-    job_pay_arr = state.job_pay.at[jw].set(b_pay, mode="drop")
-    job_map, job_ins_ok = hashmap.insert(state.job_map, job_base, j_slot, job_ins)
+    job_i64_pl = pops.masked_row_update(
+        job_i64_pl, j_slot, job_ins, pops.i64_to_planes(job_i64_rows)
+    )
+    job_pay_arr = pops.masked_row_update(state.job_pay, j_slot, job_ins, b_pay)
+    job_map, job_ins_ok = pops.insert(state.job_map, job_base, j_slot, job_ins)
 
-    # transitions: multi-column scatters share one op per dtype group
-    jup = jnp.where(jact_ok, jb_clip, m_cap)
-    act_cols = jnp.array([JB_STATE, JB_WORKER, JB_RETRIES], jnp.int32)
-    job_i32_arr = job_i32_arr.at[jup[:, None], act_cols[None, :]].set(
-        jnp.stack(
-            [jnp.full((b,), int(JI.ACTIVATED), jnp.int32),
-             batch.worker, batch.retries], axis=-1,
-        ),
-        mode="drop",
+    # transitions: multi-column updates share one pass per dtype group
+    job_i32_arr = _cols_update(
+        job_i32_arr, jb_clip, jact_ok,
+        (JB_STATE, JB_WORKER, JB_RETRIES),
+        (int(JI.ACTIVATED), batch.worker, batch.retries),
     )
-    job_i64_arr = job_i64_arr.at[jup, JBL_DEADLINE].set(
-        batch.deadline, mode="drop"
+    job_i64_pl = _col64_update(
+        job_i64_pl, jb_clip, jact_ok, JBL_DEADLINE, batch.deadline
     )
-    job_pay_arr = job_pay_arr.at[jup].set(b_pay, mode="drop")
+    job_pay_arr = pops.masked_row_update(job_pay_arr, jb_clip, jact_ok, b_pay)
 
-    jfw = jnp.where(jfail_ok, jb_clip, m_cap)
-    fail_cols = jnp.array([JB_STATE, JB_RETRIES], jnp.int32)
-    job_i32_arr = job_i32_arr.at[jfw[:, None], fail_cols[None, :]].set(
-        jnp.stack(
-            [jnp.full((b,), int(JI.FAILED), jnp.int32), batch.retries], axis=-1
-        ),
-        mode="drop",
+    job_i32_arr = _cols_update(
+        job_i32_arr, jb_clip, jfail_ok,
+        (JB_STATE, JB_RETRIES),
+        (int(JI.FAILED), batch.retries),
     )
-    job_pay_arr = job_pay_arr.at[jfw].set(
-        pack_payload(fail_vt, fail_sid, fail_num), mode="drop"
+    job_pay_arr = pops.masked_row_update(
+        job_pay_arr, jb_clip, jfail_ok,
+        pack_payload(fail_vt, fail_sid, fail_num),
     )
 
-    job_i32_arr = job_i32_arr.at[
-        jnp.where(jtime_ok, jb_clip, m_cap), JB_STATE
-    ].set(int(JI.TIMED_OUT), mode="drop")
-    job_i32_arr = job_i32_arr.at[
-        jnp.where(jret_ok, jb_clip, m_cap), JB_RETRIES
-    ].set(batch.retries, mode="drop")
+    job_i32_arr = _col_update(
+        job_i32_arr, jb_clip, jtime_ok, JB_STATE, int(JI.TIMED_OUT)
+    )
+    job_i32_arr = _col_update(
+        job_i32_arr, jb_clip, jret_ok, JB_RETRIES, batch.retries
+    )
     job_rm = jcomp_ok | jcan_ok
-    jrm = jnp.where(job_rm, jb_clip, m_cap)
-    job_i32_arr = job_i32_arr.at[jrm, JB_STATE].set(-1, mode="drop")
-    job_i64_arr = job_i64_arr.at[jrm, JBL_KEY].set(-1, mode="drop")
-    job_map = hashmap.delete(job_map, batch.key, job_rm)
+    job_i32_arr = _col_update(job_i32_arr, jb_clip, job_rm, JB_STATE, -1)
+    job_i64_pl = _col64_update(
+        job_i64_pl, jb_clip, job_rm, JBL_KEY, jnp.int64(-1)
+    )
+    job_map = pops.delete(job_map, batch.key, job_rm)
+    job_i64_arr = pops.planes_to_i64(job_i64_pl)
 
     # ---------------- join cleanup ----------------
     if graph.has_parallel_joins:
-        done_slot = jnp.where(completer, arr_slot, j_cap)
-        join_key_arr = join_key_arr.at[done_slot].set(-1, mode="drop")
-        join_nin_arr = join_nin_arr.at[done_slot].set(0, mode="drop")
-        arrived = arrived.at[done_slot].set(False, mode="drop")
-        stamp = stamp.at[done_slot].set(-1, mode="drop")
-        join_map = hashmap.delete(jmap, join_key, completer)
+        join_key_arr = pops.masked_vec64_update(
+            join_key_arr, arr_slot, completer,
+            jnp.full((b,), -1, jnp.int64),
+        )
+        join_nin_arr = pops.masked_lane_update(
+            join_nin_arr, arr_slot, completer, jnp.zeros((b,), jnp.int32)
+        )
+        arrived = pops.masked_row_update(
+            arrived.astype(jnp.int32), arr_slot, completer,
+            jnp.zeros((b, arrived.shape[1]), jnp.int32),
+        ).astype(bool)
+        stamp = pops.masked_row_update(
+            stamp, arr_slot, completer,
+            jnp.full((b, stamp.shape[1]), -1, jnp.int32),
+        )
+        join_map = pops.delete(jmap, join_key, completer)
     else:
         join_map = jmap
 
@@ -1116,21 +1195,33 @@ def step_kernel(
         t_rank = _excl_cumsum(t_ins.astype(jnp.int32))
         t_slot = tfree[jnp.clip(t_rank, 0, b - 1)]
         timer_overflow = jnp.any(t_ins & (t_slot >= t_cap))
-        tw = jnp.where(t_ins, t_slot, t_cap)
-        timer_key_arr = state.timer_key.at[tw].set(key0, mode="drop")
-        timer_due_arr = state.timer_due.at[tw].set(batch.deadline, mode="drop")
-        timer_aik_arr = state.timer_aik.at[tw].set(batch.aux_key, mode="drop")
-        timer_ik_arr = state.timer_instance_key.at[tw].set(
-            batch.instance_key, mode="drop"
+        timer_key_arr = pops.masked_vec64_update(
+            state.timer_key, t_slot, t_ins, key0
         )
-        timer_elem_arr = state.timer_elem.at[tw].set(batch.elem, mode="drop")
-        timer_wf_arr = state.timer_wf.at[tw].set(batch.wf, mode="drop")
-        timer_map, _t_ok = hashmap.insert(state.timer_map, key0, t_slot, t_ins)
+        timer_due_arr = pops.masked_vec64_update(
+            state.timer_due, t_slot, t_ins, batch.deadline
+        )
+        timer_aik_arr = pops.masked_vec64_update(
+            state.timer_aik, t_slot, t_ins, batch.aux_key
+        )
+        timer_ik_arr = pops.masked_vec64_update(
+            state.timer_instance_key, t_slot, t_ins, batch.instance_key
+        )
+        timer_elem_arr = pops.masked_lane_update(
+            state.timer_elem, t_slot, t_ins, batch.elem
+        )
+        timer_wf_arr = pops.masked_lane_update(
+            state.timer_wf, t_slot, t_ins, batch.wf
+        )
+        timer_map, _t_ok = pops.insert(state.timer_map, key0, t_slot, t_ins)
         t_rm = ttrig_ok | tcan_ok
-        trm = jnp.where(t_rm, tm_clip, t_cap)
-        timer_key_arr = timer_key_arr.at[trm].set(-1, mode="drop")
-        timer_due_arr = timer_due_arr.at[trm].set(-1, mode="drop")
-        timer_map = hashmap.delete(timer_map, batch.key, t_rm)
+        timer_key_arr = pops.masked_vec64_update(
+            timer_key_arr, tm_clip, t_rm, jnp.full((b,), -1, jnp.int64)
+        )
+        timer_due_arr = pops.masked_vec64_update(
+            timer_due_arr, tm_clip, t_rm, jnp.full((b,), -1, jnp.int64)
+        )
+        timer_map = pops.delete(timer_map, batch.key, t_rm)
     else:
         timer_overflow = jnp.zeros((), bool)
         timer_key_arr = state.timer_key

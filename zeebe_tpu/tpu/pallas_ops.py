@@ -1,0 +1,614 @@
+"""Pallas TPU primitives for the step kernel's table operations.
+
+XLA lowers general scatters/gathers and the hashmap probe loops to SERIAL
+per-index programs on TPU (~70ns-1.4ms per op at wave 2^14 — see
+PERF_NOTES.md); the whole round is a dependent chain of ~70 such ops, so
+op count × batch dominates. These kernels replace each op family with one
+serial pallas pass whose per-record cost is a handful of VPU/scalar-core
+instructions (~1.5-5ns/record measured, benchmarks/pallas_probe.py):
+
+- ``masked_row_update`` / ``masked_row_accum``: ``tbl[slot[i]] =
+  where(lane_mask[i], vals[i], old)`` for active records, serial in batch
+  order (= the XLA chain's last-writer-wins rank order).
+- ``masked_lane_update`` / ``masked_lane_accum``: the 1D-table variant;
+  the table is viewed as [T/128, 128] and the dynamic lane is modified by
+  vector select (TPU has no scalar VMEM stores).
+- ``lookup`` / ``insert`` / ``delete``: the hashmap ops
+  (zeebe_tpu.tpu.hashmap semantics). Bucket LAYOUT may differ from the
+  XLA path when colliding keys race (XLA claims are round-synchronous,
+  this path is serial) — the key→slot mapping and probe invariants are
+  identical, so tables from either path are interchangeable.
+
+Addressing rules (load-bearing, measured):
+- per-record control scalars (slots, flags, hashes, key halves) MUST live
+  in SMEM — extracting a scalar from a VMEM vector costs ~300x;
+- the batch is grid-chunked so each chunk's scalars fit SMEM;
+- int64 never enters a kernel: i64 arrays are bitcast to (lo, hi) i32
+  planes at the boundary (TPU i64 is emulated anyway).
+
+Everything falls back to the XLA implementations off-TPU (tests run on
+the CPU mesh; the TPU path is exercised by bench.py and the device parity
+check in benchmarks/).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from zeebe_tpu.tpu import hashmap
+from zeebe_tpu.tpu.hashmap import EMPTY, HashTable, MAX_PROBES, TOMBSTONE
+
+LANES = 128
+# lane extraction = max(where(sel, row, INT32_MIN)): exact for every value
+# (jnp.sum's 1D reduce does not lower under x64; max does), and the weak
+# python literal adopts i32 from the row instead of promoting
+_CHUNK = 2048  # records per grid step; scalars per chunk must fit SMEM
+
+
+def _use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _chunk(b: int) -> int:
+    c = min(b, _CHUNK)
+    while b % c:
+        c //= 2
+    return max(c, 1)
+
+
+def _pallas_call(kernel, grid, in_specs, out_specs, out_shape, aliases, vmem_mb=110):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=vmem_mb * 1024 * 1024,
+            dimension_semantics=("arbitrary",),
+        ),
+    )
+
+
+def _smem_spec(c):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.BlockSpec((c,), lambda g: (g,), memory_space=pltpu.SMEM)
+
+
+def _vmem_rows_spec(c, k):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.BlockSpec((c, k), lambda g: (g, jnp.int32(0)), memory_space=pltpu.VMEM)
+
+
+def _vmem_full_spec(shape):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.BlockSpec(
+        shape, lambda g: tuple(jnp.int32(0) for _ in shape),
+        memory_space=pltpu.VMEM,
+    )
+
+
+# ---------------------------------------------------------------------------
+# 2D-table row updates
+# ---------------------------------------------------------------------------
+
+
+def masked_row_update(
+    table: jax.Array,  # [T, K] i32
+    slots: jax.Array,  # [B] i32 (any value; inactive rows ignored)
+    active: jax.Array,  # [B] bool
+    vals: jax.Array,  # [B, K] i32
+    lane_mask: Optional[jax.Array] = None,  # [B, K] bool; None = full row
+) -> jax.Array:
+    """Serial batch-order row writes: for i in range(B): if active[i]:
+    row = table[slots[i]]; table[slots[i]] = where(lane_mask[i], vals[i], row).
+
+    Equivalent to the XLA ``table.at[where(active, slots, T)].set(vals,
+    mode="drop")`` chain (last writer in batch order wins)."""
+    if not _use_pallas():
+        idx = jnp.where(active, slots, table.shape[0])
+        if lane_mask is None:
+            return table.at[idx].set(vals, mode="drop")
+        # element-wise scatter: two active records may target DISJOINT
+        # lanes of the same row (parallel-join arrivals) — a row-level
+        # read-merge-write would drop one of them
+        k = table.shape[1]
+        rows = jnp.where(
+            active[:, None] & lane_mask, slots[:, None], table.shape[0]
+        )
+        cols = jnp.broadcast_to(
+            jnp.arange(k, dtype=jnp.int32)[None, :], lane_mask.shape
+        )
+        return table.at[rows, cols].set(vals, mode="drop")
+
+    b = slots.shape[0]
+    t, k = table.shape
+    c = _chunk(b)
+    blind = lane_mask is None
+    if blind:
+        lane_mask = jnp.ones((1, 1), jnp.int32)  # placeholder operand
+
+    def kernel(slots_ref, active_ref, vals_ref, mask_ref, tbl_ref, out_ref):
+        del tbl_ref
+
+        def body(i, _):
+            @functools.partial(_when, active_ref[i] != 0)
+            def _():
+                s = slots_ref[i]
+                if blind:
+                    out_ref[s, :] = vals_ref[i, :]
+                else:
+                    row = out_ref[s, :]
+                    out_ref[s, :] = jnp.where(
+                        mask_ref[i, :] != 0, vals_ref[i, :], row
+                    )
+            return jnp.int32(0)
+
+        lax.fori_loop(jnp.int32(0), jnp.int32(c), body, jnp.int32(0))
+
+    mask_spec = (
+        _vmem_full_spec((1, 1)) if blind else _vmem_rows_spec(c, k)
+    )
+    return _pallas_call(
+        kernel,
+        grid=(b // c,),
+        in_specs=[
+            _smem_spec(c),
+            _smem_spec(c),
+            _vmem_rows_spec(c, k),
+            mask_spec,
+            _vmem_full_spec((t, k)),
+        ],
+        out_specs=_vmem_full_spec((t, k)),
+        out_shape=jax.ShapeDtypeStruct((t, k), table.dtype),
+        aliases={4: 0},
+    )(
+        slots.astype(jnp.int32),
+        active.astype(jnp.int32),
+        vals.astype(table.dtype),
+        (lane_mask if blind else lane_mask.astype(jnp.int32)),
+        table,
+    )
+
+
+def _when(cond, fn):
+    from jax.experimental import pallas as pl
+
+    return pl.when(cond)(fn)
+
+
+def masked_row_max(
+    table: jax.Array,  # [T, K] i32
+    slots: jax.Array,  # [B] i32
+    active: jax.Array,  # [B] bool
+    vals: jax.Array,  # [B, K] i32
+) -> jax.Array:
+    """Serial ``table[slot[i]] = maximum(old, vals[i])`` for active records
+    (the ``.at[slots].max(vals, mode="drop")`` analogue; max commutes, so
+    batch order does not matter)."""
+    if not _use_pallas():
+        idx = jnp.where(active, slots, table.shape[0])
+        return table.at[idx].max(vals.astype(table.dtype), mode="drop")
+
+    b = slots.shape[0]
+    t, k = table.shape
+    c = _chunk(b)
+
+    def kernel(slots_ref, active_ref, vals_ref, tbl_ref, out_ref):
+        del tbl_ref
+
+        def body(i, _):
+            @functools.partial(_when, active_ref[i] != 0)
+            def _():
+                s = slots_ref[i]
+                row = out_ref[s, :]
+                out_ref[s, :] = jnp.maximum(row, vals_ref[i, :])
+            return jnp.int32(0)
+
+        lax.fori_loop(jnp.int32(0), jnp.int32(c), body, jnp.int32(0))
+
+    return _pallas_call(
+        kernel,
+        grid=(b // c,),
+        in_specs=[
+            _smem_spec(c),
+            _smem_spec(c),
+            _vmem_rows_spec(c, k),
+            _vmem_full_spec((t, k)),
+        ],
+        out_specs=_vmem_full_spec((t, k)),
+        out_shape=jax.ShapeDtypeStruct((t, k), table.dtype),
+        aliases={3: 0},
+    )(
+        slots.astype(jnp.int32),
+        active.astype(jnp.int32),
+        vals.astype(table.dtype),
+        table,
+    )
+
+
+# ---------------------------------------------------------------------------
+# 1D-table lane updates (table viewed as [T/128, 128])
+# ---------------------------------------------------------------------------
+
+
+def _lane_kernel(accumulate: bool):
+    def kernel(slots_ref, active_ref, vals_ref, tbl_ref, out_ref):
+        del tbl_ref
+        lane_iota = lax.broadcasted_iota(jnp.int32, (LANES,), 0)
+
+        def body(i, _):
+            @functools.partial(_when, active_ref[i] != 0)
+            def _():
+                s = slots_ref[i]
+                r = s >> 7
+                lane = s & (LANES - 1)
+                row = out_ref[r, :]
+                v = vals_ref[i]
+                hit = lane_iota == lane
+                if accumulate:
+                    out_ref[r, :] = jnp.where(hit, row + v, row)
+                else:
+                    out_ref[r, :] = jnp.where(hit, v, row)
+            return jnp.int32(0)
+
+        c = slots_ref.shape[0]
+        lax.fori_loop(jnp.int32(0), jnp.int32(c), body, jnp.int32(0))
+
+    return kernel
+
+
+def _lane_op(table1d, slots, active, vals, accumulate):
+    t = table1d.shape[0]
+    b = slots.shape[0]
+    if not _use_pallas() or t % LANES:
+        idx = jnp.where(active, slots, t)
+        if accumulate:
+            return table1d.at[idx].add(vals.astype(table1d.dtype), mode="drop")
+        return table1d.at[idx].set(vals.astype(table1d.dtype), mode="drop")
+    c = _chunk(b)
+    folded = table1d.reshape(t // LANES, LANES)
+    out = _pallas_call(
+        _lane_kernel(accumulate),
+        grid=(b // c,),
+        in_specs=[
+            _smem_spec(c),
+            _smem_spec(c),
+            _smem_spec(c),
+            _vmem_full_spec((t // LANES, LANES)),
+        ],
+        out_specs=_vmem_full_spec((t // LANES, LANES)),
+        out_shape=jax.ShapeDtypeStruct((t // LANES, LANES), table1d.dtype),
+        aliases={3: 0},
+    )(
+        slots.astype(jnp.int32),
+        active.astype(jnp.int32),
+        vals.astype(table1d.dtype),
+        folded,
+    )
+    return out.reshape(t)
+
+
+def masked_lane_update(table1d, slots, active, vals):
+    """1D analogue of masked_row_update (i32 tables only)."""
+    return _lane_op(table1d, slots, active, vals, accumulate=False)
+
+
+def masked_lane_accum(table1d, slots, active, deltas):
+    """Serial ``table[slot] += delta`` (i32), batch order."""
+    return _lane_op(table1d, slots, active, deltas, accumulate=True)
+
+
+# ---------------------------------------------------------------------------
+# int64 plane helpers (TPU i64 is emulated; tables convert to i32 planes at
+# the pallas boundary and back — a cheap layout bitcast, not element math)
+# ---------------------------------------------------------------------------
+
+
+def i64_to_planes(x: jax.Array) -> jax.Array:
+    """[N, C] i64 → [N, 2C] i32 (little-endian lo/hi pairs per column)."""
+    n, cdim = x.shape
+    return lax.bitcast_convert_type(x, jnp.int32).reshape(n, 2 * cdim)
+
+
+def planes_to_i64(p: jax.Array) -> jax.Array:
+    """[N, 2C] i32 → [N, C] i64."""
+    n, c2 = p.shape
+    return lax.bitcast_convert_type(
+        p.reshape(n, c2 // 2, 2), jnp.int64
+    )
+
+
+def vec64_to_planes(x: jax.Array) -> jax.Array:
+    """[B] i64 → [B, 2] i32."""
+    return lax.bitcast_convert_type(x, jnp.int32)
+
+
+def masked_vec64_update(table1d, slots, active, vals64):
+    """1D i64 table scatter: ``table[slot[i]] = vals64[i]`` via planes."""
+    if not _use_pallas():
+        idx = jnp.where(active, slots, table1d.shape[0])
+        return table1d.at[idx].set(vals64.astype(table1d.dtype), mode="drop")
+    planes = i64_to_planes(table1d[:, None])
+    out = masked_row_update(planes, slots, active, vec64_to_planes(vals64))
+    return planes_to_i64(out)[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# hashmap ops (int64 keys as (lo, hi) i32 planes)
+# ---------------------------------------------------------------------------
+
+
+def _split_keys(keys64: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    planes = lax.bitcast_convert_type(keys64, jnp.int32)  # [..., 2] LE
+    return planes[..., 0], planes[..., 1]
+
+
+def _join_keys(lo: jax.Array, hi: jax.Array) -> jax.Array:
+    return lax.bitcast_convert_type(
+        jnp.stack([lo, hi], axis=-1), jnp.int64
+    )
+
+
+def _hash_i32(lo, hi, table_size):
+    # must match hashmap._hash exactly (tables move between backends)
+    c1 = jnp.uint32(0x9E3779B1).astype(jnp.int32)
+    c2 = jnp.uint32(0x85EBCA77).astype(jnp.int32)
+    h = (lo * c1) ^ (hi * c2)
+    h = h ^ lax.shift_right_logical(h, jnp.int32(15))
+    return h & jnp.int32(table_size - 1)
+
+
+# sentinel planes: EMPTY = -1 → (lo, hi) = (-1, -1); TOMBSTONE = -2 →
+# (-2, -1). Real keys are non-negative, so neither collides.
+
+
+def _fold_table(table: HashTable):
+    t = table.keys.shape[0]
+    lo, hi = _split_keys(table.keys)
+    return (
+        lo.reshape(t // LANES, LANES),
+        hi.reshape(t // LANES, LANES),
+        table.vals.reshape(t // LANES, LANES),
+    )
+
+
+def lookup(table: HashTable, keys: jax.Array, valid: jax.Array):
+    """Batched probe; identical results to hashmap.lookup."""
+    t = table.keys.shape[0]
+    b = keys.shape[0]
+    if not _use_pallas() or t % LANES:
+        return hashmap.lookup(table, keys, valid)
+    c = _chunk(b)
+    lo, hi = _split_keys(keys)
+    h0 = _hash_i32(lo, hi, t)
+    tlo, thi, _tv = _fold_table(table)
+    tvals = table.vals.reshape(t // LANES, LANES)
+
+    def kernel(h0_ref, lo_ref, hi_ref, valid_ref, tlo_ref, thi_ref, tv_ref,
+               found_ref, slot_ref):
+        lane_iota = lax.broadcasted_iota(jnp.int32, (LANES,), 0)
+
+        def body(i, _):
+            # validity folds into the loop condition (done starts True for
+            # invalid records): one less conditional nesting level — the
+            # cond→while→masked-op tower otherwise exceeds the tracer's
+            # Python recursion budget
+            klo = lo_ref[i]
+            khi = hi_ref[i]
+            h = h0_ref[i]
+            invalid = jnp.where(valid_ref[i] == 0, jnp.int32(1), jnp.int32(0))
+
+            # all carries are i32: mosaic's scalar bool conversions recurse
+            def probe(carry):
+                j, found, slot, done = carry
+                idx = (h + j) & (t - 1)
+                r = idx >> 7
+                lane = idx & (LANES - 1)
+                sel = lane_iota == lane
+                blo = jnp.max(jnp.where(sel, tlo_ref[r, :], jnp.int32(-(2**31))))
+                bhi = jnp.max(jnp.where(sel, thi_ref[r, :], jnp.int32(-(2**31))))
+                bval = jnp.max(jnp.where(sel, tv_ref[r, :], jnp.int32(-(2**31))))
+                hit = (blo == klo) & (bhi == khi)
+                empty = (blo == -1) & (bhi == -1)
+                return (
+                    j + 1,
+                    jnp.where(hit, jnp.int32(1), found),
+                    jnp.where(hit, bval, slot),
+                    jnp.where(hit | empty, jnp.int32(1), done),
+                )
+
+            _, found, slot, _ = lax.while_loop(
+                lambda cy: (cy[0] < MAX_PROBES) & (cy[3] == 0),
+                probe,
+                (jnp.int32(0), jnp.int32(0), jnp.int32(-1), invalid),
+            )
+            found_ref[i] = found
+            slot_ref[i] = slot
+            return jnp.int32(0)
+
+        lax.fori_loop(jnp.int32(0), jnp.int32(c), body, jnp.int32(0))
+
+    found, slot = _pallas_call(
+        kernel,
+        grid=(b // c,),
+        in_specs=[_smem_spec(c)] * 4
+        + [_vmem_full_spec((t // LANES, LANES))] * 3,
+        out_specs=(_smem_spec(c), _smem_spec(c)),
+        out_shape=(
+            jax.ShapeDtypeStruct((b,), jnp.int32),
+            jax.ShapeDtypeStruct((b,), jnp.int32),
+        ),
+        aliases={},
+    )(h0, lo, hi, valid.astype(jnp.int32), tlo, thi, tvals)
+    return found.astype(bool), slot
+
+
+def insert(table: HashTable, keys: jax.Array, vals: jax.Array, valid: jax.Array):
+    """Batched insert of unique keys (hashmap.insert semantics; bucket
+    layout may differ on collisions — see module docstring)."""
+    t = table.keys.shape[0]
+    b = keys.shape[0]
+    if not _use_pallas() or t % LANES:
+        return hashmap.insert(table, keys, vals, valid)
+    c = _chunk(b)
+    lo, hi = _split_keys(keys)
+    h0 = _hash_i32(lo, hi, t)
+    tlo, thi, tvals = _fold_table(table)
+
+    def kernel(h0_ref, lo_ref, hi_ref, vals_ref, valid_ref,
+               tlo_in, thi_in, tv_in,
+               tlo_ref, thi_ref, tv_ref, ok_ref):
+        del tlo_in, thi_in, tv_in
+        lane_iota = lax.broadcasted_iota(jnp.int32, (LANES,), 0)
+
+        def body(i, _):
+            klo = lo_ref[i]
+            khi = hi_ref[i]
+            h = h0_ref[i]
+            v = vals_ref[i]
+            invalid = jnp.where(valid_ref[i] == 0, jnp.int32(1), jnp.int32(0))
+
+            # find the first EMPTY bucket; no ref writes inside the loop,
+            # validity folded into the condition, i32 carries only
+            def probe(carry):
+                j, target, placed = carry
+                idx = (h + j) & (t - 1)
+                r = idx >> 7
+                lane = idx & (LANES - 1)
+                sel = lane_iota == lane
+                blo = jnp.max(jnp.where(sel, tlo_ref[r, :], jnp.int32(-(2**31))))
+                bhi = jnp.max(jnp.where(sel, thi_ref[r, :], jnp.int32(-(2**31))))
+                free = (blo == -1) & (bhi == -1)
+                return (
+                    j + 1,
+                    jnp.where(free, idx, target),
+                    jnp.where(free, jnp.int32(1), placed),
+                )
+
+            _, target, placed = lax.while_loop(
+                lambda cy: (cy[0] < MAX_PROBES) & (cy[2] == 0) & (invalid == 0),
+                probe,
+                (jnp.int32(0), jnp.int32(-1), jnp.int32(0)),
+            )
+
+            @functools.partial(_when, placed != 0)
+            def _():
+                r = target >> 7
+                sel = lane_iota == (target & (LANES - 1))
+                tlo_ref[r, :] = jnp.where(sel, klo, tlo_ref[r, :])
+                thi_ref[r, :] = jnp.where(sel, khi, thi_ref[r, :])
+                tv_ref[r, :] = jnp.where(sel, v, tv_ref[r, :])
+
+            ok_ref[i] = placed
+            return jnp.int32(0)
+
+        lax.fori_loop(jnp.int32(0), jnp.int32(c), body, jnp.int32(0))
+
+    shape2d = jax.ShapeDtypeStruct((t // LANES, LANES), jnp.int32)
+    tlo2, thi2, tv2, ok = _pallas_call(
+        kernel,
+        grid=(b // c,),
+        in_specs=[_smem_spec(c)] * 5
+        + [_vmem_full_spec((t // LANES, LANES))] * 3,
+        out_specs=(
+            _vmem_full_spec((t // LANES, LANES)),
+            _vmem_full_spec((t // LANES, LANES)),
+            _vmem_full_spec((t // LANES, LANES)),
+            _smem_spec(c),
+        ),
+        out_shape=(shape2d, shape2d, shape2d,
+                   jax.ShapeDtypeStruct((b,), jnp.int32)),
+        aliases={5: 0, 6: 1, 7: 2},
+    )(h0, lo, hi, vals.astype(jnp.int32), valid.astype(jnp.int32),
+      tlo, thi, tvals)
+    new_keys = _join_keys(tlo2.reshape(t), thi2.reshape(t))
+    return HashTable(new_keys, tv2.reshape(t)), ok.astype(bool)
+
+
+def delete(table: HashTable, keys: jax.Array, valid: jax.Array) -> HashTable:
+    """Batched delete (tombstones); identical to hashmap.delete."""
+    t = table.keys.shape[0]
+    b = keys.shape[0]
+    if not _use_pallas() or t % LANES:
+        return hashmap.delete(table, keys, valid)
+    c = _chunk(b)
+    lo, hi = _split_keys(keys)
+    h0 = _hash_i32(lo, hi, t)
+    tlo, thi, tvals = _fold_table(table)
+
+    def kernel(h0_ref, lo_ref, hi_ref, valid_ref, tlo_in, thi_in,
+               tlo_ref, thi_ref):
+        del tlo_in, thi_in
+        lane_iota = lax.broadcasted_iota(jnp.int32, (LANES,), 0)
+
+        def body(i, _):
+            klo = lo_ref[i]
+            khi = hi_ref[i]
+            h = h0_ref[i]
+            invalid = jnp.where(valid_ref[i] == 0, jnp.int32(1), jnp.int32(0))
+
+            def probe(carry):
+                j, target, done = carry
+                idx = (h + j) & (t - 1)
+                r = idx >> 7
+                lane = idx & (LANES - 1)
+                sel = lane_iota == lane
+                blo = jnp.max(jnp.where(sel, tlo_ref[r, :], jnp.int32(-(2**31))))
+                bhi = jnp.max(jnp.where(sel, thi_ref[r, :], jnp.int32(-(2**31))))
+                hit = (blo == klo) & (bhi == khi)
+                empty = (blo == -1) & (bhi == -1)
+                return (
+                    j + 1,
+                    jnp.where(hit, idx, target),
+                    jnp.where(hit | empty, jnp.int32(1), done),
+                )
+
+            _, target, _ = lax.while_loop(
+                lambda cy: (cy[0] < MAX_PROBES) & (cy[2] == 0) & (invalid == 0),
+                probe,
+                (jnp.int32(0), jnp.int32(-1), jnp.int32(0)),
+            )
+
+            @functools.partial(_when, target >= 0)
+            def _():
+                # TOMBSTONE = -2 → planes (-2, -1)
+                r = target >> 7
+                sel = lane_iota == (target & (LANES - 1))
+                tlo_ref[r, :] = jnp.where(sel, jnp.int32(-2), tlo_ref[r, :])
+                thi_ref[r, :] = jnp.where(sel, jnp.int32(-1), thi_ref[r, :])
+
+            return jnp.int32(0)
+
+        lax.fori_loop(jnp.int32(0), jnp.int32(c), body, jnp.int32(0))
+
+    shape2d = jax.ShapeDtypeStruct((t // LANES, LANES), jnp.int32)
+    tlo2, thi2 = _pallas_call(
+        kernel,
+        grid=(b // c,),
+        in_specs=[_smem_spec(c)] * 4
+        + [_vmem_full_spec((t // LANES, LANES))] * 2,
+        out_specs=(
+            _vmem_full_spec((t // LANES, LANES)),
+            _vmem_full_spec((t // LANES, LANES)),
+        ),
+        out_shape=(shape2d, shape2d),
+        aliases={4: 0, 5: 1},
+    )(h0, lo, hi, valid.astype(jnp.int32), tlo, thi)
+    new_keys = _join_keys(tlo2.reshape(t), thi2.reshape(t))
+    return HashTable(new_keys, tvals.reshape(t))
