@@ -50,24 +50,30 @@ def bench_log():
     from zeebe_tpu.protocol.metadata import RecordMetadata
     from zeebe_tpu.protocol.records import Record, JobRecord
 
-    with tempfile.TemporaryDirectory() as tmp:
-        log = LogStream(SegmentedLogStorage(tmp), partition_id=0)
-        n = 20_000
-        rec = lambda: Record(  # noqa: E731
-            metadata=RecordMetadata(record_type=RecordType.EVENT, value_type=0, intent=1),
-            value=JobRecord(type="payment", retries=3, payload={"k": 1}),
-        )
-        t0 = time.perf_counter()
-        for _ in range(n):
-            log.append([rec()])
-        append_rate = _rate(n, t0)
-        t0 = time.perf_counter()
-        count = sum(1 for _ in log.reader(0))
-        read_rate = _rate(count, t0)
-        return [
-            {"metric": "log_appends_per_sec", "value": append_rate},
-            {"metric": "log_reads_per_sec", "value": read_rate},
-        ]
+    from zeebe_tpu import native
+
+    out = []
+    backends = [("py", False)] + ([("native", True)] if native.available() else [])
+    for label, use_native in backends:
+        with tempfile.TemporaryDirectory() as tmp:
+            log = LogStream(
+                SegmentedLogStorage(tmp, native=use_native), partition_id=0
+            )
+            n = 20_000
+            rec = lambda: Record(  # noqa: E731
+                metadata=RecordMetadata(record_type=RecordType.EVENT, value_type=0, intent=1),
+                value=JobRecord(type="payment", retries=3, payload={"k": 1}),
+            )
+            t0 = time.perf_counter()
+            for _ in range(n):
+                log.append([rec()])
+            append_rate = _rate(n, t0)
+            t0 = time.perf_counter()
+            count = sum(1 for _ in log.reader(0))
+            read_rate = _rate(count, t0)
+            out.append({"metric": f"log_appends_per_sec_{label}", "value": append_rate})
+            out.append({"metric": f"log_reads_per_sec_{label}", "value": read_rate})
+    return out
 
 
 def bench_transport():

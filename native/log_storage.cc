@@ -209,3 +209,31 @@ ZB_EXPORT int ls_truncate(void* handle, int64_t address) {
   ls->segments.back().size = offset;
   return 0;
 }
+
+// Delete whole segment files with id < `segment_id` (compaction floor;
+// reference: the broker deletes segments below the committed snapshot
+// position). Never deletes the current tail segment. Returns removed count.
+ZB_EXPORT int32_t ls_delete_before(void* handle, int32_t segment_id) {
+  auto* ls = static_cast<LogStorage*>(handle);
+  int32_t removed = 0;
+  while (!ls->segments.empty() && ls->segments.front().id < segment_id &&
+         ls->segments.front().id != ls->cur_id) {
+    if (::unlink(segment_path(ls, ls->segments.front().id).c_str()) != 0) break;
+    ls->segments.erase(ls->segments.begin());
+    ++removed;
+  }
+  return removed;
+}
+
+// Delete ALL segments and roll a fresh segment 0 (snapshot fast-forward:
+// the installed snapshot supersedes everything on disk).
+ZB_EXPORT int ls_reset(void* handle) {
+  auto* ls = static_cast<LogStorage*>(handle);
+  if (ls->fd >= 0) {
+    ::close(ls->fd);
+    ls->fd = -1;
+  }
+  for (const Segment& s : ls->segments) ::unlink(segment_path(ls, s.id).c_str());
+  ls->segments.clear();
+  return roll_segment(ls, 0) ? 0 : -1;
+}

@@ -27,9 +27,30 @@ class SegmentedLogStorage:
 
     Addresses are ``(segment_id << 32) | byte_offset`` — the reference packs
     (segmentId, offset) into a long the same way.
+
+    ``native=True`` serves the same on-disk format through the C++ mmap
+    backend (``native/log_storage.cc``); it requires the native toolchain
+    (``zeebe_tpu.native.available()``) and raises when missing rather than
+    silently falling back — an operator asking for the native backend
+    should not unknowingly run the Python one.
     """
 
-    def __init__(self, directory: str, segment_size: int = DEFAULT_SEGMENT_SIZE):
+    def __new__(cls, directory: str, segment_size: int = DEFAULT_SEGMENT_SIZE,
+                native: bool = False):
+        if native and cls is SegmentedLogStorage:
+            from zeebe_tpu import native as native_mod
+
+            if not native_mod.available():
+                raise RuntimeError(
+                    "native log storage requested but the native layer is "
+                    f"unavailable: {native_mod.build_error()}"
+                )
+            return native_mod.NativeLogStorage(directory, segment_size)
+        return object.__new__(cls)
+
+    def __init__(self, directory: str, segment_size: int = DEFAULT_SEGMENT_SIZE,
+                 native: bool = False):
+        del native  # handled by __new__ (this body only runs for the Python backend)
         self.directory = directory
         self.segment_size = segment_size
         os.makedirs(directory, exist_ok=True)

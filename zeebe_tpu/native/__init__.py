@@ -126,6 +126,10 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.ls_first_address.argtypes = [c.c_void_p]
     lib.ls_truncate.restype = c.c_int
     lib.ls_truncate.argtypes = [c.c_void_p, c.c_int64]
+    lib.ls_delete_before.restype = c.c_int32
+    lib.ls_delete_before.argtypes = [c.c_void_p, c.c_int32]
+    lib.ls_reset.restype = c.c_int
+    lib.ls_reset.argtypes = [c.c_void_p]
 
     lib.frame_scan.restype = c.c_int64
     lib.frame_scan.argtypes = [
@@ -281,6 +285,22 @@ class NativeLogStorage:
     def first_address(self) -> Optional[int]:
         addr = self._lib.ls_first_address(self._h)
         return None if addr < 0 else addr
+
+    @property
+    def _segments(self) -> List[int]:
+        """Sorted live segment ids (same bookkeeping view as the Python
+        backend exposes; tests and compaction assertions read it)."""
+        return [
+            self._lib.ls_segment_id(self._h, i)
+            for i in range(self._lib.ls_segment_count(self._h))
+        ]
+
+    def delete_segments_before(self, segment_id: int) -> int:
+        return self._lib.ls_delete_before(self._h, segment_id)
+
+    def reset(self) -> None:
+        if self._lib.ls_reset(self._h) != 0:
+            raise OSError("reset failed")
 
     def truncate(self, address: int) -> None:
         if self._lib.ls_truncate(self._h, address) != 0:

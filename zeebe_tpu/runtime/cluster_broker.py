@@ -100,7 +100,9 @@ class PartitionServer:
         self.partition_id = partition_id
         pdir = os.path.join(broker.data_dir, f"partition-{partition_id}")
         self.storage = SegmentedLogStorage(
-            pdir, segment_size=broker.cfg.data.segment_size_bytes
+            pdir,
+            segment_size=broker.cfg.data.segment_size_bytes,
+            native=broker.cfg.data.native_storage,
         )
         self.log = LogStream(
             self.storage,

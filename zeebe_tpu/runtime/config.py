@@ -51,6 +51,10 @@ class DataCfg:
     segment_size_bytes: int = 64 * 1024 * 1024
     snapshot_period_ms: int = 15 * 60 * 1000
     snapshot_replication_period_ms: int = 5 * 60 * 1000
+    # serve the partition logs through the C++ storage backend
+    # (native/log_storage.cc — same on-disk format as the Python one);
+    # requires the native toolchain, fails loudly when missing
+    native_storage: bool = False
 
 
 @dataclasses.dataclass
@@ -160,6 +164,11 @@ _ENV_OVERRIDES = {
         lambda v: [p.strip() for p in v.split(",") if p.strip()],
     ),
     "ZEEBE_DATA_DIR": ("data", "directory", str),
+    "ZEEBE_NATIVE_STORAGE": (
+        "data",
+        "native_storage",
+        lambda v: v.strip().lower() in ("1", "true", "yes"),
+    ),
     "ZEEBE_ENGINE_TYPE": ("engine", "type", str),
     "ZEEBE_METRICS_PORT": ("metrics", "port", int),
 }

@@ -413,6 +413,11 @@ class TpuPartitionEngine:
                 wf_key = wf.key if wf is not None else -1
             if wf_key in self._host_only_keys:
                 return True
+            if self._nonscalar_payload(record):
+                # nested/list payload values have no device column form —
+                # the instance is born (and lives) host-side; the oracle
+                # supports arbitrary documents
+                return True
             if int(record.metadata.record_type) == int(RecordType.COMMAND) and (
                 intent in (int(WI.CANCEL), int(WI.UPDATE_PAYLOAD))
             ):
@@ -428,6 +433,11 @@ class TpuPartitionEngine:
                 or value.workflow_instance_key in instances
             )
         if vt == int(ValueType.JOB):
+            if self._nonscalar_payload(record):
+                # e.g. a worker completing with a list-valued result:
+                # process_batch demotes the owning instance first (for
+                # commands; job events with such payloads are host-born)
+                return True
             return (
                 value.headers.workflow_key in self._host_only_keys
                 or record.key in self._host.jobs
@@ -566,7 +576,10 @@ class TpuPartitionEngine:
                     value=self._job_value_from_slot(int(slot)),
                 )
             )
-        return out
+        # jobs of host-only/demoted workflows live in the embedded oracle;
+        # merge key-sorted so mixed device+host populations emit the same
+        # global order the pure oracle would (log order IS the contract)
+        return sorted(out + self._host.check_job_deadlines(), key=lambda r: r.key)
 
     def check_timer_deadlines(self) -> List[Record]:
         now = self.clock()
@@ -598,7 +611,12 @@ class TpuPartitionEngine:
                     ),
                 )
             )
-        return out
+        # timers of host-only/demoted workflows (incl. boundary-event
+        # timers) live in the embedded oracle and must be swept too;
+        # key-sorted merge = the pure oracle's global order
+        return sorted(
+            out + self._host.check_timer_deadlines(), key=lambda r: r.key
+        )
 
     def check_message_ttls(self) -> List[Record]:
         return self._host.check_message_ttls()
@@ -794,6 +812,27 @@ class TpuPartitionEngine:
                         self._demote_instance(
                             record.value.workflow_instance_key
                         )
+                elif (
+                    vt == int(ValueType.JOB)
+                    and int(md.record_type) == int(RecordType.COMMAND)
+                    and self._nonscalar_payload(record)
+                ):
+                    # a non-columnar job result drags the owning instance
+                    # to the host path before the command applies. Client
+                    # commands may omit headers — resolve the owner from
+                    # the device job table by job key then.
+                    owner = record.value.headers.workflow_instance_key
+                    if owner < 0 and record.key >= 0:
+                        slots = np.nonzero(
+                            np.asarray(self.state.job_key) == record.key
+                        )[0]
+                        if len(slots):
+                            owner = int(
+                                np.asarray(self.state.job_instance_key)[
+                                    int(slots[0])
+                                ]
+                            )
+                    self._demote_instance(owner)
                 deployed_before = len(self.repository.by_key)
                 per_record[i] = self._host.process(record)
                 if len(self.repository.by_key) != deployed_before:
@@ -811,6 +850,22 @@ class TpuPartitionEngine:
         if records:
             self.last_processed_position = records[-1].position
         return merged
+
+    @staticmethod
+    def _nonscalar_payload(record: Record) -> bool:
+        """True when the record payload holds values with no device column
+        form (lists/nested documents) — such records take the host path.
+        Device-born events are scalar by induction, so a non-scalar
+        payload implies host ownership even before the oracle's
+        element-instance index has the entry (e.g. the CREATED event of an
+        instance whose CREATE was host-routed for this same reason)."""
+        payload = getattr(record.value, "payload", None)
+        if not payload:
+            return False
+        return any(
+            not isinstance(v, (type(None), bool, int, float, str))
+            for v in payload.values()
+        )
 
     def _inexact_payload_value(self, record: Record):
         """Name of the first payload entry not exactly representable in
