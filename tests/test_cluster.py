@@ -606,3 +606,179 @@ class TestTpuClusterServing:
                 client.close()
         finally:
             cluster.close()
+
+    def test_multi_partition_device_cluster(self, tmp_path):
+        """Two device-backed partitions serving independently (the DP
+        sharding axis of SURVEY §2: partitions are the shards)."""
+        cluster = ClusterUnderTest(tmp_path, n_brokers=2, partitions=2, engine="tpu")
+        try:
+            cluster.await_leaders()
+            from zeebe_tpu.tpu import TpuPartitionEngine
+
+            for pid in (0, 1):
+                leader = cluster.leader_of(pid)
+                assert isinstance(leader.partitions[pid].engine, TpuPartitionEngine)
+            client = cluster.client()
+            try:
+                client.deploy_model(order_process())
+                done = []
+                worker = client.open_job_worker(
+                    "payment-service", lambda pid, rec: done.append((pid, rec.key))
+                )
+                for i in range(6):  # round-robins over both partitions
+                    client.create_instance("order-process", {"orderId": i})
+                assert wait_until(lambda: len(done) >= 6, timeout=30), done
+                assert {pid for pid, _ in done} == {0, 1}
+                worker.close()
+            finally:
+                client.close()
+        finally:
+            cluster.close()
+
+    def test_mixed_host_and_device_workflows_across_failover(self, tmp_path):
+        """A device-eligible workflow and a host-demoted one (message
+        receive) served by the same device partition, surviving a leader
+        kill (VERDICT round-2 item 10)."""
+        cluster = ClusterUnderTest(tmp_path, n_brokers=3, partitions=1, engine="tpu")
+        try:
+            cluster.await_leaders()
+            client = cluster.client()
+            try:
+                client.deploy_model(order_process())
+                client.deploy_model(
+                    Bpmn.create_process("await-payment")
+                    .start_event()
+                    .receive_task(
+                        "wait", message_name="paid", correlation_key="$.oid"
+                    )
+                    .end_event()
+                    .done()
+                )
+                done = []
+                worker = client.open_job_worker(
+                    "payment-service",
+                    lambda pid, rec: done.append(rec.key),
+                    timeout_ms=8_000,
+                )
+                client.create_instance("order-process", {"orderId": 1})
+                client.create_instance("await-payment", {"oid": "a-1"})
+                assert wait_until(lambda: len(done) >= 1, timeout=20), done
+
+                old = cluster.leader_of(0)
+                old.close()
+                del cluster.brokers[old.node_id]
+                assert wait_until(lambda: cluster.leader_of(0) is not None, 30)
+
+                # device workflow still serves...
+                client.create_instance("order-process", {"orderId": 2})
+                assert wait_until(lambda: len(done) >= 2, timeout=30), done
+                # ...and the host-demoted instance still correlates
+                client.publish_message("paid", "a-1", {"ok": True})
+
+                def host_done():
+                    leader = cluster.leader_of(0)
+                    records = [
+                        r for r in leader.partitions[0].log.reader(0)
+                        if getattr(r.value, "bpmn_process_id", "") == "await-payment"
+                        and r.metadata.intent == 9  # ELEMENT_COMPLETED
+                    ]
+                    return bool(records)
+
+                assert wait_until(host_done, timeout=30)
+                worker.close()
+            finally:
+                client.close()
+        finally:
+            cluster.close()
+
+    def test_device_snapshot_under_load(self, tmp_path):
+        """Checkpointing while instances are in flight (jobs outstanding)
+        must capture a restorable state: kill the leader mid-stream and
+        the successor finishes the backlog."""
+        cluster = ClusterUnderTest(tmp_path, n_brokers=3, partitions=1, engine="tpu")
+        try:
+            cluster.await_leaders()
+            client = cluster.client()
+            try:
+                client.deploy_model(order_process())
+                done = []
+
+                def handler(pid, rec):
+                    done.append(rec.key)
+                    return {"paid": True}
+
+                # short job timeout: a job in flight when the leader dies
+                # must re-activate within the test window (at-least-once)
+                worker = client.open_job_worker(
+                    "payment-service", handler, timeout_ms=8_000
+                )
+                for i in range(8):
+                    client.create_instance("order-process", {"orderId": i})
+                # snapshot while some jobs are still outstanding
+                leader = cluster.leader_of(0)
+                leader.snapshot_all()
+                assert wait_until(
+                    lambda: all(
+                        b.partitions[0].snapshots.storage.list()
+                        for b in cluster.brokers.values()
+                    ),
+                    timeout=20,
+                )
+                old_id = leader.node_id
+                leader.close()
+                del cluster.brokers[old_id]
+                assert wait_until(lambda: cluster.leader_of(0) is not None, 30)
+                assert wait_until(lambda: len(done) >= 8, timeout=40), len(done)
+                worker.close()
+            finally:
+                client.close()
+        finally:
+            cluster.close()
+
+    def test_fresh_worker_after_failover_gets_backlog(self, tmp_path):
+        """A worker that does NOT re-subscribe across the failover: jobs
+        created before the leader died are activated for a NEW worker that
+        first connects to the successor (backlog activation on subscribe —
+        reference ActivateJobStreamProcessor reads the log from the
+        start)."""
+        cluster = ClusterUnderTest(tmp_path, n_brokers=3, partitions=1, engine="tpu")
+        try:
+            cluster.await_leaders()
+            client = cluster.client()
+            try:
+                client.deploy_model(order_process())
+                # no worker yet: jobs pile up as CREATED
+                for i in range(3):
+                    client.create_instance("order-process", {"orderId": i})
+
+                def jobs_created():
+                    leader = cluster.leader_of(0)
+                    return (
+                        sum(
+                            1 for r in leader.partitions[0].log.reader(0)
+                            if r.metadata.value_type == 0  # JOB
+                            and r.metadata.intent == 1  # CREATED
+                        )
+                        >= 3
+                    )
+
+                assert wait_until(jobs_created, timeout=20)
+                old = cluster.leader_of(0)
+                old.close()
+                del cluster.brokers[old.node_id]
+                assert wait_until(lambda: cluster.leader_of(0) is not None, 30)
+            finally:
+                client.close()
+            # a FRESH client+worker connects only after the failover
+            client2 = cluster.client()
+            try:
+                done = []
+                worker = client2.open_job_worker(
+                    "payment-service", lambda pid, rec: done.append(rec.key)
+                )
+                assert wait_until(lambda: len(done) >= 3, timeout=30), done
+                worker.close()
+            finally:
+                client2.close()
+        finally:
+            cluster.close()

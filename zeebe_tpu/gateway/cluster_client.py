@@ -443,13 +443,36 @@ class RemoteJobWorker:
     def _on_record(self, partition: int, record: Record) -> None:
         self.handled.append(record)
         try:
-            result = self.handler(partition, record)
-        except Exception:  # noqa: BLE001 - worker handler errors fail the job
-            self.client.fail_job(partition, record.key, record.value.retries - 1)
-            return
-        self.client.complete_job(
-            partition, record.key, result if isinstance(result, dict) else None
-        )
+            try:
+                result = self.handler(partition, record)
+            except Exception:  # noqa: BLE001 - handler errors fail the job
+                try:
+                    self.client.fail_job(
+                        partition, record.key, record.value.retries - 1
+                    )
+                except (ClientException, TransportError, TimeoutError):
+                    pass  # job already final or broker unreachable
+                return
+            try:
+                self.client.complete_job(
+                    partition, record.key,
+                    result if isinstance(result, dict) else None,
+                )
+            except ClientException:
+                # at-least-once delivery: a failover can re-push a job
+                # whose COMPLETE already committed — the rejection is
+                # expected and must not break the worker (reference
+                # JobSubscriber tolerates completion rejections the same
+                # way)
+                pass
+            except (TransportError, TimeoutError):
+                # broker unreachable: the job times out server-side and
+                # re-activates; this worker keeps its credit flowing
+                pass
+        finally:
+            self._replenish(partition)
+
+    def _replenish(self, partition: int) -> None:
         # replenish the consumed credit
         addr = self.client._leader_for(partition)
         if addr is not None:

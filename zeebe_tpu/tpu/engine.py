@@ -123,6 +123,7 @@ class TpuPartitionEngine:
         )
         self._compiled_count = 0
         self._host_only_keys: set = set()
+        self._device_keys_dirty = False
         # ONE position→record cache shared with the embedded host oracle:
         # the broker fills it during recovery, host-side incident
         # resolution reads it (reference TypedStreamReader by position)
@@ -764,10 +765,36 @@ class TpuPartitionEngine:
         # depend on state a preceding device record writes, e.g. a job
         # COMPLETE followed by the instance's CANCEL)
         pending: List[int] = []
+        # the two engines allocate from ONE keyspace; their counters sync
+        # at segment boundaries so keys never collide across the
+        # host/device split. Device→host pulls cost a device read and only
+        # happen when a device segment has run since the last pull — the
+        # flag lives on SELF because the boundary usually falls BETWEEN
+        # process_batch calls; host→device pushes are device-side maxima
+        # (no read).
+        host_allocated = [False]
+
+        def push_host_keys() -> None:
+            if not host_allocated[0]:
+                return
+            # device-side maxima: no host↔device round trip
+            self.state = dataclasses.replace(
+                self.state,
+                next_wf_key=jnp.maximum(
+                    self.state.next_wf_key,
+                    jnp.asarray(self._host.wf_keys.peek, jnp.int64),
+                ),
+                next_job_key=jnp.maximum(
+                    self.state.next_job_key,
+                    jnp.asarray(self._host.job_keys.peek, jnp.int64),
+                ),
+            )
+            host_allocated[0] = False
 
         def flush() -> None:
             if not pending:
                 return
+            push_host_keys()  # device allocations continue after the host's
             results = self._process_device(
                 [records[i] for i in pending],
                 [records[i].position for i in pending],
@@ -775,6 +802,7 @@ class TpuPartitionEngine:
             for i, res in zip(pending, results):
                 per_record[i] = res
             pending.clear()
+            self._device_keys_dirty = True
 
         for i, record in enumerate(records):
             vt = int(record.metadata.value_type)
@@ -799,6 +827,11 @@ class TpuPartitionEngine:
                 pending.append(i)
             else:
                 flush()  # earlier device rows execute BEFORE this record
+                if self._device_keys_dirty:
+                    self._pull_device_keys_into_host()
+                    self._device_keys_dirty = False
+                wf_peek = self._host.wf_keys.peek
+                job_peek = self._host.job_keys.peek
                 if (
                     vt == int(ValueType.WORKFLOW_INSTANCE)
                     and int(md.record_type) == int(RecordType.COMMAND)
@@ -837,7 +870,13 @@ class TpuPartitionEngine:
                 per_record[i] = self._host.process(record)
                 if len(self.repository.by_key) != deployed_before:
                     self._recompile()
+                if (
+                    self._host.wf_keys.peek != wf_peek
+                    or self._host.job_keys.peek != job_peek
+                ):
+                    host_allocated[0] = True
         flush()
+        push_host_keys()
 
         merged = ProcessingResult()
         for res in per_record:
@@ -850,6 +889,19 @@ class TpuPartitionEngine:
         if records:
             self.last_processed_position = records[-1].position
         return merged
+
+    def _pull_device_keys_into_host(self) -> None:
+        """Advance the embedded oracle's key generators past the device
+        counters (one device→host scalar read; called only at
+        device-segment → host-record boundaries)."""
+        from zeebe_tpu.engine import keyspace
+
+        dev_wf = int(np.asarray(self.state.next_wf_key))
+        dev_job = int(np.asarray(self.state.next_job_key))
+        if self._host.wf_keys.peek < dev_wf:
+            self._host.wf_keys.set_key(dev_wf - keyspace.STEP_SIZE)
+        if self._host.job_keys.peek < dev_job:
+            self._host.job_keys.set_key(dev_job - keyspace.STEP_SIZE)
 
     @staticmethod
     def _nonscalar_payload(record: Record) -> bool:
