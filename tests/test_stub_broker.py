@@ -1,0 +1,217 @@
+"""Client-side tests against the scriptable stub broker.
+
+Reference parity: ``protocol-test-util/.../brokerapi/StubBrokerRule`` —
+the gateway/client tests run against a FAKE broker with scripted
+responses and failure injection (timeouts, rejections, leader
+redirects), never a real engine. Covers both native-protocol clients:
+the Python ``ClusterClient`` and the C++ ``clients/cpp/zbclient``.
+"""
+
+import os
+import subprocess
+import time
+
+import pytest
+
+from zeebe_tpu.gateway.client import ClientException
+from zeebe_tpu.gateway.cluster_client import ClusterClient
+from zeebe_tpu.models.bpmn.builder import Bpmn
+from zeebe_tpu.models.bpmn.xml import write_model
+from zeebe_tpu.protocol import codec
+from zeebe_tpu.protocol.enums import RecordType, ValueType
+from zeebe_tpu.protocol.intents import JobIntent
+from zeebe_tpu.protocol.metadata import RecordMetadata
+from zeebe_tpu.protocol.records import JobHeaders, JobRecord, Record
+from zeebe_tpu.testing import StubBroker
+from zeebe_tpu.transport import TransportError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLIENT_DIR = os.path.join(REPO, "clients", "cpp")
+CLIENT_BIN = os.path.join(CLIENT_DIR, "zbclient")
+
+
+@pytest.fixture
+def stub():
+    s = StubBroker()
+    yield s
+    s.close()
+
+
+@pytest.fixture
+def client(stub):
+    c = ClusterClient([stub.address], request_timeout_ms=4000)
+    yield c
+    c.close()
+
+
+class TestPythonClientAgainstStub:
+    def test_requests_are_recorded(self, stub, client):
+        record = client.create_instance("order-process", {"x": 1})
+        assert record.value.workflow_instance_key > 0
+        commands = stub.requests_of("command")
+        assert len(commands) == 1
+        sent, _ = codec.decode_record(bytes(commands[0]["frame"]))
+        assert sent.value.bpmn_process_id == "order-process"
+        assert sent.value.payload == {"x": 1}
+
+    def test_rejection_surfaces_as_client_exception(self, stub, client):
+        stub.reject_next("command", reason="scripted: not today")
+        with pytest.raises(ClientException) as e:
+            client.create_instance("order-process", {})
+        assert "not today" in str(e.value)
+
+    def test_dropped_response_times_out(self, stub, client):
+        stub.drop_next("command")
+        t0 = time.monotonic()
+        with pytest.raises(TransportError):
+            client.create_instance("order-process", {})
+        assert time.monotonic() - t0 >= 3.0  # waited out the deadline
+
+    def test_not_leader_redirect_retries_via_topology(self, stub, client):
+        stub.redirect_next("command")
+        record = client.create_instance("order-process", {})
+        assert record.value.workflow_instance_key > 0
+        # the client re-fetched topology between the redirect and the retry
+        types = [t for t, _ in stub.requests]
+        assert types.count("command") == 2
+        assert "topology" in types[types.index("command") + 1 :]
+
+    def test_worker_receives_scripted_push_and_completes(self, stub, client):
+        done = []
+        worker = client.open_job_worker(
+            "payment-service", lambda pid, rec: done.append(rec.key) or {"ok": 1}
+        )
+        deadline = time.time() + 5
+        while time.time() < deadline and not stub.requests_of("job-subscription"):
+            time.sleep(0.02)
+        subs = stub.requests_of("job-subscription")
+        assert subs and subs[0]["action"] == "add"
+        key = int(subs[0]["subscriber_key"])
+
+        job = Record(
+            key=77,
+            position=5,
+            metadata=RecordMetadata(
+                record_type=RecordType.EVENT,
+                value_type=ValueType.JOB,
+                intent=int(JobIntent.ACTIVATED),
+            ),
+            value=JobRecord(
+                type="payment-service", retries=3, payload={"total": 9},
+                headers=JobHeaders(workflow_instance_key=1),
+            ),
+        )
+        stub.push_job(key, job)
+        deadline = time.time() + 5
+        while time.time() < deadline and not done:
+            time.sleep(0.02)
+        assert done == [77]
+        # the worker sent COMPLETE and replenished its credit
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            completes = [
+                m for m in stub.requests_of("command")
+                if codec.decode_record(bytes(m["frame"]))[0].metadata.value_type
+                == ValueType.JOB
+            ]
+            credits = [
+                m for m in stub.requests_of("job-subscription")
+                if m.get("action") == "credits"
+            ]
+            if completes and credits:
+                break
+            time.sleep(0.02)
+        assert completes and credits
+        worker.close()
+
+    def test_latency_injection_within_deadline(self, stub, client):
+        stub.delay("command", 500)
+        t0 = time.monotonic()
+        client.create_instance("order-process", {})
+        assert time.monotonic() - t0 >= 0.5
+
+
+@pytest.fixture(scope="module")
+def client_bin():
+    proc = subprocess.run(
+        ["make", "-C", CLIENT_DIR], capture_output=True, text=True
+    )
+    if proc.returncode != 0:
+        pytest.skip(f"C++ toolchain unavailable: {proc.stderr[-300:]}")
+    return CLIENT_BIN
+
+
+class TestCppClientAgainstStub:
+    def test_topology(self, client_bin, stub):
+        out = subprocess.run(
+            [client_bin, stub.address.host, str(stub.address.port), "topology"],
+            capture_output=True, text=True, timeout=30,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "partition 0 leader" in out.stdout
+
+    def test_order_process_flow_with_scripted_push(self, client_bin, stub, tmp_path):
+        """The full C++ flow (deploy → subscribe → create → push →
+        complete) against the stub: the push is scripted, no engine."""
+        model = (
+            Bpmn.create_process("order-process")
+            .start_event("s")
+            .service_task("collect-money", type="payment-service")
+            .end_event("e")
+            .done()
+        )
+        bpmn = tmp_path / "order.bpmn"
+        bpmn.write_bytes(write_model(model))
+
+        import threading
+
+        def push_when_subscribed():
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                subs = stub.requests_of("job-subscription")
+                if subs:
+                    job = Record(
+                        key=901,
+                        position=9,
+                        metadata=RecordMetadata(
+                            record_type=RecordType.EVENT,
+                            value_type=ValueType.JOB,
+                            intent=int(JobIntent.ACTIVATED),
+                        ),
+                        value=JobRecord(
+                            type="payment-service", retries=3,
+                            payload={"orderId": 31243},
+                            headers=JobHeaders(workflow_instance_key=1),
+                        ),
+                    )
+                    time.sleep(0.2)  # let the worker enter its poll loop
+                    stub.push_job(int(subs[0]["subscriber_key"]), job)
+                    return
+                time.sleep(0.05)
+
+        pusher = threading.Thread(target=push_when_subscribed)
+        pusher.start()
+        out = subprocess.run(
+            [client_bin, stub.address.host, str(stub.address.port),
+             "run-order-process", str(bpmn)],
+            capture_output=True, text=True, timeout=60,
+        )
+        pusher.join()
+        assert out.returncode == 0, (out.stdout, out.stderr)
+        assert "ORDER-PROCESS-OK" in out.stdout
+        assert "job pushed key=901" in out.stdout
+        # COMPLETE arrived at the stub
+        completes = [
+            m for m in stub.requests_of("command")
+            if codec.decode_record(bytes(m["frame"]))[0].metadata.value_type
+            == ValueType.JOB
+        ]
+        assert completes
+
+    def test_cpp_client_times_out_cleanly_on_dropped_topology(self, client_bin, stub):
+        stub.drop_next("topology")
+        out = subprocess.run(
+            [client_bin, stub.address.host, str(stub.address.port), "topology"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode != 0

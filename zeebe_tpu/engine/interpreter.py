@@ -1196,7 +1196,12 @@ class PartitionEngine:
         # TPU-native: close message subscription / cancel timer, then terminate
         if element.message_name:
             value: WorkflowInstanceRecord = record.value
-            found, corr_value = query_json_path(value.payload, element.correlation_key_path)
+            try:
+                found, corr_value = query_json_path(
+                    value.payload, element.correlation_key_path
+                )
+            except ValueError:
+                found, corr_value = False, None
             if found:
                 target = self.partition_for_correlation_key(str(corr_value))
                 close = MessageSubscriptionRecord(
@@ -1228,7 +1233,12 @@ class PartitionEngine:
         # reference SubscribeMessageHandler: extract correlation key, send
         # OpenMessageSubscription to the message partition
         value: WorkflowInstanceRecord = record.value
-        found, corr_value = query_json_path(value.payload, element.correlation_key_path)
+        try:
+            found, corr_value = query_json_path(
+                value.payload, element.correlation_key_path
+            )
+        except ValueError:
+            found, corr_value = False, None
         if not found or not isinstance(corr_value, (str, int)):
             self._raise_incident(
                 record,
@@ -1373,9 +1383,12 @@ class PartitionEngine:
                     _record(RecordType.COMMAND, timer, TimerIntent.CREATE, -1, record.position)
                 )
             elif boundary.message_name:
-                found, corr_value = query_json_path(
-                    value.payload, boundary.correlation_key_path
-                )
+                try:
+                    found, corr_value = query_json_path(
+                        value.payload, boundary.correlation_key_path
+                    )
+                except ValueError:
+                    found, corr_value = False, None
                 if not found or not isinstance(corr_value, (str, int)):
                     self._raise_incident(
                         record,
@@ -1411,9 +1424,12 @@ class PartitionEngine:
         for boundary in element.boundary_events:
             if not boundary.message_name:
                 continue
-            found, corr_value = query_json_path(
-                value.payload, boundary.correlation_key_path
-            )
+            try:
+                found, corr_value = query_json_path(
+                    value.payload, boundary.correlation_key_path
+                )
+            except ValueError:
+                continue
             if not found:
                 continue
             target = self.partition_for_correlation_key(str(corr_value))

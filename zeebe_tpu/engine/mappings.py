@@ -21,7 +21,10 @@ class MappingError(ValueError):
 
 
 def _set_path(document: Dict[str, Any], path: str, value: Any) -> None:
-    steps = compile_json_path(path)
+    try:
+        steps = compile_json_path(path)
+    except ValueError as e:
+        raise MappingError(str(e)) from None
     if not steps:
         raise MappingError("Target mapping '$' must be the only mapping")
     node = document
@@ -39,11 +42,20 @@ def _set_path(document: Dict[str, Any], path: str, value: Any) -> None:
     node[last] = value
 
 
+def _query(document: Dict[str, Any], path: str):
+    """Runtime query: any path error becomes a MappingError so the engine
+    raises an IO_MAPPING_ERROR incident instead of crashing the step."""
+    try:
+        return query_json_path(document, path)
+    except ValueError as e:
+        raise MappingError(str(e)) from None
+
+
 def extract(document: Dict[str, Any], mappings: List[Mapping]) -> Dict[str, Any]:
     """Build a new document from mappings (reference MappingProcessor.extract)."""
     result: Dict[str, Any] = {}
     for mapping in mappings:
-        found, value = query_json_path(document, mapping.source)
+        found, value = _query(document, mapping.source)
         if not found:
             raise MappingError(
                 f"No data found for query {mapping.source}."
@@ -74,7 +86,7 @@ def merge(
         result.update(source)
         return result
     for mapping in mappings:
-        found, value = query_json_path(source, mapping.source)
+        found, value = _query(source, mapping.source)
         if not found:
             raise MappingError(f"No data found for query {mapping.source}.")
         if mapping.target == "$":

@@ -24,6 +24,7 @@ from zeebe_tpu.models.bpmn.model import (
     SubProcess,
 )
 from zeebe_tpu.models.el.parser import ConditionParseError, parse_condition
+from zeebe_tpu.protocol.jsonpath import JsonPathError, compile_query
 
 
 @dataclasses.dataclass
@@ -51,7 +52,25 @@ def validate_model(model: BpmnModel) -> List[ValidationError]:
                 ValidationError(process.id, "process must have exactly one start event")
             )
 
+    def check_path(element_id: str, path: str, what: str) -> None:
+        if not path:
+            return
+        try:
+            compile_query(path)
+        except JsonPathError as e:
+            errors.append(ValidationError(element_id, f"{what}: {e}"))
+
     for element in model.elements.values():
+        if isinstance(element, FlowNode):
+            for m in element.input_mappings:
+                check_path(element.id, m.source, "input mapping source")
+                check_path(element.id, m.target, "input mapping target")
+            for m in element.output_mappings:
+                check_path(element.id, m.source, "output mapping source")
+                check_path(element.id, m.target, "output mapping target")
+        msg = getattr(element, "message", None)
+        if msg is not None and msg.correlation_key:
+            check_path(element.id, msg.correlation_key, "correlation key")
         if isinstance(element, ServiceTask):
             if not element.task_definition.type:
                 errors.append(

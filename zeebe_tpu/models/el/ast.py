@@ -50,56 +50,33 @@ class Conjunction(Condition):
 
 
 def compile_json_path(path: str) -> List[Union[str, int]]:
-    """Compile a JSONPath subset to access steps.
+    """Compile a JSONPath subset to flat access steps.
 
-    Reference: ``json-path/.../jsonpath/JsonPathQueryCompiler.java`` — the
-    engine subset: ``$``, ``$.a.b``, ``$['a']``, ``$.items[0]``.
+    Reference: ``json-path/.../jsonpath/JsonPathQueryCompiler.java``. The
+    single grammar lives in ``zeebe_tpu.protocol.jsonpath`` (tokenizer +
+    compiled queries); this legacy step-list form rejects wildcards —
+    callers that can fan out use ``compile_query`` directly.
     """
-    if not path.startswith("$"):
-        raise ValueError(f"JSONPath must start with '$': {path}")
-    steps: List[Union[str, int]] = []
-    i = 1
-    n = len(path)
-    while i < n:
-        ch = path[i]
-        if ch == ".":
-            i += 1
-            start = i
-            while i < n and path[i] not in ".[":
-                i += 1
-            if i > start:
-                steps.append(path[start:i])
-        elif ch == "[":
-            i += 1
-            if i < n and path[i] in "'\"":
-                quote = path[i]
-                i += 1
-                start = i
-                while i < n and path[i] != quote:
-                    i += 1
-                steps.append(path[start:i])
-                i += 2  # skip quote and ]
-            else:
-                start = i
-                while i < n and path[i] != "]":
-                    i += 1
-                steps.append(int(path[start:i]))
-                i += 1
-        else:
-            raise ValueError(f"bad JSONPath syntax at {i}: {path}")
-    return steps
+    from zeebe_tpu.protocol.jsonpath import WILDCARD, JsonPathError, compile_query
+
+    try:
+        query = compile_query(path)
+    except JsonPathError as e:
+        raise ValueError(str(e)) from None
+    if any(s is WILDCARD for s in query.steps):
+        raise ValueError(f"wildcards not supported here: {path!r}")
+    return list(query.steps)
 
 
 def query_json_path(document: Any, path: str):
-    """Apply a compiled path to a document; returns (found, value)."""
-    node = document
-    for step in compile_json_path(path):
-        if isinstance(step, str):
-            if not isinstance(node, dict) or step not in node:
-                return False, None
-            node = node[step]
-        else:
-            if not isinstance(node, list) or step >= len(node) or step < -len(node):
-                return False, None
-            node = node[step]
-    return True, node
+    """Apply a compiled path to a document; returns (found, value).
+
+    Full grammar (incl. wildcards) lives in
+    ``zeebe_tpu.protocol.jsonpath`` — the tokenizer/compiler layer
+    (reference JsonPathQueryCompiler); this is the convenience form."""
+    from zeebe_tpu.protocol.jsonpath import JsonPathError, compile_query
+
+    try:
+        return compile_query(path).evaluate_one(document)
+    except JsonPathError as e:
+        raise ValueError(str(e)) from None

@@ -1,0 +1,5 @@
+"""Test utilities shipped with the framework (reference parity:
+``protocol-test-util`` — the stub broker, record asserts, controlled
+clocks are product surface, not private test code)."""
+
+from zeebe_tpu.testing.stub_broker import StubBroker  # noqa: F401
