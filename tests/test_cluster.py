@@ -17,7 +17,7 @@ from zeebe_tpu.runtime.cluster_broker import ClusterBroker
 from zeebe_tpu.runtime.config import BrokerCfg
 
 
-def wait_until(predicate, timeout=20.0, interval=0.02):
+def wait_until(predicate, timeout=60.0, interval=0.02):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if predicate():
@@ -92,7 +92,7 @@ class ClusterUnderTest:
                 members = {nid: a for nid, a in addrs.items() if nid != node_id}
                 broker.bootstrap_partition(pid, members)
 
-    def await_leaders(self, timeout=30):
+    def await_leaders(self, timeout=60):
         def all_led():
             return all(
                 any(
@@ -146,7 +146,7 @@ class TestClusterHappyPath:
             )
             created = client.create_instance("order-process", {"orderId": 42})
             assert created.value.workflow_instance_key > 0
-            assert wait_until(lambda: len(done) == 1, timeout=20), done
+            assert wait_until(lambda: len(done) == 1), done
             worker.close()
         finally:
             client.close()
@@ -164,7 +164,6 @@ class TestClusterHappyPath:
                     b.partitions[0].log.next_position >= target
                     for b in cluster3.brokers.values()
                 ),
-                timeout=20,
             ), {
                 nid: b.partitions[0].log.next_position
                 for nid, b in cluster3.brokers.items()
@@ -187,7 +186,7 @@ class TestClusterHappyPath:
                     and leaders[0].port == leader_broker.client_address.port
                 )
 
-            assert wait_until(topology_converged, timeout=20)
+            assert wait_until(topology_converged)
         finally:
             client.close()
 
@@ -209,7 +208,7 @@ class TestLeaderChange:
             del cluster3.brokers[old_id]
 
             assert wait_until(
-                lambda: cluster3.leader_of(0) is not None, timeout=30
+                lambda: cluster3.leader_of(0) is not None
             ), "no new leader elected"
 
             # the new leader replayed the log: deployment + first instance
@@ -226,7 +225,7 @@ class TestLeaderChange:
             client.create_instance("order-process")
             # both instances' jobs eventually reach the worker (the first
             # job was CREATED before the failover, rebuilt by replay)
-            assert wait_until(lambda: len(done) >= 2, timeout=20), done
+            assert wait_until(lambda: len(done) >= 2), done
             worker.close()
         finally:
             client.close()
@@ -262,7 +261,7 @@ class TestWorkerDisconnect:
                 "payment-service", lambda pid, rec: done.append(rec.key) or {}
             )
             client.create_instance("order-process")
-            assert wait_until(lambda: len(done) == 1, timeout=20), done
+            assert wait_until(lambda: len(done) == 1), done
             worker.close()
         finally:
             client.close()
@@ -285,7 +284,6 @@ class TestTopicSubscriptions:
                     r.metadata.value_type == ValueType.WORKFLOW_INSTANCE
                     for r in sub.records
                 ),
-                timeout=20,
             ), [r.metadata.value_type for r in sub.records]
             assert any(
                 r.metadata.value_type == ValueType.DEPLOYMENT for r in sub.records
@@ -304,15 +302,15 @@ class TestTopicSubscriptions:
             sub = client.open_topic_subscription("resume", lambda pid, r: None, ack_batch=1)
             client.deploy_model(order_process())
             client.create_instance("order-process")
-            assert wait_until(lambda: len(sub.records) >= 5, timeout=30)
-            assert wait_until(lambda: sub._since_ack == 0, timeout=30)
+            assert wait_until(lambda: len(sub.records) >= 5)
+            assert wait_until(lambda: sub._since_ack == 0)
             acked_through = sub.records[-1].position
 
             old = cluster3.leader_of(0)
             old_id = old.node_id
             old.close()
             del cluster3.brokers[old_id]
-            assert wait_until(lambda: cluster3.leader_of(0) is not None, timeout=30)
+            assert wait_until(lambda: cluster3.leader_of(0) is not None)
 
             before = len(sub.records)
             client.create_instance("order-process")
@@ -321,7 +319,6 @@ class TestTopicSubscriptions:
             # records BEYOND the acked point (the new instance's) arrive
             assert wait_until(
                 lambda: any(r.position > acked_through for r in sub.records[before:]),
-                timeout=30,
             ), [r.position for r in sub.records[before:]]
             fresh = sub.records[before:]
             assert fresh[0].position > 0, "subscription rewound to log start"
@@ -355,7 +352,7 @@ class TestTopicOrchestration:
                     for pid in pids
                 )
 
-            assert wait_until(all_led, timeout=20)
+            assert wait_until(all_led)
 
             # replication factor: each partition exists on 2 brokers
             for pid in pids:
@@ -375,7 +372,7 @@ class TestTopicOrchestration:
             )
             for pid in pids:
                 client.create_instance("order-process", partition_id=pid)
-            assert wait_until(lambda: len(done) == 2, timeout=30), done
+            assert wait_until(lambda: len(done) == 2), done
             worker.close()
         finally:
             client.close()
@@ -414,7 +411,7 @@ class TestSnapshotReplication:
                         for b in cluster.brokers.values()
                     )
 
-                assert wait_until(followers_have_snapshot, timeout=20), {
+                assert wait_until(followers_have_snapshot), {
                     nid: len(b.partitions[0].snapshots.storage.list())
                     for nid, b in cluster.brokers.items()
                 }
@@ -424,7 +421,7 @@ class TestSnapshotReplication:
                 old_id = leader.node_id
                 leader.close()
                 del cluster.brokers[old_id]
-                assert wait_until(lambda: cluster.leader_of(0) is not None, 30)
+                assert wait_until(lambda: cluster.leader_of(0) is not None)
                 new_leader = cluster.leader_of(0)
                 assert wait_until(
                     lambda: new_leader.repository.latest("order-process") is not None,
@@ -434,7 +431,7 @@ class TestSnapshotReplication:
                 worker = client.open_job_worker(
                     "payment-service", lambda pid, rec: done.append(rec.key)
                 )
-                assert wait_until(lambda: len(done) >= 1, timeout=20), done
+                assert wait_until(lambda: len(done) >= 1), done
                 worker.close()
             finally:
                 client.close()
@@ -474,7 +471,6 @@ class TestSelfAssembly:
                     0 in b.partitions and b.partitions[0].is_leader
                     for b in brokers.values()
                 ),
-                timeout=30,
             )
             assert wait_until(
                 lambda: all(0 in b.partitions for b in brokers.values()), 20
@@ -488,7 +484,7 @@ class TestSelfAssembly:
                         return t is not None and t["state"] == "CREATED"
                 return False
 
-            assert wait_until(topic_created, timeout=30)
+            assert wait_until(topic_created)
             # and it serves real work
             client = ClusterClient([b.client_address for b in brokers.values()])
             try:
@@ -499,7 +495,7 @@ class TestSelfAssembly:
                     partitions=[1],
                 )
                 client.create_instance("order-process", partition_id=1)
-                assert wait_until(lambda: len(done) == 1, timeout=30), done
+                assert wait_until(lambda: len(done) == 1), done
                 worker.close()
             finally:
                 client.close()
@@ -545,7 +541,7 @@ class TestMultiPartition:
                         is None
                     )
 
-                assert wait_until(instance_completed, timeout=30)
+                assert wait_until(instance_completed)
             finally:
                 client.close()
         finally:
@@ -575,7 +571,7 @@ class TestTpuClusterServing:
                     "payment-service", lambda pid, rec: done.append(rec.key)
                 )
                 client.create_instance("order-process", {"orderId": 1})
-                assert wait_until(lambda: len(done) >= 1, timeout=20), done
+                assert wait_until(lambda: len(done) >= 1), done
 
                 # checkpoint on the leader; followers fetch the device
                 # snapshot chunk-wise (it must decode as the device envelope)
@@ -587,12 +583,12 @@ class TestTpuClusterServing:
                         for b in cluster.brokers.values()
                     )
 
-                assert wait_until(followers_have_snapshot, timeout=20)
+                assert wait_until(followers_have_snapshot)
 
                 old_id = leader.node_id
                 leader.close()
                 del cluster.brokers[old_id]
-                assert wait_until(lambda: cluster.leader_of(0) is not None, 30)
+                assert wait_until(lambda: cluster.leader_of(0) is not None)
                 new_leader = cluster.leader_of(0)
                 assert isinstance(new_leader.partitions[0].engine, TpuPartitionEngine)
 
@@ -600,7 +596,7 @@ class TestTpuClusterServing:
                 # completes end-to-end (worker re-subscribes internally via
                 # the cluster client's reconnect)
                 client.create_instance("order-process", {"orderId": 2})
-                assert wait_until(lambda: len(done) >= 2, timeout=30), done
+                assert wait_until(lambda: len(done) >= 2), done
                 worker.close()
             finally:
                 client.close()
@@ -627,7 +623,7 @@ class TestTpuClusterServing:
                 )
                 for i in range(6):  # round-robins over both partitions
                     client.create_instance("order-process", {"orderId": i})
-                assert wait_until(lambda: len(done) >= 6, timeout=30), done
+                assert wait_until(lambda: len(done) >= 6), done
                 assert {pid for pid, _ in done} == {0, 1}
                 worker.close()
             finally:
@@ -662,16 +658,16 @@ class TestTpuClusterServing:
                 )
                 client.create_instance("order-process", {"orderId": 1})
                 client.create_instance("await-payment", {"oid": "a-1"})
-                assert wait_until(lambda: len(done) >= 1, timeout=20), done
+                assert wait_until(lambda: len(done) >= 1), done
 
                 old = cluster.leader_of(0)
                 old.close()
                 del cluster.brokers[old.node_id]
-                assert wait_until(lambda: cluster.leader_of(0) is not None, 30)
+                assert wait_until(lambda: cluster.leader_of(0) is not None)
 
                 # device workflow still serves...
                 client.create_instance("order-process", {"orderId": 2})
-                assert wait_until(lambda: len(done) >= 2, timeout=30), done
+                assert wait_until(lambda: len(done) >= 2), done
                 # ...and the host-demoted instance still correlates
                 client.publish_message("paid", "a-1", {"ok": True})
 
@@ -684,7 +680,7 @@ class TestTpuClusterServing:
                     ]
                     return bool(records)
 
-                assert wait_until(host_done, timeout=30)
+                assert wait_until(host_done)
                 worker.close()
             finally:
                 client.close()
@@ -722,12 +718,11 @@ class TestTpuClusterServing:
                         b.partitions[0].snapshots.storage.list()
                         for b in cluster.brokers.values()
                     ),
-                    timeout=20,
                 )
                 old_id = leader.node_id
                 leader.close()
                 del cluster.brokers[old_id]
-                assert wait_until(lambda: cluster.leader_of(0) is not None, 30)
+                assert wait_until(lambda: cluster.leader_of(0) is not None)
                 assert wait_until(lambda: len(done) >= 8, timeout=40), len(done)
                 worker.close()
             finally:
@@ -762,11 +757,11 @@ class TestTpuClusterServing:
                         >= 3
                     )
 
-                assert wait_until(jobs_created, timeout=20)
+                assert wait_until(jobs_created)
                 old = cluster.leader_of(0)
                 old.close()
                 del cluster.brokers[old.node_id]
-                assert wait_until(lambda: cluster.leader_of(0) is not None, 30)
+                assert wait_until(lambda: cluster.leader_of(0) is not None)
             finally:
                 client.close()
             # a FRESH client+worker connects only after the failover
@@ -776,7 +771,7 @@ class TestTpuClusterServing:
                 worker = client2.open_job_worker(
                     "payment-service", lambda pid, rec: done.append(rec.key)
                 )
-                assert wait_until(lambda: len(done) >= 3, timeout=30), done
+                assert wait_until(lambda: len(done) >= 3), done
                 worker.close()
             finally:
                 client2.close()
