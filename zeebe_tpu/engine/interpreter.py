@@ -486,6 +486,22 @@ class PartitionEngine:
     # ------------------------------------------------------------------
     # main entry: process one committed record
     # ------------------------------------------------------------------
+    def process_batch(self, records: List[Record]) -> ProcessingResult:
+        """Batch drain: per-record processing with per-record source
+        stamping, merged in log order (the device engine overrides this
+        with real SIMD batching)."""
+        from zeebe_tpu.protocol.records import stamp_source_positions
+
+        merged = ProcessingResult()
+        for record in records:
+            res = self.process(record)
+            stamp_source_positions(res.written, record.position)
+            merged.written.extend(res.written)
+            merged.responses.extend(res.responses)
+            merged.sends.extend(res.sends)
+            merged.pushes.extend(res.pushes)
+        return merged
+
     def process(self, record: Record) -> ProcessingResult:
         self.records_by_position[record.position] = record
         out = ProcessingResult()

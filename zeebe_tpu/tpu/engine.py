@@ -878,10 +878,13 @@ class TpuPartitionEngine:
         flush()
         push_host_keys()
 
+        from zeebe_tpu.protocol.records import stamp_source_positions
+
         merged = ProcessingResult()
-        for res in per_record:
+        for i, res in enumerate(per_record):
             if res is None:
                 continue
+            stamp_source_positions(res.written, records[i].position)
             merged.written.extend(res.written)
             merged.responses.extend(res.responses)
             merged.sends.extend(res.sends)
