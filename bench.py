@@ -418,6 +418,16 @@ def main():
 
     backend = jax.default_backend()
     accel = backend not in ("cpu",)
+
+    if backend == "tpu":
+        # the pallas table ops carry the round on TPU; their functional
+        # parity gate runs first so a divergence fails the bench loudly
+        # instead of producing wrong numbers
+        _progress("pallas_ops parity gate...")
+        from benchmarks import pallas_ops_check
+
+        pallas_ops_check.main()
+        _progress("pallas_ops parity gate OK")
     # wave sizing: the drive loop runs entirely on device (lax.while_loop),
     # so throughput saturates well below huge waves; 2^14 keeps XLA's
     # compile of the loop program fast — larger waves blow up the TPU
