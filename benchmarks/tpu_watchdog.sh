@@ -1,0 +1,24 @@
+#!/bin/bash
+# Polls for TPU availability; on recovery runs the round-3 validation
+# chain (pallas parity gate, then the bench matrix) and records results
+# in TPU_VALIDATION.log. Exit codes: 0 = validated, 1 = gate failed or
+# the device never returned.
+cd /root/repo
+LOG=/root/repo/TPU_VALIDATION.log
+echo "watchdog start $(date -u +%FT%TZ)" >> "$LOG"
+for i in $(seq 1 48); do
+  if timeout 120 python -u -c "import jax; assert jax.default_backend() == 'tpu'" >/dev/null 2>&1; then
+    echo "device back $(date -u +%FT%TZ)" >> "$LOG"
+    if ! timeout 900 python benchmarks/pallas_ops_check.py >> "$LOG" 2>&1; then
+      echo "PARITY GATE FAILED — not benchmarking $(date -u +%FT%TZ)" >> "$LOG"
+      exit 1
+    fi
+    echo "--- bench ---" >> "$LOG"
+    BENCH_PROGRESS=1 timeout 3000 python bench.py >> "$LOG" 2>&1
+    echo "watchdog done $(date -u +%FT%TZ)" >> "$LOG"
+    exit 0
+  fi
+  sleep 300
+done
+echo "device never returned $(date -u +%FT%TZ)" >> "$LOG"
+exit 1
