@@ -258,6 +258,11 @@ def run_serving_path(n_instances=2048, engine="tpu", threads=8):
             while _time.time() < t_w and not done:
                 _time.sleep(0.05)
 
+            # timed window excludes the warm-up instance and its records:
+            # snapshot the log position and completed count at t0 and report
+            # deltas only
+            warm_done = len(done)
+            records_at_t0 = int(broker.partitions[0].log.next_position)
             t0 = _time.perf_counter()
 
             def pump(k):
@@ -271,21 +276,21 @@ def run_serving_path(n_instances=2048, engine="tpu", threads=8):
                 t.start()
             for t in ts:
                 t.join()
-            total = (n_instances // threads) * threads + 1
+            total = (n_instances // threads) * threads
             t_done = _time.time() + 300
-            while _time.time() < t_done and len(done) < total:
+            while _time.time() < t_done and len(done) - warm_done < total:
                 _time.sleep(0.05)
             elapsed = _time.perf_counter() - t0
             worker.close()
-            records = broker.partitions[0].log.next_position
+            records = int(broker.partitions[0].log.next_position) - records_at_t0
             return {
                 "config": "serving-path-1-service-task",
                 "engine": engine,
                 "instances": total,
-                "completed_jobs": len(done),
-                "records": int(records),
+                "completed_jobs": len(done) - warm_done,
+                "records": records,
                 "elapsed_sec": round(elapsed, 3),
-                "transitions_per_sec": round(int(records) / elapsed, 1),
+                "transitions_per_sec": round(records / elapsed, 1),
                 "instances_per_sec": round(total / elapsed, 1),
             }
         finally:
@@ -388,9 +393,11 @@ def run_device_config(build_fn, label, total_instances, wave, progress):
     processed, completed = int(host["p"]), int(host["c"])
     assert not bool(host["o"]), f"{label}: device table overflow"
     assert completed == waves * wave, (label, completed, waves * wave)
+    import jax as _jax
+
     return {
         "config": label,
-        "engine": "tpu-kernel",
+        "engine": f"{_jax.default_backend()}-kernel",
         "instances": waves * wave,
         "records": processed,
         "elapsed_sec": round(elapsed, 3),
@@ -398,6 +405,39 @@ def run_device_config(build_fn, label, total_instances, wave, progress):
         "transitions_per_instance": round(processed / (waves * wave), 1),
         "transitions_per_sec": round(processed / elapsed, 1),
     }
+
+
+def _probe_backend(timeout_sec=180):
+    """Probe the accelerator in a SUBPROCESS with a hard timeout.
+
+    A downed TPU tunnel makes ``jax.devices()`` hang forever (round 3's
+    ``BENCH_r03.json`` was a traceback; the hang variant is worse), and a
+    hang in the parent cannot be caught with try/except. Probing in a
+    child process lets us kill it and fall back to CPU with an explicit
+    marker instead of zeroing the round.
+    Returns (backend, device_status, error_or_None).
+    """
+    import os
+    import subprocess
+    import sys
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return "cpu", "forced-cpu", None
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=timeout_sec,
+        )
+    except subprocess.TimeoutExpired:
+        return "cpu", "unavailable", f"device probe hung >{timeout_sec}s"
+    if out.returncode != 0:
+        tail = (out.stderr or out.stdout or "").strip().splitlines()[-1:]
+        return "cpu", "unavailable", (tail[0] if tail else "probe failed")[:300]
+    platform = out.stdout.strip()
+    if platform in ("cpu",):
+        return "cpu", "no-accelerator", None
+    return platform, "ok", None
 
 
 def main():
@@ -408,6 +448,15 @@ def main():
         if os.environ.get("BENCH_PROGRESS"):
             print(msg, file=sys.stderr, flush=True)
 
+    # probe BEFORE the in-process jax import so a dead tunnel can't hang us
+    backend, device_status, device_error = _probe_backend(
+        timeout_sec=int(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
+    )
+    if backend == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    if device_error:
+        _progress(f"device unavailable ({device_error}); running host/CPU bench")
+
     from zeebe_tpu import tpu as _tpu  # noqa: F401  (enables x64)
     import jax
 
@@ -416,18 +465,32 @@ def main():
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-    backend = jax.default_backend()
     accel = backend not in ("cpu",)
 
     if backend == "tpu":
         # the pallas table ops carry the round on TPU; their functional
-        # parity gate runs first so a divergence fails the bench loudly
-        # instead of producing wrong numbers
+        # parity gate runs first so a divergence fails the bench LOUDLY —
+        # but still with a parseable JSON record, not a bare traceback
         _progress("pallas_ops parity gate...")
-        from benchmarks import pallas_ops_check
+        try:
+            from benchmarks import pallas_ops_check
 
-        pallas_ops_check.main()
-        _progress("pallas_ops parity gate OK")
+            pallas_ops_check.main()
+            _progress("pallas_ops parity gate OK")
+        except Exception as e:  # noqa: BLE001 - outage-proofing
+            print(json.dumps({
+                "metric": "bpmn_token_transitions_per_sec",
+                "value": 0.0,
+                "unit": "transitions/sec",
+                "vs_baseline": 0.0,
+                "detail": {
+                    "backend": backend,
+                    "device_status": "parity-gate-failed",
+                    "device_error": str(e)[:300],
+                    "configs": [],
+                },
+            }))
+            return
     # wave sizing: the drive loop runs entirely on device (lax.while_loop),
     # so throughput saturates well below huge waves; 2^14 keeps XLA's
     # compile of the loop program fast — larger waves blow up the TPU
@@ -435,8 +498,20 @@ def main():
     total_instances = 1 << 20 if accel else 1 << 12
     wave = 1 << 14 if accel else 1 << 10
 
-    # headline: config 1 (the north-star number the driver records)
-    c1 = run_device_config(build_graph, "1-service-task", total_instances, wave, _progress)
+    # headline: config 1 (the north-star number the driver records).
+    # Never let a failure here zero the round: emit the JSON record with an
+    # error field and whatever else still runs.
+    try:
+        c1 = run_device_config(
+            build_graph, "1-service-task", total_instances, wave, _progress
+        )
+    except Exception as e:  # noqa: BLE001 - outage-proofing, report and go on
+        c1 = {
+            "config": "1-service-task",
+            "engine": "tpu-kernel" if accel else "cpu-kernel",
+            "error": str(e)[:300],
+            "transitions_per_sec": 0.0,
+        }
 
     configs = [c1]
     if os.environ.get("BENCH_CONFIGS", "all") != "headline":
@@ -501,9 +576,11 @@ def main():
                 "vs_baseline": round(tps / 10e6, 4),
                 "detail": {
                     "backend": backend,
-                    "instances": c1["instances"],
-                    "records": c1["records"],
-                    "elapsed_sec": c1["elapsed_sec"],
+                    "device_status": device_status,
+                    **({"device_error": device_error} if device_error else {}),
+                    "instances": c1.get("instances"),
+                    "records": c1.get("records"),
+                    "elapsed_sec": c1.get("elapsed_sec"),
                     "wave": c1.get("wave"),
                     "transitions_per_instance": c1.get("transitions_per_instance"),
                     "configs": configs,

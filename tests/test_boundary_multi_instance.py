@@ -309,3 +309,92 @@ class TestMultiInstanceSubProcess:
             if r.metadata.value_type == ValueType.INCIDENT
         ]
         assert "CREATED" in incidents
+
+    def test_malformed_mi_input_collection_rejected_at_deploy(self, broker, client):
+        """Round-3 advisor: a path like 'items' (no '$') must reject at
+        deploy, not raise inside the engine hot loop at activation."""
+        from zeebe_tpu.gateway.client import ClientException
+
+        model = self.mi_model(input_collection="items")
+        with pytest.raises(ClientException) as e:
+            client.deploy_model(model)
+        assert "input collection" in str(e.value)
+
+    def test_malformed_mi_output_element_rejected_at_deploy(self, broker, client):
+        from zeebe_tpu.gateway.client import ClientException
+
+        model = self.mi_model(
+            input_collection="$.items",
+            output_collection="results",
+            output_element="result",  # not a JSONPath
+        )
+        with pytest.raises(ClientException) as e:
+            client.deploy_model(model)
+        assert "output element" in str(e.value)
+
+
+class TestPoisonRecordIsolation:
+    """A record whose handler raises is skipped and recorded — it must not
+    wedge the partition by re-raising on every drain (round-3 advisor;
+    reference StreamProcessorController onError)."""
+
+    def test_process_batch_isolates_poison_record(self):
+        from zeebe_tpu.engine.interpreter import PartitionEngine
+        from zeebe_tpu.models.transform.transformer import transform_model
+        from zeebe_tpu.protocol.enums import RecordType, ValueType
+        from zeebe_tpu.protocol.intents import WorkflowInstanceIntent as WI
+        from zeebe_tpu.protocol.records import (
+            Record, RecordMetadata, WorkflowInstanceRecord,
+        )
+
+        engine = PartitionEngine()
+        model = (
+            Bpmn.create_process("p")
+            .start_event("s")
+            .end_event("e")
+            .done()
+        )
+        workflows = transform_model(model)
+        for wf in workflows:
+            wf.key, wf.version = 1, 1
+        engine.repository.merge(workflows)
+
+        def make(pos, intent, wf_key=1):
+            return Record(
+                key=-1,
+                position=pos,
+                timestamp=0,
+                metadata=RecordMetadata(
+                    record_type=RecordType.COMMAND,
+                    value_type=ValueType.WORKFLOW_INSTANCE,
+                    intent=int(intent),
+                ),
+                value=WorkflowInstanceRecord(
+                    bpmn_process_id="p", workflow_key=wf_key, payload={}
+                ),
+            )
+
+        good1 = make(1, WI.CREATE)
+        poison = make(2, WI.CREATE)
+        # sabotage: make the poison record's value explode on copy
+        class Bomb:
+            def __deepcopy__(self, memo):
+                raise RuntimeError("boom")
+
+            def __reduce__(self):
+                raise RuntimeError("boom")
+
+        poison.value.payload = {"x": Bomb()}
+        good2 = make(3, WI.CREATE)
+        result = engine.process_batch([good1, poison, good2])
+        # both good records produced follow-ups; the poison one is recorded
+        assert len(engine.processing_failures) == 1
+        assert engine.processing_failures[0][0] == 2
+        created = [
+            r for r in result.written
+            if r.metadata.value_type == ValueType.WORKFLOW_INSTANCE
+        ]
+        assert len(created) >= 2
+        # and a subsequent batch still processes normally
+        more = engine.process_batch([make(4, WI.CREATE)])
+        assert more.written

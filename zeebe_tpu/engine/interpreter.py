@@ -28,6 +28,7 @@ receive tasks, and message-subscription close on termination.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -68,6 +69,8 @@ from zeebe_tpu.protocol.records import (
     WorkflowInstanceRecord,
     WorkflowInstanceSubscriptionRecord,
 )
+
+logger = logging.getLogger(__name__)
 
 
 # ---------------------------------------------------------------------------
@@ -391,6 +394,11 @@ class PartitionEngine:
         self.topic_sub_acks: Dict[str, int] = {}
         self.topic_sub_keys = keyspace.topic_subscriber_keys()
 
+        # poison-record isolation (reference StreamProcessor onError):
+        # (position, error) for records whose handler raised; they are
+        # skipped by process_batch, never retried
+        self.processing_failures: List[tuple] = []
+
         # topic orchestration state, system partition only (reference
         # KnownTopics + the IdGenerator stream processor: partition ids are
         # assigned deterministically from replicated state)
@@ -489,18 +497,64 @@ class PartitionEngine:
     def process_batch(self, records: List[Record]) -> ProcessingResult:
         """Batch drain: per-record processing with per-record source
         stamping, merged in log order (the device engine overrides this
-        with real SIMD batching)."""
+        with real SIMD batching).
+
+        Failure containment (reference StreamProcessorController onError →
+        skip/blacklist, ``StreamProcessorController.java:296-399``): a record
+        whose handler raises is logged, recorded in ``processing_failures``,
+        answered with a PROCESSING_ERROR rejection when it was a client
+        command, and SKIPPED — a poison record cannot wedge the partition by
+        re-raising on every drain (round-3 advisor finding). Determinism
+        note: handlers fail deterministically (pure functions of record +
+        state), so replay re-raises at the same point and reconverges on the
+        same partial mutations; the skip is replay-stable."""
         from zeebe_tpu.protocol.records import stamp_source_positions
 
         merged = ProcessingResult()
         for record in records:
-            res = self.process(record)
+            try:
+                res = self.process(record)
+            except Exception as e:  # noqa: BLE001 - poison-record isolation
+                self._contain_processing_failure(record, e, merged)
+                continue
             stamp_source_positions(res.written, record.position)
             merged.written.extend(res.written)
             merged.responses.extend(res.responses)
             merged.sends.extend(res.sends)
             merged.pushes.extend(res.pushes)
         return merged
+
+    def _contain_processing_failure(
+        self, record: Record, exc: Exception, merged: ProcessingResult
+    ) -> None:
+        """Record, log, and (for client commands) answer a record whose
+        handler raised, so the client sees a rejection instead of hanging
+        to its request timeout."""
+        self.processing_failures.append((record.position, repr(exc)[:300]))
+        logger.error(
+            "record at position %d (valueType=%s intent=%s) poisoned the "
+            "engine and was skipped: %r",
+            record.position, record.metadata.value_type,
+            record.metadata.intent, exc,
+        )
+        if (
+            record.metadata.record_type == RecordType.COMMAND
+            and record.metadata.request_id >= 0
+        ):
+            try:
+                rejection = _record(
+                    RecordType.COMMAND_REJECTION, record.value.copy(),
+                    record.metadata.intent, record.key, record.position,
+                    {
+                        "rejection_type": RejectionType.PROCESSING_ERROR,
+                        "rejection_reason": f"processing failed: {exc!r}"[:200],
+                        "request_id": record.metadata.request_id,
+                        "request_stream_id": record.metadata.request_stream_id,
+                    },
+                )
+            except Exception:  # noqa: BLE001 - the value itself may be broken
+                return
+            merged.responses.append(rejection)
 
     def process(self, record: Record) -> ProcessingResult:
         self.records_by_position[record.position] = record
@@ -1046,9 +1100,14 @@ class PartitionEngine:
                 # semantics), dropping it for some iterations, and a
                 # mixed keyspace would let a surviving loopCounter collide
                 # with an order-assigned key and silently drop an output
-                found, extracted = query_json_path(
-                    value.payload, scope_el.mi_output_element
-                )
+                try:
+                    found, extracted = query_json_path(
+                        value.payload, scope_el.mi_output_element
+                    )
+                except ValueError:
+                    # a bad output-element path collects null rather than
+                    # escaping the engine loop mid-token-consume
+                    found, extracted = False, None
                 scope.mi_outputs[len(scope.mi_outputs) + 1] = (
                     extracted if found else None
                 )
@@ -1318,7 +1377,14 @@ class PartitionEngine:
         container = instance
         items = None
         if element.mi_input_collection:
-            found, coll = query_json_path(value.payload, element.mi_input_collection)
+            try:
+                found, coll = query_json_path(
+                    value.payload, element.mi_input_collection
+                )
+            except ValueError:
+                # malformed path that slipped past deploy validation must
+                # become an incident, not wedge the partition drain loop
+                found, coll = False, None
             if not found or not isinstance(coll, list):
                 self._raise_incident(
                     record,

@@ -93,16 +93,25 @@ def validate_model(model: BpmnModel) -> List[ValidationError]:
                     )
                 )
             mi = element.multi_instance
-            if mi is not None and not mi.input_collection and not (
-                mi.cardinality is not None and mi.cardinality > 0
-            ):
-                errors.append(
-                    ValidationError(
-                        element.id,
-                        "multi-instance activity must have an input collection "
-                        "or a positive cardinality",
+            if mi is not None:
+                if not mi.input_collection and not (
+                    mi.cardinality is not None and mi.cardinality > 0
+                ):
+                    errors.append(
+                        ValidationError(
+                            element.id,
+                            "multi-instance activity must have an input collection "
+                            "or a positive cardinality",
+                        )
                     )
-                )
+                # input_collection and output_element are JSONPath queries
+                # (evaluated in the engine hot loop — a malformed one must
+                # reject at deploy, round-3 advisor); input_element and
+                # output_collection are plain variable names
+                if mi.input_collection:
+                    check_path(element.id, mi.input_collection, "input collection")
+                if getattr(mi, "output_element", None):
+                    check_path(element.id, mi.output_element, "output element")
         elif isinstance(element, ExclusiveGateway):
             for flow in element.outgoing:
                 if (

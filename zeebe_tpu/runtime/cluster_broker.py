@@ -29,6 +29,7 @@ codec frames.
 
 from __future__ import annotations
 
+import logging
 import os
 import zlib
 import threading
@@ -47,6 +48,8 @@ from zeebe_tpu.runtime.clock import SystemClock
 from zeebe_tpu.runtime.config import BrokerCfg
 from zeebe_tpu.runtime.metrics import MetricsFileWriter, MetricsRegistry
 from zeebe_tpu.transport import ClientTransport, RemoteAddress, ServerTransport
+
+logger = logging.getLogger(__name__)
 
 
 class Topology:
@@ -407,9 +410,21 @@ class ClusterBroker(Actor):
             if cfg.metrics.port:
                 from zeebe_tpu.runtime.metrics import MetricsHttpServer
 
-                self.metrics_http = MetricsHttpServer(
-                    self.metrics, host=cfg.network.host, port=cfg.metrics.port
-                )
+                try:
+                    self.metrics_http = MetricsHttpServer(
+                        self.metrics, host=cfg.network.host, port=cfg.metrics.port
+                    )
+                except OSError as e:
+                    # a second broker on the host (no portOffset) or any
+                    # process on the port must not make broker construction
+                    # fail — metrics serving is best-effort, the file
+                    # writer keeps running (round-3 advisor finding)
+                    logger.warning(
+                        "metrics endpoint bind failed on %s:%d (%s); "
+                        "continuing without /metrics",
+                        cfg.network.host, cfg.metrics.port, e,
+                    )
+                    self.metrics_http = None
 
         self.repository = WorkflowRepository()
         self.topology = Topology()

@@ -96,6 +96,9 @@ class EngineCfg:
     capacity: int = 1 << 12  # device table capacity (instances/jobs rows)
     num_vars: int = 16  # payload variable columns on device
     sub_capacity: int = 16  # sub-process nesting table rows
+    # on-chip pallas-vs-XLA parity smoke before the first TPU engine
+    # serves (refuses to serve on divergence); no-op off-TPU
+    pallas_selfcheck: bool = True
 
 
 @dataclasses.dataclass

@@ -32,6 +32,13 @@ def engine_factory_from_config(
         def factory(partition_id: int, broker):
             from zeebe_tpu.tpu import TpuPartitionEngine
 
+            if getattr(cfg.engine, "pallas_selfcheck", True):
+                # on-chip parity smoke before the first engine serves: a
+                # broken Mosaic lowering must refuse to serve, not corrupt
+                # partition state (round-3 advisor). Memoized; no-op off-TPU.
+                from zeebe_tpu.tpu import pallas_ops
+
+                pallas_ops.selfcheck()
             return TpuPartitionEngine(
                 partition_id,
                 broker.cfg.cluster.partitions,

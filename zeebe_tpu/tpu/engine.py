@@ -867,9 +867,18 @@ class TpuPartitionEngine:
                             )
                     self._demote_instance(owner)
                 deployed_before = len(self.repository.by_key)
-                per_record[i] = self._host.process(record)
+                try:
+                    per_record[i] = self._host.process(record)
+                except Exception as e:  # noqa: BLE001 - poison isolation,
+                    # same contract as the oracle's process_batch: skip and
+                    # record, never wedge the drain loop
+                    self._host.processing_failures.append(
+                        (record.position, repr(e)[:300])
+                    )
                 if len(self.repository.by_key) != deployed_before:
                     self._recompile()
+                # key-sync check runs even for a poisoned record: a handler
+                # may allocate keys before raising
                 if (
                     self._host.wf_keys.peek != wf_peek
                     or self._host.job_keys.peek != job_peek
@@ -899,8 +908,10 @@ class TpuPartitionEngine:
         device-segment → host-record boundaries)."""
         from zeebe_tpu.engine import keyspace
 
-        dev_wf = int(np.asarray(self.state.next_wf_key))
-        dev_job = int(np.asarray(self.state.next_job_key))
+        # .item() extracts the scalar for any size-1 array; plain int() on a
+        # ndim>0 array is deprecated NumPy behavior that will hard-error
+        dev_wf = int(np.asarray(self.state.next_wf_key).item())
+        dev_job = int(np.asarray(self.state.next_job_key).item())
         if self._host.wf_keys.peek < dev_wf:
             self._host.wf_keys.set_key(dev_wf - keyspace.STEP_SIZE)
         if self._host.job_keys.peek < dev_job:

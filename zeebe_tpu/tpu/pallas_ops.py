@@ -612,3 +612,79 @@ def delete(table: HashTable, keys: jax.Array, valid: jax.Array) -> HashTable:
     )(h0, lo, hi, valid.astype(jnp.int32), tlo, thi)
     new_keys = _join_keys(tlo2.reshape(t), thi2.reshape(t))
     return HashTable(new_keys, tvals.reshape(t))
+
+
+# ----------------------------------------------------------------------
+# startup self-check
+# ----------------------------------------------------------------------
+_SELFCHECK_PASSED = False
+
+
+def selfcheck() -> None:
+    """On-chip pallas-vs-XLA parity smoke, run ONCE before a TPU-backed
+    broker serves traffic (round-3 advisor: the full parity gate in
+    ``benchmarks/pallas_ops_check.py`` had never completed on hardware,
+    yet ``_use_pallas()`` enabled these kernels unconditionally for
+    production serving). Small shapes keep the extra boot cost to a few
+    compiles; raises ``RuntimeError`` on any divergence so a broken
+    Mosaic lowering refuses to serve instead of corrupting state.
+
+    No-op off-TPU (the CPU suite pins semantics through the XLA
+    fallbacks, which are the same code path).
+    """
+    global _SELFCHECK_PASSED
+    if _SELFCHECK_PASSED or not _use_pallas():
+        return
+
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    t, b, k = 1 << 10, 1 << 8, 16
+
+    def _fail(name, a, b_):
+        raise RuntimeError(
+            f"pallas selfcheck MISMATCH [{name}]: refusing to serve "
+            f"({np.asarray(a).ravel()[:4]} vs {np.asarray(b_).ravel()[:4]})"
+        )
+
+    def _eq(name, a, b_):
+        if not (np.asarray(a) == np.asarray(b_)).all():
+            _fail(name, a, b_)
+
+    table = hashmap.make(t)
+    keys = jnp.asarray(
+        rng.choice(np.arange(1, 8 * t, 3, dtype=np.int64), b, replace=False)
+    )
+    vals = jnp.arange(b, dtype=jnp.int32)
+    valid = jnp.asarray(rng.random(b) < 0.8)
+    t_x, ok_x = hashmap.insert(table, keys, vals, valid)
+    t_p, ok_p = insert(table, keys, vals, valid)
+    _eq("insert keyset", np.sort(np.asarray(t_x.keys)), np.sort(np.asarray(t_p.keys)))
+    _eq("insert ok", ok_x, ok_p)
+    fx, sx = hashmap.lookup(t_p, keys, valid)
+    fp, sp = lookup(t_p, keys, valid)
+    _eq("lookup found", fx, fp)
+    _eq("lookup slots", np.where(np.asarray(fx), np.asarray(sx), -1),
+        np.where(np.asarray(fp), np.asarray(sp), -1))
+    d_x = hashmap.delete(t_x, keys, valid)
+    d_p = delete(t_p, keys, valid)
+    _eq("delete keyset", np.sort(np.asarray(d_x.keys)), np.sort(np.asarray(d_p.keys)))
+
+    tbl = jnp.asarray(rng.integers(0, 100, (t, k)), jnp.int32)
+    slots = jnp.asarray(rng.choice(t, b, replace=False), jnp.int32)
+    active = jnp.asarray(rng.random(b) < 0.7)
+    rows = jnp.asarray(rng.integers(0, 1000, (b, k)), jnp.int32)
+    x = tbl.at[jnp.where(active, slots, t)].set(rows, mode="drop")
+    p = masked_row_update(tbl, slots, active, rows)
+    _eq("row update", x, p)
+
+    t1 = jnp.asarray(rng.integers(0, 100, (t,)), jnp.int32)
+    lvals = jnp.asarray(rng.integers(0, 9, (b,)), jnp.int32)
+    _eq("lane update",
+        t1.at[jnp.where(active, slots, t)].set(lvals, mode="drop"),
+        masked_lane_update(t1, slots, active, lvals))
+    _eq("lane accum",
+        t1.at[jnp.where(active, slots, t)].add(lvals, mode="drop"),
+        masked_lane_accum(t1, slots, active, lvals))
+
+    _SELFCHECK_PASSED = True
