@@ -24,9 +24,33 @@ jax.config.update("jax_platforms", "cpu")
 # Persistent compile cache: the step kernel is a large jit program; caching
 # makes repeat test runs fast. (Must be config.update, not env vars — this
 # jax build never reads the JAX_COMPILATION_CACHE_DIR env var.)
+# The cache dir is keyed by a machine fingerprint: the repo (incl. ignored
+# files) persists across build rounds that may land on DIFFERENT machines,
+# and XLA:CPU AOT executables compiled for another machine's CPU features
+# fail to load (or risk SIGILL) — a stale cross-machine cache turned the
+# whole suite into a compile storm in round 4.
+import hashlib
+import platform
+
+
+def _machine_fingerprint() -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = next(
+                (line for line in f if line.startswith("flags")), platform.machine()
+            )
+    except OSError:
+        flags = platform.machine()
+    return hashlib.sha256(str(flags).encode()).hexdigest()[:12]
+
+
 jax.config.update(
     "jax_compilation_cache_dir",
-    os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".jax_cache")),
+    os.path.abspath(
+        os.path.join(
+            os.path.dirname(__file__), "..", ".jax_cache", _machine_fingerprint()
+        )
+    ),
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
 

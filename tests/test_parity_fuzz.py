@@ -88,8 +88,8 @@ def build_random_model(rng: random.Random, pid: str):
                 f"{pid}-w{i}", duration_ms=rng.choice([5_000, 30_000])
             )
         elif kind == "receive":
-            # message correlation — device-ineligible: exercises the
-            # demotion boundary (host-backed partition on the TPU broker)
+            # message correlation — device-served since round 4 (open/
+            # publish/correlate/close run in the kernel's message tables)
             b = b.receive_task(
                 f"{pid}-r{i}",
                 message_name=f"{pid}-msg{i}",

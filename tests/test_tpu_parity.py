@@ -27,6 +27,9 @@ SIG_TYPES = {
     int(ValueType.JOB),
     int(ValueType.INCIDENT),
     int(ValueType.TIMER),
+    int(ValueType.MESSAGE),
+    int(ValueType.MESSAGE_SUBSCRIPTION),
+    int(ValueType.WORKFLOW_INSTANCE_SUBSCRIPTION),
 }
 
 
@@ -442,7 +445,8 @@ class TestPayloadContract:
 
 
 class TestHostOnlyFallback:
-    """Device-incompatible workflows (message catch events this round) run
+    """Device-incompatible workflows (nested correlation-key paths here —
+    message catches with FLAT keys compile to the device since round 4) run
     on the embedded host oracle of a TPU-backed partition — every deployed
     workflow keeps executing (reference bar: the stream processor serves
     the whole deployed set; `graph.check_device_compatible` decides WHERE
@@ -462,14 +466,15 @@ class TestHostOnlyFallback:
                 Bpmn.create_process("wait-for-msg")
                 .start_event("s")
                 .message_catch_event(
-                    "wait", message_name="go", correlation_key="$.orderId"
+                    # nested path: no device column form → host-only
+                    "wait", message_name="go", correlation_key="$.meta.orderId"
                 )
                 .end_event("e")
                 .done()
             )
             client.deploy_model(msg_model)
             engine = broker.partitions[0].engine
-            assert engine._host_only_keys, "message workflow should be host-only"
+            assert engine._host_only_keys, "nested-path workflow should be host-only"
             assert engine.graph is not None, "device workflow should compile"
 
             # device workflow completes on the kernel
@@ -479,7 +484,7 @@ class TestHostOnlyFallback:
             assert len(worker.handled) == 1
 
             # host-only workflow completes via message correlation
-            client.create_instance("wait-for-msg", {"orderId": 7})
+            client.create_instance("wait-for-msg", {"meta": {"orderId": 7}})
             broker.run_until_idle()
             client.publish_message("go", correlation_key="7")
             broker.run_until_idle()
@@ -507,7 +512,8 @@ class TestHostOnlyFallback:
                 Bpmn.create_process("msg-then-work")
                 .start_event("s")
                 .message_catch_event(
-                    "wait", message_name="go2", correlation_key="$.k"
+                    # nested path keeps this workflow host-only
+                    "wait", message_name="go2", correlation_key="$.meta.k"
                 )
                 .service_task("work", type="late-service")
                 .end_event("e")
@@ -516,7 +522,7 @@ class TestHostOnlyFallback:
             client.deploy_model(model)
             assert broker.partitions[0].engine._host_only_keys
             worker = JobWorker(broker, "late-service", lambda ctx: {"done": 1})
-            client.create_instance("msg-then-work", {"k": 5})
+            client.create_instance("msg-then-work", {"meta": {"k": 5}})
             broker.run_until_idle()
             client.publish_message("go2", correlation_key="5")
             broker.run_until_idle()
@@ -550,14 +556,16 @@ class TestHostOnlyFallback:
         msg_model = (
             Bpmn.create_process("wait-for-msg")
             .start_event("s")
-            .message_catch_event("wait", message_name="go3", correlation_key="$.k")
+            .message_catch_event(
+                # nested path keeps this workflow host-only
+                "wait", message_name="go3", correlation_key="$.meta.k")
             .end_event("e")
             .done()
         )
         client.deploy_model(msg_model)
         host_only_before = set(broker.partitions[0].engine._host_only_keys)
         compiled_before = broker.partitions[0].engine._compiled_count
-        client.create_instance("wait-for-msg", {"k": 9})
+        client.create_instance("wait-for-msg", {"meta": {"k": 9}})
         broker.run_until_idle()
         broker.snapshot()
         broker.close()
@@ -595,12 +603,14 @@ class TestHostOnlyFallback:
             msg_model = (
                 Bpmn.create_process("cancellable")
                 .start_event("s")
-                .message_catch_event("w", message_name="m9", correlation_key="$.k")
+                .message_catch_event(
+                    # nested path keeps this workflow host-only
+                    "w", message_name="m9", correlation_key="$.meta.k")
                 .end_event("e")
                 .done()
             )
             client.deploy_model(msg_model)
-            inst = client.create_instance("cancellable", {"k": 1})
+            inst = client.create_instance("cancellable", {"meta": {"k": 1}})
             broker.run_until_idle()
             client.cancel_instance(inst.workflow_instance_key)
             broker.run_until_idle()
@@ -610,5 +620,241 @@ class TestHostOnlyFallback:
                 and int(r.metadata.intent) == int(WI.ELEMENT_TERMINATED)
             ]
             assert canceled
+        finally:
+            broker.close()
+
+
+def receive_task_process():
+    return (
+        Bpmn.create_process("msgflow")
+        .start_event("start")
+        .receive_task("wait", message_name="paid", correlation_key="$.oid")
+        .end_event("done")
+        .done()
+    )
+
+
+def catch_event_process():
+    return (
+        Bpmn.create_process("catchflow")
+        .start_event("start")
+        .message_catch_event("gate", message_name="go", correlation_key="$.key")
+        .service_task("after", type="post-service")
+        .end_event("end")
+        .done()
+    )
+
+
+class TestMessageCorrelationParity:
+    """Round 4: message catch/receive compile to the device — subscription
+    open, publish correlate, stored-message TTL, close — and the full log
+    must stay bit-identical to the oracle (reference
+    SubscriptionCommandSender.java:96-108,
+    WorkflowInstanceStreamProcessor.java:455-509)."""
+
+    def test_open_then_publish(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(receive_task_process())
+            client.create_instance("msgflow", {"oid": "o-7"})
+            broker.run_until_idle()
+            client.publish_message("paid", "o-7", {"paid": True})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_publish_before_open_with_ttl(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(receive_task_process())
+            client.publish_message(
+                "paid", "o-1", {"amount": 5}, time_to_live_ms=60_000
+            )
+            broker.run_until_idle()
+            client.create_instance("msgflow", {"oid": "o-1"})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_publish_without_ttl_does_not_store(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(receive_task_process())
+            client.publish_message("paid", "o-2", {"x": 1})  # no subscriber
+            broker.run_until_idle()
+            client.create_instance("msgflow", {"oid": "o-2"})
+            broker.run_until_idle()
+            # instance still waiting: publish again, now correlates
+            client.publish_message("paid", "o-2", {"x": 2})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_ttl_expiry_deletes_stored_message(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(receive_task_process())
+            client.publish_message("paid", "late", {"v": 1}, time_to_live_ms=5_000)
+            broker.run_until_idle()
+            clock.advance(6_000)
+            broker.tick()
+            broker.run_until_idle()
+            # a subscriber arriving after expiry waits (no stored message)
+            client.create_instance("msgflow", {"oid": "late"})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_message_catch_event_with_downstream_task(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(catch_event_process())
+            JobWorker(broker, "post-service", lambda ctx: {"done": 1})
+            client.create_instance("catchflow", {"key": "k-1"})
+            broker.run_until_idle()
+            client.publish_message("go", "k-1", {"approved": True})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_numeric_correlation_key(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(receive_task_process())
+            client.create_instance("msgflow", {"oid": 42})
+            broker.run_until_idle()
+            client.publish_message("paid", "42", {"ok": True})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_duplicate_message_id_rejected(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(receive_task_process())
+            client.publish_message(
+                "paid", "dup", {"n": 1}, time_to_live_ms=60_000, message_id="m-1"
+            )
+            broker.run_until_idle()
+            try:
+                client.publish_message(
+                    "paid", "dup", {"n": 2}, time_to_live_ms=60_000, message_id="m-1"
+                )
+            except ClientException:
+                pass
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_cancel_closes_subscription(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(receive_task_process())
+            inst = client.create_instance("msgflow", {"oid": "c-1"})
+            broker.run_until_idle()
+            client.cancel_instance(inst.workflow_instance_key)
+            broker.run_until_idle()
+            # late publish: subscription is closed, message stores (TTL)
+            client.publish_message("paid", "c-1", {"late": 1}, time_to_live_ms=9_000)
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_two_instances_distinct_keys(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(receive_task_process())
+            client.create_instance("msgflow", {"oid": "a"})
+            client.create_instance("msgflow", {"oid": "b"})
+            broker.run_until_idle()
+            client.publish_message("paid", "b", {"who": "b"})
+            broker.run_until_idle()
+            client.publish_message("paid", "a", {"who": "a"})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_correlation_key_missing_raises_incident(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(receive_task_process())
+            client.create_instance("msgflow", {"other": 1})  # no oid var
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_float_correlation_key_raises_incident(self, rig):
+        # oracle accepts (str, int) only; floats incident on both engines
+        def scenario(broker, client, clock):
+            client.deploy_model(receive_task_process())
+            client.create_instance("msgflow", {"oid": 1.5})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_bool_correlation_key_subscribes(self, rig):
+        # bool IS an int to the oracle — both engines subscribe with "True"
+        def scenario(broker, client, clock):
+            client.deploy_model(receive_task_process())
+            client.create_instance("msgflow", {"oid": True})
+            broker.run_until_idle()
+            client.publish_message("paid", "True", {"ok": 1})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+
+class TestMessageStoreLimits:
+    """The device message store keys ONE live slot per (name, correlation)
+    composite. Workloads exceeding that (two instances waiting on the same
+    key, two buffered messages with the same key) REJECT the extra record
+    with an explicit reason — a documented capability divergence from the
+    oracle that degrades per-record instead of crashing the partition."""
+
+    def _tpu_broker(self):
+        from tests.conftest import make_tpu_broker
+
+        return make_tpu_broker()
+
+    def test_second_subscription_same_key_rejected_partition_survives(self):
+        broker = self._tpu_broker()
+        try:
+            client = ZeebeClient(broker)
+            client.deploy_model(receive_task_process())
+            client.create_instance("msgflow", {"oid": "same"})
+            client.create_instance("msgflow", {"oid": "same"})
+            broker.run_until_idle()
+            rejections = [
+                r for r in broker.records(0)
+                if int(r.metadata.record_type) == int(RecordType.COMMAND_REJECTION)
+                and "already open" in (r.metadata.rejection_reason or "")
+            ]
+            assert rejections, "second OPEN must reject with a reason"
+            # the partition keeps serving: first instance still correlates
+            client.publish_message("paid", "same", {"ok": 1})
+            broker.run_until_idle()
+            completed = [
+                r for r in broker.records(0)
+                if int(r.metadata.value_type) == int(ValueType.WORKFLOW_INSTANCE)
+                and int(r.metadata.intent) == int(WI.ELEMENT_COMPLETED)
+                and getattr(r.value, "activity_id", "") == "msgflow"
+            ]
+            assert len(completed) == 1
+        finally:
+            broker.close()
+
+    def test_second_stored_message_same_key_rejected(self):
+        broker = self._tpu_broker()
+        try:
+            client = ZeebeClient(broker)
+            client.deploy_model(receive_task_process())
+            client.publish_message("paid", "k", {"n": 1}, time_to_live_ms=60_000)
+            try:
+                client.publish_message(
+                    "paid", "k", {"n": 2}, time_to_live_ms=60_000
+                )
+                raise AssertionError("second TTL store should reject")
+            except ClientException as e:
+                assert "already stored" in str(e)
+            # the stored first message still correlates a late subscriber
+            client.create_instance("msgflow", {"oid": "k"})
+            broker.run_until_idle()
+            completed = [
+                r for r in broker.records(0)
+                if int(r.metadata.value_type) == int(ValueType.WORKFLOW_INSTANCE)
+                and int(r.metadata.intent) == int(WI.ELEMENT_COMPLETED)
+                and getattr(r.value, "activity_id", "") == "msgflow"
+            ]
+            assert len(completed) == 1
         finally:
             broker.close()

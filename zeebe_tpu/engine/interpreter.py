@@ -2068,6 +2068,7 @@ class PartitionEngine:
                                     message_name=value.name,
                                     payload=dict(value.payload),
                                     message_partition_id=self.partition_id,
+                                    correlation_key=sub.correlation_key,
                                 ),
                                 WorkflowInstanceSubscriptionIntent.CORRELATE,
                             ),
@@ -2128,6 +2129,7 @@ class PartitionEngine:
                                     message_name=value.message_name,
                                     payload=dict(message.payload),
                                     message_partition_id=self.partition_id,
+                                    correlation_key=value.correlation_key,
                                 ),
                                 WorkflowInstanceSubscriptionIntent.CORRELATE,
                             ),
@@ -2185,6 +2187,7 @@ class PartitionEngine:
                 workflow_instance_key=value.workflow_instance_key,
                 activity_instance_key=value.activity_instance_key,
                 message_name=value.message_name,
+                correlation_key=value.correlation_key,
             )
             out.sends.append(
                 (

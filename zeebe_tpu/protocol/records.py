@@ -180,6 +180,10 @@ class WorkflowInstanceSubscriptionRecord(RecordValue):
     # workflow partition can route the post-correlation CLOSE (the reference
     # leaks subscriptions after correlation in this version)
     message_partition_id: int = _f("messagePartitionId", -1)
+    # TPU-native: the subscription's correlation key, echoed so the CLOSE
+    # can address the store by its composite (name, correlation) key — the
+    # device store is hashmap-addressed, not scanned
+    correlation_key: str = _f("correlationKey", "")
 
 
 @dataclasses.dataclass

@@ -428,6 +428,8 @@ class ClusterBroker(Actor):
 
         self.repository = WorkflowRepository()
         self.topology = Topology()
+        # partition id → in-flight snapshot-replication fetch thread
+        self._snapshot_fetches: Dict[int, threading.Thread] = {}
         self.partitions: Dict[int, PartitionServer] = {}
         self._pending_responses: Dict[int, ActorFuture] = {}
         self._next_request_id = 0
@@ -728,19 +730,27 @@ class ClusterBroker(Actor):
     def _replicate_snapshots(self) -> None:
         """Follower side: poll each partition's leader for new snapshots and
         fetch them chunk-wise (installed per follower partition —
-        SnapshotReplicationInstallService parity)."""
+        SnapshotReplicationInstallService parity). One in-flight fetch per
+        partition: the poll period (can be 100s of ms in tests) must not
+        pile up threads behind a slow leader — each fetch involves requests
+        with multi-second timeouts."""
         for pid, server in list(self.partitions.items()):
             if server.is_leader:
                 continue
             addr = self.topology.leader_address(pid)
             if addr is None:
                 continue
-            threading.Thread(
+            prev = self._snapshot_fetches.get(pid)
+            if prev is not None and prev.is_alive():
+                continue
+            t = threading.Thread(
                 target=self._fetch_snapshots_from_leader,
                 args=(pid, server, addr),
                 daemon=True,
                 name=f"zb-snapshot-replication-{pid}",
-            ).start()
+            )
+            self._snapshot_fetches[pid] = t
+            t.start()
 
     def _fetch_snapshots_from_leader(self, pid: int, server, addr) -> None:
         from zeebe_tpu.log.snapshot import SnapshotMetadata
@@ -834,8 +844,11 @@ class ClusterBroker(Actor):
                         meta.last_processed_position + 1, term=lp_term
                     )
                 )
-        except Exception:  # noqa: BLE001 - next poll retries
-            pass
+        except Exception as e:  # noqa: BLE001 - next poll retries
+            logger.debug(
+                "snapshot replication fetch from %s for partition %d "
+                "failed (next poll retries): %r", addr, pid, e,
+            )
 
     # -- topic subscriptions over the client API ----------------------------
     def _handle_topic_subscription(self, msg: dict, conn, result: ActorFuture) -> None:
@@ -1518,7 +1531,16 @@ class ClusterBroker(Actor):
         snapshot reads the same engine state processing mutates, and the
         device engine additionally DONATES its buffers to XLA each step
         (a concurrent read would hit deleted arrays)."""
-        self.actor.call(self._snapshot_all_on_actor).join(30)
+        try:
+            self.actor.call(self._snapshot_all_on_actor).join(60)
+        except TimeoutError:
+            # a silently-skipped checkpoint turns into an unexplainable
+            # missing-snapshot failure much later (round-4 flake hunt);
+            # fail where the cause is
+            raise TimeoutError(
+                "snapshot_all: broker actor did not run the checkpoint "
+                "within 60s (actor wedged or overloaded)"
+            )
 
     def _snapshot_all_on_actor(self) -> None:
         for server in self.partitions.values():

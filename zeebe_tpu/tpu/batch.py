@@ -45,12 +45,20 @@ REJ_JOB_NOT_FAILED = 4
 REJ_RETRIES_NOT_POSITIVE = 5
 REJ_JOB_NOT_EXIST = 6
 REJ_TIMER_NOT_EXIST = 7
+REJ_SUB_NOT_ACTIVE = 8   # correlate arrival for a gone activity instance
+REJ_MSG_DUP = 9          # duplicate (name, correlation, message id) publish
+# the device message store keys ONE live slot per (name, correlation)
+# composite — a second open subscription / stored message on an occupied
+# composite rejects per-record instead of crashing the partition
+REJ_SUB_OCCUPIED = 10
+REJ_MSG_STORE_OCCUPIED = 11
 
 # incident error codes (emitted on INCIDENT CREATE commands)
 ERR_CONDITION_NO_FLOW = 101
 ERR_CONDITION_EVAL = 102
 ERR_IO_MAPPING_IN = 103
 ERR_IO_MAPPING_OUT = 104
+ERR_CORRELATION_KEY = 106  # 105 = job-no-retries (engine.py)
 
 # reason strings match the oracle engine exactly (interpreter.py)
 REJECTION_REASONS = {
@@ -61,6 +69,17 @@ REJECTION_REASONS = {
     REJ_RETRIES_NOT_POSITIVE: "Retries must be greater than 0",
     REJ_JOB_NOT_EXIST: "Job does not exist",
     REJ_TIMER_NOT_EXIST: "timer does not exist",
+    REJ_SUB_NOT_ACTIVE: "activity is not active anymore",
+    # REJ_MSG_DUP's reason embeds the message id — formatted in
+    # engine._materialize from the interned id
+    REJ_SUB_OCCUPIED: (
+        "a subscription for this (message name, correlation key) is already "
+        "open on this TPU-backed partition (one live subscription per key)"
+    ),
+    REJ_MSG_STORE_OCCUPIED: (
+        "a message with this (name, correlation key) is already stored on "
+        "this TPU-backed partition (one buffered message per key)"
+    ),
 }
 
 _FIELDS = [

@@ -65,6 +65,15 @@ _DEVICE_VALUE_TYPES = {
     int(ValueType.TIMER),
 }
 
+# device-served when the compiled graph has message elements (round 4):
+# the message store side is chosen per deployment set — see
+# TpuPartitionEngine._recompile
+_MESSAGE_VALUE_TYPES = {
+    int(ValueType.MESSAGE),
+    int(ValueType.MESSAGE_SUBSCRIPTION),
+    int(ValueType.WORKFLOW_INSTANCE_SUBSCRIPTION),
+}
+
 _ERR_NO_RETRIES = 105  # kernel's JOB_NO_RETRIES incident code
 
 
@@ -124,6 +133,10 @@ class TpuPartitionEngine:
         self._compiled_count = 0
         self._host_only_keys: set = set()
         self._device_keys_dirty = False
+        # message store side (see _recompile): True = device tables serve
+        # this partition's MESSAGE-partition role
+        self._messages_on_device = False
+        self._restoring = False
         # ONE position→record cache shared with the embedded host oracle:
         # the broker fills it during recovery, host-side incident
         # resolution reads it (reference TypedStreamReader by position)
@@ -165,6 +178,7 @@ class TpuPartitionEngine:
         if not workflows:
             self.graph = None
             self._compiled_count = 0
+            self._set_message_store_side(False)
             return
         if extra_variables is not None:
             var_names = list(extra_variables)
@@ -179,6 +193,194 @@ class TpuPartitionEngine:
                 f"num_vars={self.num_vars}; raise num_vars"
             )
         self._compiled_count = len(workflows)
+        # The message store (this partition's MESSAGE-partition role: stored
+        # messages + open subscriptions) lives on EXACTLY one side. Device
+        # iff the deployed set compiles with message elements and has no
+        # host-only workflows — a mixed store would let a publish see only
+        # half the subscriptions. Flipping sides migrates the store.
+        self._set_message_store_side(
+            self.graph.has_messages and not host_only
+        )
+
+    def _set_message_store_side(self, on_device: bool) -> None:
+        prev = self._messages_on_device
+        self._messages_on_device = on_device
+        if self._restoring:
+            return
+        if on_device and not prev:
+            self._migrate_message_store_to_device()
+        elif prev and not on_device:
+            self._migrate_message_store_to_host()
+
+    def _migrate_message_store_to_device(self) -> None:
+        """Host oracle message store → device tables (a deployment flipped
+        the store side; rare control-plane event, plain host loop)."""
+        from zeebe_tpu.tpu import hashmap as hm
+        from zeebe_tpu.tpu.conditions import VT_NUM, VT_STR
+
+        host = self._host
+        if not host.messages and not host.message_subscriptions:
+            return
+        s = self.state
+
+        def corr_cols(value) -> tuple:
+            if isinstance(value, str):
+                return int(VT_STR), self.interns.intern(value)
+            return (
+                int(VT_NUM),
+                int(np.float32(float(value)).view(np.int32)),
+            )
+
+        def composite(name: str, cvt: int, cbits: int) -> int:
+            nid = self.interns.intern(name)
+            return (nid << 35) | (cvt << 32) | (cbits & 0xFFFFFFFF)
+
+        msub_ckey = np.asarray(s.msub_ckey).copy()
+        msub_i32 = np.asarray(s.msub_i32).copy()
+        msub_i64 = np.asarray(s.msub_i64).copy()
+        mkeys, mslots = [], []
+        free = list(np.nonzero(msub_ckey < 0)[0])
+        if len(host.message_subscriptions) > len(free):
+            raise RuntimeError(
+                f"message-store migration needs "
+                f"{len(host.message_subscriptions)} subscription slots but "
+                f"the device table has {len(free)} free — raise the "
+                "engine's msub capacity"
+            )
+        for sub in host.message_subscriptions:
+            cvt, cbits = corr_cols(sub.correlation_key)
+            ck = composite(sub.message_name, cvt, cbits)
+            slot = int(free.pop(0))
+            msub_ckey[slot] = ck
+            msub_i32[slot] = (
+                self.interns.intern(sub.message_name), cvt, cbits,
+                sub.workflow_instance_partition_id,
+            )
+            msub_i64[slot] = (sub.workflow_instance_key, sub.activity_instance_key)
+            mkeys.append(ck)
+            mslots.append(slot)
+        host.message_subscriptions = []
+
+        msg_key = np.asarray(s.msg_key).copy()
+        msg_ckey = np.asarray(s.msg_ckey).copy()
+        msg_i32 = np.asarray(s.msg_i32).copy()
+        msg_deadline = np.asarray(s.msg_deadline).copy()
+        msg_pay = np.asarray(s.msg_pay).copy()
+        gkeys, gslots = [], []
+        gfree = list(np.nonzero(msg_key < 0)[0])
+        if len(host.messages) > len(gfree):
+            raise RuntimeError(
+                f"message-store migration needs {len(host.messages)} stored-"
+                f"message slots but the device table has {len(gfree)} free "
+                "— raise the engine's msg capacity"
+            )
+        for key, message in sorted(host.messages.items()):
+            cvt, cbits = corr_cols(message.correlation_key)
+            ck = composite(message.name, cvt, cbits)
+            slot = int(gfree.pop(0))
+            msg_key[slot] = key
+            msg_ckey[slot] = ck
+            msg_i32[slot] = (
+                self.interns.intern(message.name), cvt, cbits,
+                self.interns.intern(message.message_id)
+                if message.message_id else 0,
+            )
+            msg_deadline[slot] = message.deadline
+            vt, num, sid = rb.payload_to_columns(
+                message.payload, self._var_column, self.interns, self.num_vars
+            )
+            msg_pay[slot] = np.concatenate(
+                [vt.astype(np.int32), sid,
+                 np.ascontiguousarray(num).view(np.int32)]
+            )
+            gkeys.append(ck)
+            gslots.append(slot)
+        host.messages = {}
+
+        state = dataclasses.replace(
+            self.state,
+            msub_ckey=jnp.asarray(msub_ckey),
+            msub_i32=jnp.asarray(msub_i32),
+            msub_i64=jnp.asarray(msub_i64),
+            msg_key=jnp.asarray(msg_key),
+            msg_ckey=jnp.asarray(msg_ckey),
+            msg_i32=jnp.asarray(msg_i32),
+            msg_deadline=jnp.asarray(msg_deadline),
+            msg_pay=jnp.asarray(msg_pay),
+        )
+        if mkeys:
+            m, _ = hm.insert(
+                state.msub_map, jnp.asarray(mkeys, jnp.int64),
+                jnp.asarray(mslots, jnp.int32),
+                jnp.ones((len(mkeys),), bool),
+            )
+            state = dataclasses.replace(state, msub_map=m)
+        if gkeys:
+            g, _ = hm.insert(
+                state.msg_map, jnp.asarray(gkeys, jnp.int64),
+                jnp.asarray(gslots, jnp.int32),
+                jnp.ones((len(gkeys),), bool),
+            )
+            state = dataclasses.replace(state, msg_map=g)
+        self.state = state
+
+    def _migrate_message_store_to_host(self) -> None:
+        """Device message tables → host oracle store (a host-only workflow
+        arrived; the store moves so every subscription sees every publish)."""
+        from zeebe_tpu.engine.interpreter import StoredMessage, StoredSubscription
+        from zeebe_tpu.tpu import hashmap as hm
+
+        s = self.state
+        names = self.meta.varspace.names if self.meta else []
+        corr_value = self._corr_string
+
+        msub_ckey = np.asarray(s.msub_ckey)
+        msub_i32 = np.asarray(s.msub_i32)
+        msub_i64 = np.asarray(s.msub_i64)
+        for slot in np.nonzero(msub_ckey >= 0)[0]:
+            slot = int(slot)
+            self._host.message_subscriptions.append(
+                StoredSubscription(
+                    message_name=self.interns.string(int(msub_i32[slot, 0])) or "",
+                    correlation_key=corr_value(
+                        int(msub_i32[slot, 1]), int(msub_i32[slot, 2])
+                    ),
+                    workflow_instance_partition_id=int(msub_i32[slot, 3]),
+                    workflow_instance_key=int(msub_i64[slot, 0]),
+                    activity_instance_key=int(msub_i64[slot, 1]),
+                )
+            )
+        msg_key = np.asarray(s.msg_key)
+        msg_i32 = np.asarray(s.msg_i32)
+        msg_deadline = np.asarray(s.msg_deadline)
+        msg_pay = np.asarray(s.msg_pay)
+        for slot in np.nonzero(msg_key >= 0)[0]:
+            slot = int(slot)
+            key = int(msg_key[slot])
+            self._host.messages[key] = StoredMessage(
+                key=key,
+                name=self.interns.string(int(msg_i32[slot, 0])) or "",
+                correlation_key=corr_value(
+                    int(msg_i32[slot, 1]), int(msg_i32[slot, 2])
+                ),
+                time_to_live=0,
+                payload=rb.columns_to_payload(
+                    *_host_unpack_payload(msg_pay[slot]), names, self.interns
+                ),
+                message_id=self.interns.string(int(msg_i32[slot, 3])) or "",
+                deadline=int(msg_deadline[slot]),
+            )
+        v = self.num_vars
+        self.state = dataclasses.replace(
+            s,
+            msub_ckey=jnp.full_like(s.msub_ckey, -1),
+            msub_i64=jnp.full_like(s.msub_i64, -1),
+            msub_map=hm.make(s.msub_map.keys.shape[0]),
+            msg_key=jnp.full_like(s.msg_key, -1),
+            msg_ckey=jnp.full_like(s.msg_ckey, -1),
+            msg_deadline=jnp.full_like(s.msg_deadline, -1),
+            msg_map=hm.make(s.msg_map.keys.shape[0]),
+        )
 
     # -- instance demotion: rare imperative ops take the host path ---------
     def _live_device_instance_slot(self, key: int) -> int:
@@ -452,6 +654,19 @@ class TpuPartitionEngine:
                 or value.activity_instance_key
                 in self._host.element_instances.instances
             )
+        if vt in (
+            int(ValueType.MESSAGE), int(ValueType.MESSAGE_SUBSCRIPTION)
+        ):
+            # the message store lives on exactly one side (see _recompile)
+            return not self._messages_on_device
+        if vt == int(ValueType.WORKFLOW_INSTANCE_SUBSCRIPTION):
+            # CORRELATE routes by where the TARGET INSTANCE lives: demoted
+            # and host-only instances correlate on the oracle, device
+            # instances in the kernel
+            return (
+                value.activity_instance_key
+                in self._host.element_instances.instances
+            )
         return False
 
     def _var_column(self, name: str) -> int:
@@ -620,7 +835,46 @@ class TpuPartitionEngine:
         )
 
     def check_message_ttls(self) -> List[Record]:
-        return self._host.check_message_ttls()
+        from zeebe_tpu.protocol.intents import MessageIntent as MI
+        from zeebe_tpu.protocol.records import MessageRecord
+
+        now = self.clock()
+        s = self.state
+        keys = np.asarray(s.msg_key)
+        due = (keys >= 0) & (np.asarray(s.msg_deadline) <= now)
+        slots = np.nonzero(due)[0]
+        names = self.meta.varspace.names if self.meta else []
+        msg_i32 = np.asarray(s.msg_i32)
+        msg_pay = np.asarray(s.msg_pay)
+        out = []
+        for slot in slots[np.argsort(keys[slots])]:
+            slot = int(slot)
+            out.append(
+                Record(
+                    key=int(keys[slot]),
+                    metadata=RecordMetadata(
+                        record_type=RecordType.COMMAND,
+                        value_type=ValueType.MESSAGE,
+                        intent=int(MI.DELETE),
+                    ),
+                    value=MessageRecord(
+                        name=self.interns.string(int(msg_i32[slot, 0])) or "",
+                        correlation_key=self._corr_string(
+                            int(msg_i32[slot, 1]), int(msg_i32[slot, 2])
+                        ),
+                        payload=rb.columns_to_payload(
+                            *_host_unpack_payload(msg_pay[slot]),
+                            names, self.interns,
+                        ),
+                        message_id=(
+                            self.interns.string(int(msg_i32[slot, 3])) or ""
+                        ),
+                    ),
+                )
+            )
+        return sorted(
+            out + self._host.check_message_ttls(), key=lambda r: r.key
+        )
 
     def compaction_floor(self) -> int:
         """See PartitionEngine.compaction_floor — incident state lives on
@@ -685,17 +939,32 @@ class TpuPartitionEngine:
         self.meta = None
         self.graph = None
         if self.repository.by_key:
-            self._recompile(extra_variables=list(meta.get("variables", [])))
+            # no store migration during restore: the snapshot arrays below
+            # already carry the message store on whichever side the gate
+            # computes (the gate is a pure function of the restored repo)
+            self._restoring = True
+            try:
+                self._recompile(extra_variables=list(meta.get("variables", [])))
+            finally:
+                self._restoring = False
         arrays = snap["arrays"]
         kwargs = {}
+        pre_round4_arrays = False
         for f in dataclasses.fields(self.state):
             if f.name + ".keys" in arrays:
                 kwargs[f.name] = hashmap.HashTable(
                     keys=jnp.asarray(arrays[f.name + ".keys"]),
                     vals=jnp.asarray(arrays[f.name + ".vals"]),
                 )
-            else:
+            elif f.name in arrays:
                 kwargs[f.name] = jnp.asarray(arrays[f.name])
+            else:
+                # snapshot written before this state family existed (e.g.
+                # message tables added in round 4): keep the fresh empty
+                # table; any live state of that family sits on the host
+                # side of the snapshot and migrates below
+                kwargs[f.name] = getattr(self.state, f.name)
+                pre_round4_arrays = True
         st = state_mod.EngineState(**kwargs)
         # job-worker subscriptions are transient client-session state: the
         # reference drops them across failover (workers re-subscribe); the
@@ -713,6 +982,12 @@ class TpuPartitionEngine:
         self.last_processed_position = int(
             meta.get("last_processed_position", -1)
         )
+        if pre_round4_arrays and self._messages_on_device:
+            # the old snapshot's message store lives host-side (flat-key
+            # message workflows were host-only before round 4) but the
+            # restored deployment now computes a device store — migrate so
+            # publishes see the restored subscriptions
+            self._migrate_message_store_to_device()
 
     def _job_value_from_slot(self, slot: int) -> JobRecord:
         s = self.state
@@ -807,8 +1082,13 @@ class TpuPartitionEngine:
         for i, record in enumerate(records):
             vt = int(record.metadata.value_type)
             md = record.metadata
+            device_vt = vt in _DEVICE_VALUE_TYPES or (
+                vt in _MESSAGE_VALUE_TYPES
+                and self.graph is not None
+                and self.graph.has_messages
+            )
             if (
-                vt in _DEVICE_VALUE_TYPES
+                device_vt
                 and self.meta is not None
                 and self.graph is not None
                 and not self._routes_to_host(record)
@@ -1060,6 +1340,42 @@ class TpuPartitionEngine:
             cols["aux_key"][i] = value.activity_instance_key
             cols["instance_key"][i] = value.workflow_instance_key
             cols["deadline"][i] = value.due_date
+        elif vt == int(ValueType.MESSAGE):
+            self._stage_corr(cols, i, value.name, value.correlation_key)
+            cols["deadline"][i] = value.time_to_live
+            cols["aux2_key"][i] = (
+                self.interns.intern(value.message_id) if value.message_id else 0
+            )
+            self._stage_payload(cols, i, value.payload)
+        elif vt == int(ValueType.MESSAGE_SUBSCRIPTION):
+            self._stage_corr(cols, i, value.message_name, value.correlation_key)
+            cols["wf"][i] = value.workflow_instance_partition_id
+            cols["instance_key"][i] = value.workflow_instance_key
+            cols["aux_key"][i] = value.activity_instance_key
+        elif vt == int(ValueType.WORKFLOW_INSTANCE_SUBSCRIPTION):
+            self._stage_corr(
+                cols, i, value.message_name, value.correlation_key
+            )
+            cols["wf"][i] = value.message_partition_id
+            cols["aux2_key"][i] = value.message_partition_id
+            cols["instance_key"][i] = value.workflow_instance_key
+            cols["aux_key"][i] = value.activity_instance_key
+            self._stage_payload(cols, i, value.payload)
+
+    def _stage_corr(self, cols, i, name: str, correlation_key) -> None:
+        """Message-family correlation columns: type_id = interned name,
+        retries = correlation value type, worker = correlation bits."""
+        from zeebe_tpu.tpu.conditions import VT_NUM, VT_STR
+
+        cols["type_id"][i] = self.interns.intern(name) if name else 0
+        if isinstance(correlation_key, str) and correlation_key:
+            cols["retries"][i] = int(VT_STR)
+            cols["worker"][i] = self.interns.intern(correlation_key)
+        elif isinstance(correlation_key, (int, float)):
+            cols["retries"][i] = int(VT_NUM)
+            cols["worker"][i] = int(
+                np.float32(float(correlation_key)).view(np.int32)
+            )
 
     def _stage_payload(self, cols, i, payload) -> None:
         if not payload:
@@ -1146,7 +1462,10 @@ class TpuPartitionEngine:
             return results
         batch = self._stage([records[i] for i in live])
         now = jnp.asarray(self.clock(), jnp.int64)
-        self.state, out, stats = kernel.step_jit(self.graph, self.state, batch, now)
+        self.state, out, stats = kernel.step_jit(
+            self.graph, self.state, batch, now,
+            partition_id=jnp.asarray(self.partition_id, jnp.int32),
+        )
         if bool(stats["overflow"]):
             raise RuntimeError(
                 "device table overflow — raise TpuPartitionEngine capacity"
@@ -1172,6 +1491,11 @@ class TpuPartitionEngine:
         results: List[ProcessingResult],
         live_rows: List[int],
     ) -> None:
+        from zeebe_tpu.protocol.intents import (
+            MessageSubscriptionIntent as MS,
+            WorkflowInstanceSubscriptionIntent as WS,
+        )
+
         o = {f.name: np.asarray(getattr(out, f.name)) for f in dataclasses.fields(out)}
         count = int(o["valid"].sum())
         names = self.meta.varspace.names
@@ -1182,6 +1506,27 @@ class TpuPartitionEngine:
                 src_positions[src] if 0 <= src < len(src_positions) else -1
             )
             res = results[live_rows[src]] if 0 <= src < len(live_rows) else results[0]
+            # cross-partition subscription commands are SENDS, not appended
+            # records — exactly the oracle's out.sends channel
+            # (SubscriptionCommandSender.java:96-108)
+            vt = int(o["vtype"][r])
+            rt = int(o["rtype"][r])
+            intent = int(o["intent"][r])
+            if rt == int(RecordType.COMMAND) and vt == int(
+                ValueType.MESSAGE_SUBSCRIPTION
+            ) and intent in (int(MS.OPEN), int(MS.CLOSE)):
+                target = self.partition_for_correlation_key(
+                    record.value.correlation_key
+                )
+                record.source_record_position = -1  # sends are unstamped
+                res.sends.append((target, record))
+                continue
+            if rt == int(RecordType.COMMAND) and vt == int(
+                ValueType.WORKFLOW_INSTANCE_SUBSCRIPTION
+            ) and intent == int(WS.CORRELATE):
+                record.source_record_position = -1
+                res.sends.append((int(o["wf"][r]), record))
+                continue
             res.written.append(record)
             if o["resp"][r] and int(o["req"][r]) >= 0:
                 res.responses.append(record)
@@ -1270,9 +1615,69 @@ class TpuPartitionEngine:
                 due_date=int(o["deadline"][r]),
                 handler_element_id=elem_id,
             )
+        elif vt == int(ValueType.MESSAGE):
+            from zeebe_tpu.protocol.records import MessageRecord
+
+            value = MessageRecord(
+                name=self.interns.string(int(o["type_id"][r])) or "",
+                correlation_key=self._corr_string(
+                    int(o["retries"][r]), int(o["worker"][r])
+                ),
+                time_to_live=max(int(o["deadline"][r]), 0),
+                payload=payload,
+                message_id=self.interns.string(int(o["aux2_key"][r])) or "",
+            )
+            if rt == int(RecordType.COMMAND_REJECTION) and rej == rb.REJ_MSG_DUP:
+                md.rejection_type = RejectionType.BAD_VALUE
+                md.rejection_reason = (
+                    f"message with id '{value.message_id}' is already published"
+                )
+        elif vt == int(ValueType.MESSAGE_SUBSCRIPTION):
+            from zeebe_tpu.protocol.records import MessageSubscriptionRecord
+
+            value = MessageSubscriptionRecord(
+                workflow_instance_partition_id=int(o["wf"][r]),
+                workflow_instance_key=int(o["instance_key"][r]),
+                activity_instance_key=int(o["aux_key"][r]),
+                message_name=self.interns.string(int(o["type_id"][r])) or "",
+                correlation_key=self._corr_string(
+                    int(o["retries"][r]), int(o["worker"][r])
+                ),
+            )
+        elif vt == int(ValueType.WORKFLOW_INSTANCE_SUBSCRIPTION):
+            from zeebe_tpu.protocol.records import (
+                WorkflowInstanceSubscriptionRecord,
+            )
+
+            value = WorkflowInstanceSubscriptionRecord(
+                workflow_instance_key=int(o["instance_key"][r]),
+                activity_instance_key=int(o["aux_key"][r]),
+                message_name=self.interns.string(int(o["type_id"][r])) or "",
+                payload=payload,
+                message_partition_id=int(o["aux2_key"][r]),
+                correlation_key=self._corr_string(
+                    int(o["retries"][r]), int(o["worker"][r])
+                ),
+            )
         else:
             value = None
         return Record(key=int(o["key"][r]), metadata=md, value=value)
+
+    def _corr_string(self, cvt: int, cbits: int) -> str:
+        """Correlation columns → the oracle's string form (numeric keys
+        normalize to ``str(int(...))`` exactly like the oracle's
+        ``str(corr_value)`` on an int payload value; bools to
+        ``str(True/False)``)."""
+        from zeebe_tpu.tpu.conditions import VT_BOOL, VT_STR
+
+        if cvt == int(VT_STR):
+            return self.interns.string(cbits) or ""
+        if cvt == int(VT_BOOL):
+            return str(bool(np.int32(cbits).view(np.float32)))
+        if cvt == 0:
+            return ""
+        f = float(np.int32(cbits).view(np.float32))
+        return str(int(f)) if f == int(f) else str(f)
 
     def _incident_error(self, o, r, element, payload, rej):
         """Reconstruct the oracle's exact incident error message by
@@ -1306,4 +1711,10 @@ class TpuPartitionEngine:
             return ErrorType.IO_MAPPING_ERROR, "io mapping failed"
         if rej == _ERR_NO_RETRIES:
             return ErrorType.JOB_NO_RETRIES, "No more retries left."
+        if rej == rb.ERR_CORRELATION_KEY:
+            path = getattr(element, "correlation_key_path", "") if element else ""
+            return (
+                ErrorType.IO_MAPPING_ERROR,
+                f"Failed to extract the correlation-key by '{path}'",
+            )
         return ErrorType.UNKNOWN, ""

@@ -48,7 +48,8 @@ _DEVICE_ELEMENT_TYPES = {
     ElementType.PARALLEL_GATEWAY,
     ElementType.SEQUENCE_FLOW,
     ElementType.SUB_PROCESS,
-    ElementType.INTERMEDIATE_CATCH_EVENT,  # timer catch only (messages: host)
+    ElementType.INTERMEDIATE_CATCH_EVENT,  # timer + message catch
+    ElementType.RECEIVE_TASK,              # message catch (round 4)
 }
 
 
@@ -84,7 +85,7 @@ _DATA = [
     "join_nin", "join_pos", "job_type", "job_retries",
     "in_map_src", "in_map_dst", "in_map_n", "in_root",
     "out_map_src", "out_map_dst", "out_map_n", "out_root", "out_behavior",
-    "timer_dur", "progs", "lit_nums",
+    "timer_dur", "msg_name", "corr_var", "progs", "lit_nums",
 ]
 
 
@@ -92,7 +93,8 @@ _DATA = [
     jax.tree_util.register_dataclass,
     data_fields=_DATA,
     meta_fields=["num_vars", "emit_width", "max_join_in", "has_conditions",
-                 "has_parallel_joins", "has_timers", "has_mappings"],
+                 "has_parallel_joins", "has_timers", "has_mappings",
+                 "has_messages"],
 )
 @dataclasses.dataclass
 class DeviceGraph:
@@ -121,6 +123,8 @@ class DeviceGraph:
     out_root: jax.Array
     out_behavior: jax.Array
     timer_dur: jax.Array             # i64, -1 = no timer
+    msg_name: jax.Array              # interned message name, 0 = none
+    corr_var: jax.Array              # correlation-key payload column, -1 none
     progs: jax.Array                 # [P, L, 6] predicate programs
     lit_nums: jax.Array              # [Q] f32
     # static meta
@@ -135,6 +139,7 @@ class DeviceGraph:
     has_parallel_joins: bool = True
     has_timers: bool = True
     has_mappings: bool = True
+    has_messages: bool = False
 
 
 @dataclasses.dataclass
@@ -188,7 +193,13 @@ def check_device_compatible(workflow: ExecutableWorkflow) -> Optional[str]:
             if el.element_type not in _DEVICE_ELEMENT_TYPES:
                 return f"element type {el.element_type.name} ({el.id})"
             if el.message_name:
-                return f"message catch event ({el.id}) — host-only in this round"
+                # message catch runs on device (round 4); the correlation
+                # key must be a flat payload variable (same contract as
+                # io-mappings — nested documents never live in columns)
+                _flat_var(
+                    varspace, el.correlation_key_path,
+                    f"correlation key of {el.id}",
+                )
             if el.is_multi_instance:
                 return f"multi-instance activity ({el.id}) — host-only in this round"
             if el.boundary_events:
@@ -260,6 +271,8 @@ def compile_graph(
     out_root = np.zeros(shape, bool)
     out_behavior = np.zeros(shape, np.int32)
     timer_dur = np.full(shape, -1, np.int64)
+    msg_name = np.zeros(shape, np.int32)
+    corr_var = np.full(shape, -1, np.int32)
 
     slot_by_key: Dict[int, int] = {}
     elem_ids: List[List[str]] = []
@@ -313,9 +326,18 @@ def compile_graph(
             out_behavior[w, e] = int(el.output_behavior)
             if el.timer_duration_ms is not None:
                 timer_dur[w, e] = int(el.timer_duration_ms)
+            if el.message_name:
+                msg_name[w, e] = interns.intern(el.message_name)
+                corr_var[w, e] = _flat_var(
+                    varspace, el.correlation_key_path,
+                    f"correlation key of {el.id}",
+                )
 
     progs, lit_nums = pool.tensors()
     emit_width = max(2, int(out_count.max()) if workflows else 2)
+    if (msg_name > 0).any():
+        # a CORRELATE arrival emits CORRELATED + ELEMENT_COMPLETING + CLOSE
+        emit_width = max(emit_width, 3)
 
     graph = DeviceGraph(
         step_table=jnp.asarray(step_table),
@@ -342,6 +364,8 @@ def compile_graph(
         out_root=jnp.asarray(out_root),
         out_behavior=jnp.asarray(out_behavior),
         timer_dur=jnp.asarray(timer_dur),
+        msg_name=jnp.asarray(msg_name),
+        corr_var=jnp.asarray(corr_var),
         progs=progs,
         lit_nums=lit_nums,
         num_vars=max(len(varspace), 1),
@@ -354,6 +378,7 @@ def compile_graph(
             (in_map_n > 0).any() or (out_map_n > 0).any()
             or in_root.any() or out_root.any()
         ),
+        has_messages=bool((msg_name > 0).any()),
     )
     meta = GraphMeta(
         workflows=list(workflows),

@@ -33,8 +33,11 @@ from zeebe_tpu.models.transform.steps import BpmnStep as BS
 from zeebe_tpu.protocol.enums import RecordType, ValueType
 from zeebe_tpu.protocol.intents import (
     JobIntent as JI,
+    MessageIntent as MI,
+    MessageSubscriptionIntent as MS,
     TimerIntent as TI,
     WorkflowInstanceIntent as WI,
+    WorkflowInstanceSubscriptionIntent as WS,
 )
 from zeebe_tpu.tpu import batch as rb
 from zeebe_tpu.tpu import hashmap
@@ -42,15 +45,24 @@ from zeebe_tpu.tpu import pallas_ops as pops
 from zeebe_tpu.tpu.batch import RecordBatch
 from zeebe_tpu.tpu.conditions import ERROR as TRI_ERROR
 from zeebe_tpu.tpu.conditions import TRUE as TRI_TRUE
-from zeebe_tpu.tpu.conditions import VT_ABSENT, eval_programs
+from zeebe_tpu.tpu.conditions import (
+    VT_ABSENT,
+    VT_BOOL as COND_VT_BOOL,
+    VT_NUM as COND_VT_NUM,
+    VT_STR as COND_VT_STR,
+    eval_programs,
+)
 from zeebe_tpu.tpu.graph import DeviceGraph
 from zeebe_tpu.tpu.state import (
     EngineState,
+    corr_composite,
     pack_payload, unpack_payload,
     EI_ELEM, EI_STATE, EI_WF, EI_SCOPE, EI_TOKENS,
     EIL_KEY, EIL_IKEY, EIL_JOB_KEY,
     JB_STATE, JB_ELEM, JB_WF, JB_TYPE, JB_RETRIES, JB_WORKER,
     JBL_KEY, JBL_IKEY, JBL_AIK, JBL_DEADLINE,
+    MS_NAME, MS_CVT, MS_CBITS, MS_PART, MSL_WIKEY, MSL_AIK,
+    MG_NAME, MG_CVT, MG_CBITS, MG_MSGID,
 )
 
 RT_EVENT = int(RecordType.EVENT)
@@ -60,6 +72,9 @@ VT_WI = int(ValueType.WORKFLOW_INSTANCE)
 VT_JOB = int(ValueType.JOB)
 VT_INCIDENT = int(ValueType.INCIDENT)
 VT_TIMER = int(ValueType.TIMER)
+VT_MSG = int(ValueType.MESSAGE)
+VT_MSUB = int(ValueType.MESSAGE_SUBSCRIPTION)
+VT_WISUB = int(ValueType.WORKFLOW_INSTANCE_SUBSCRIPTION)
 
 _KEY_STEP = keyspace.STEP_SIZE
 
@@ -223,7 +238,7 @@ def _select_by_map(dst_from, vt, num, sid):
 
 def step_kernel(
     graph: DeviceGraph, state: EngineState, batch: RecordBatch, now,
-    synthetic_workers: bool = False,
+    synthetic_workers: bool = False, partition_id=0,
 ) -> Tuple[EngineState, RecordBatch, dict]:
     """Process one committed-record batch; returns (state', emissions, stats).
 
@@ -258,6 +273,18 @@ def step_kernel(
     job_cmd = is_job & (rt == RT_CMD)
     job_ev = is_job & (rt == RT_EVENT)
     timer_cmd = valid & (vt_ == VT_TIMER) & (rt == RT_CMD)
+    # message family (reference broker-core message correlation — the
+    # MESSAGE/MESSAGE_SUBSCRIPTION processors on the message partition and
+    # CorrelateWorkflowInstanceSubscription on the workflow partition;
+    # correlation columns ride type_id=name, retries=corr vt, worker=corr
+    # bits — fields the message rows never use for their job meanings)
+    msg_pub = valid & (vt_ == VT_MSG) & (rt == RT_CMD) & (it == int(MI.PUBLISH))
+    msg_del = valid & (vt_ == VT_MSG) & (rt == RT_CMD) & (it == int(MI.DELETE))
+    ms_open = valid & (vt_ == VT_MSUB) & (rt == RT_CMD) & (it == int(MS.OPEN))
+    ms_close = valid & (vt_ == VT_MSUB) & (rt == RT_CMD) & (it == int(MS.CLOSE))
+    wisub_corr = (
+        valid & (vt_ == VT_WISUB) & (rt == RT_CMD) & (it == int(WS.CORRELATE))
+    )
 
     # the three element-instance lookups (record key / scope key / job
     # activity key) probe the same table — ONE batched probe loop over the
@@ -267,7 +294,8 @@ def step_kernel(
         state.ei_map,
         jnp.concatenate([batch.key, batch.scope_key, batch.aux_key]),
         jnp.concatenate(
-            [wi_ev, wi_ev & (batch.scope_key >= 0), job_ev | timer_cmd]
+            [wi_ev, wi_ev & (batch.scope_key >= 0),
+             job_ev | timer_cmd | wisub_corr]
         ),
     )
     ei_found, ei_slot = ei3_found[:b], ei3_slot[:b]
@@ -283,6 +311,24 @@ def step_kernel(
     else:
         tm_found = jnp.zeros((b,), bool)
         tm_slot = jnp.zeros((b,), jnp.int32)
+    ms_cap = state.msub_ckey.shape[0]
+    mg_cap = state.msg_key.shape[0]
+    if graph.has_messages:
+        # composite (message name, correlation value) — the store key for
+        # both subscription and stored-message probes
+        ckey = corr_composite(batch.type_id, batch.retries, batch.worker)
+        msub_probe = msg_pub | ms_open | ms_close
+        msub_found, msub_slot = pops.lookup(state.msub_map, ckey, msub_probe)
+        mmsg_probe = msg_pub | ms_open | msg_del
+        mmsg_found, mmsg_slot = pops.lookup(state.msg_map, ckey, mmsg_probe)
+    else:
+        ckey = jnp.full((b,), -1, jnp.int64)
+        msub_found = jnp.zeros((b,), bool)
+        msub_slot = jnp.zeros((b,), jnp.int32)
+        mmsg_found = jnp.zeros((b,), bool)
+        mmsg_slot = jnp.zeros((b,), jnp.int32)
+    msub_clip = jnp.clip(msub_slot, 0, ms_cap - 1)
+    mmsg_clip = jnp.clip(mmsg_slot, 0, mg_cap - 1)
     ei_clip = jnp.clip(ei_slot, 0, n_cap - 1)
     sc_clip = jnp.clip(sc_slot, 0, n_cap - 1)
     aik_clip = jnp.clip(aik_slot, 0, n_cap - 1)
@@ -347,6 +393,7 @@ def step_kernel(
     m_psplit = m_step(BS.PARALLEL_SPLIT)
     m_pmerge = m_step(BS.PARALLEL_MERGE)
     m_timer_step = m_step(BS.CREATE_TIMER)
+    m_subscribe = m_step(BS.SUBSCRIBE_TO_INTERMEDIATE_MESSAGE)
 
     # job commands
     job_state = jnp.where(jb_found, state.job_state[jb_clip], -1)
@@ -398,6 +445,64 @@ def step_kernel(
     ttrig_inst = ttrig_ok & aik_found & (
         jnp.where(aik_found, state.ei_state[aik_clip], -1) == int(WI.ELEMENT_ACTIVATED)
     )
+
+    # message correlation guards (oracle: _process_message_command /
+    # _process_message_subscription / _process_wi_subscription)
+    if graph.has_messages:
+        msgid = batch.aux2_key.astype(jnp.int32)  # interned message id, 0 none
+        pub_dup = (
+            msg_pub & mmsg_found & (msgid > 0)
+            & (state.msg_i32[mmsg_clip, MG_MSGID] == msgid)
+        )
+        # one live slot per composite (the device store is hashmap-keyed):
+        # a second TTL-store or OPEN on an occupied composite REJECTS that
+        # record with an explicit reason — a legal-but-unsupported workload
+        # degrades per-record, never crashes the partition
+        pub_chain = msg_pub & ~pub_dup & (batch.deadline > 0) & mmsg_found
+        pub_ok = msg_pub & ~pub_dup & ~pub_chain
+        pub_store = pub_ok & (batch.deadline > 0)   # TTL rides the deadline col
+        pub_nostore = pub_ok & ~(batch.deadline > 0)
+        pub_corr = pub_ok & msub_found
+        open_dup = ms_open & msub_found
+        open_ok = ms_open & ~msub_found
+        open_corr = open_ok & mmsg_found
+        close_ok = (
+            ms_close & msub_found
+            & (state.msub_i64[msub_clip, MSL_AIK] == batch.aux_key)
+            & (state.msub_i64[msub_clip, MSL_WIKEY] == batch.instance_key)
+        )
+        del_ok = msg_del & mmsg_found & (state.msg_key[mmsg_clip] == batch.key)
+        corr_inst_ok = wisub_corr & aik_found
+        corr_rej = wisub_corr & ~aik_found
+        # subscribe step: correlation key extracted from the payload column.
+        # Accepted types mirror the oracle's isinstance(corr, (str, int)):
+        # strings, ints, and bools (a Python bool IS an int); floats raise
+        # the same IO_MAPPING incident the oracle does
+        cvar = graph.corr_var[wf_c, el_c]
+        cvar_c = jnp.clip(cvar, 0, v - 1)
+        corr_vt_ext = batch.v_vt[rows, cvar_c].astype(jnp.int32)
+        corr_bits_ext = jnp.where(
+            corr_vt_ext == int(COND_VT_STR),
+            batch.v_str[rows, cvar_c],
+            jax.lax.bitcast_convert_type(batch.v_num[rows, cvar_c], jnp.int32),
+        )
+        corr_extractable = (
+            (cvar >= 0)
+            & (
+                (corr_vt_ext == int(COND_VT_STR))
+                | (corr_vt_ext == int(COND_VT_NUM))
+                | (corr_vt_ext == int(COND_VT_BOOL))
+            )
+        )
+        sub_ok = m_subscribe & corr_extractable
+        sub_err = m_subscribe & ~corr_extractable
+    else:
+        zb = jnp.zeros((b,), bool)
+        pub_dup = pub_chain = pub_ok = pub_store = pub_nostore = pub_corr = zb
+        open_dup = open_ok = open_corr = close_ok = del_ok = zb
+        corr_inst_ok = corr_rej = sub_ok = sub_err = zb
+        corr_vt_ext = jnp.zeros((b,), jnp.int32)
+        corr_bits_ext = jnp.zeros((b,), jnp.int32)
 
     # ---------------- C. per-step compute ----------------
     # exclusive split: evaluate conditioned flows in order
@@ -581,7 +686,7 @@ def step_kernel(
     out_count = graph.out_count[wf_c, el_c]
     single_key = (
         m_create | m_take | xs_ok | m_actgw | m_startst | m_trigend
-        | m_trigstart | completer | m_tcreate
+        | m_trigstart | completer | m_tcreate | pub_ok | open_ok
     )
     n_wf = jnp.where(single_key, 1, jnp.where(m_psplit, out_count, 0))
     wf_base = state.next_wf_key + _KEY_STEP * _excl_cumsum(n_wf).astype(jnp.int64)
@@ -969,22 +1074,151 @@ def step_kernel(
         deadline=batch.deadline,
     )
 
+    # --- message correlation emissions
+    if graph.has_messages:
+        e2 = blank()
+        pid_col = jnp.broadcast_to(
+            jnp.asarray(partition_id, jnp.int32), (b,)
+        )
+        # subscribe step → OPEN sent to the message partition (oracle
+        # _h_subscribe_to_message); correlation-key failure → incident
+        e0 = put(
+            e0, sub_ok,
+            valid=True, rtype=RT_CMD, vtype=VT_MSUB, intent=int(MS.OPEN),
+            key=jnp.int64(-1), elem=batch.elem,
+            type_id=graph.msg_name[wf_c, el_c],
+            retries=corr_vt_ext, worker=corr_bits_ext,
+            instance_key=batch.instance_key, aux_key=batch.key,
+            wf=pid_col,
+        )
+        e0 = put(
+            e0, sub_err,
+            valid=True, rtype=RT_CMD, vtype=VT_INCIDENT, intent=0,
+            key=jnp.int64(-1), elem=batch.elem, aux_key=batch.key,
+            rej=rb.ERR_CORRELATION_KEY,
+        )
+        # message partition: PUBLISH
+        e0 = put(
+            e0, pub_dup | pub_chain | open_dup,
+            valid=True, rtype=RT_REJ, vtype=vt_, intent=it, key=batch.key,
+            type_id=batch.type_id, retries=batch.retries, worker=batch.worker,
+            instance_key=batch.instance_key, aux_key=batch.aux_key,
+            aux2_key=batch.aux2_key,
+            rej=jnp.where(
+                pub_dup, rb.REJ_MSG_DUP,
+                jnp.where(pub_chain, rb.REJ_MSG_STORE_OCCUPIED,
+                          rb.REJ_SUB_OCCUPIED),
+            ),
+            req=batch.req, req_stream=batch.req_stream, resp=batch.req >= 0,
+        )
+        e0 = put(
+            e0, pub_ok,
+            valid=True, rtype=RT_EVENT, vtype=VT_MSG, intent=int(MI.PUBLISHED),
+            key=key0, type_id=batch.type_id, retries=batch.retries,
+            worker=batch.worker, deadline=batch.deadline,
+            aux2_key=batch.aux2_key,
+            req=batch.req, req_stream=batch.req_stream, resp=batch.req >= 0,
+        )
+        e1 = put(
+            e1, pub_nostore,
+            valid=True, rtype=RT_EVENT, vtype=VT_MSG, intent=int(MI.DELETED),
+            key=key0, type_id=batch.type_id, retries=batch.retries,
+            worker=batch.worker, aux2_key=batch.aux2_key,
+        )
+        e2 = put(
+            e2, pub_corr,
+            valid=True, rtype=RT_CMD, vtype=VT_WISUB, intent=int(WS.CORRELATE),
+            key=jnp.int64(-1),
+            wf=state.msub_i32[msub_clip, MS_PART],
+            instance_key=state.msub_i64[msub_clip, MSL_WIKEY],
+            aux_key=state.msub_i64[msub_clip, MSL_AIK],
+            type_id=batch.type_id, retries=batch.retries, worker=batch.worker,
+            aux2_key=pid_col.astype(jnp.int64),  # message partition id
+        )
+        # message partition: OPEN / CLOSE
+        e0 = put(
+            e0, open_ok,
+            valid=True, rtype=RT_EVENT, vtype=VT_MSUB, intent=int(MS.OPENED),
+            key=key0, type_id=batch.type_id, retries=batch.retries,
+            worker=batch.worker, instance_key=batch.instance_key,
+            aux_key=batch.aux_key,
+        )
+        stored_vt, stored_sid, stored_num = unpack_payload(
+            state.msg_pay[mmsg_clip]
+        )
+        e1 = put(
+            e1, open_corr,
+            valid=True, rtype=RT_CMD, vtype=VT_WISUB, intent=int(WS.CORRELATE),
+            key=jnp.int64(-1), wf=batch.wf,
+            instance_key=batch.instance_key, aux_key=batch.aux_key,
+            type_id=batch.type_id, retries=batch.retries, worker=batch.worker,
+            aux2_key=pid_col.astype(jnp.int64),
+        )
+        e1["v_vt"] = jnp.where(
+            open_corr[:, None], stored_vt.astype(jnp.int8), e1["v_vt"]
+        )
+        e1["v_num"] = jnp.where(open_corr[:, None], stored_num, e1["v_num"])
+        e1["v_str"] = jnp.where(open_corr[:, None], stored_sid, e1["v_str"])
+        e0 = put(
+            e0, close_ok,
+            valid=True, rtype=RT_EVENT, vtype=VT_MSUB, intent=int(MS.CLOSED),
+            key=batch.key, type_id=batch.type_id, retries=batch.retries,
+            worker=batch.worker, instance_key=batch.instance_key,
+            aux_key=batch.aux_key,
+        )
+        e0 = put(
+            e0, del_ok,
+            valid=True, rtype=RT_EVENT, vtype=VT_MSG, intent=int(MI.DELETED),
+            key=batch.key, type_id=batch.type_id, retries=batch.retries,
+            worker=batch.worker, aux2_key=batch.aux2_key,
+        )
+        # workflow partition: CORRELATE arrival (oracle
+        # _process_wi_subscription) — CORRELATED + instance completes with
+        # the message payload + CLOSE back to the message partition
+        e0 = put(
+            e0, corr_inst_ok,
+            valid=True, rtype=RT_EVENT, vtype=VT_WISUB,
+            intent=int(WS.CORRELATED), key=batch.key,
+            type_id=batch.type_id, retries=batch.retries, worker=batch.worker,
+            instance_key=batch.instance_key, aux_key=batch.aux_key,
+        )
+        e1 = put(
+            e1, corr_inst_ok,
+            valid=True, rtype=RT_EVENT, vtype=VT_WI,
+            intent=int(WI.ELEMENT_COMPLETING), key=batch.aux_key,
+            elem=inst_elem, wf=inst_wf, scope_key=inst_scope_key,
+        )
+        e1["instance_key"] = jnp.where(
+            corr_inst_ok, state.ei_instance_key[aik_clip], e1["instance_key"]
+        )
+        e2 = put(
+            e2, corr_inst_ok,
+            valid=True, rtype=RT_CMD, vtype=VT_MSUB, intent=int(MS.CLOSE),
+            key=jnp.int64(-1), wf=pid_col,
+            type_id=batch.type_id, retries=batch.retries, worker=batch.worker,
+            instance_key=batch.instance_key, aux_key=batch.aux_key,
+        )
+        e0 = put(
+            e0, corr_rej,
+            valid=True, rtype=RT_REJ, vtype=vt_, intent=it, key=batch.key,
+            type_id=batch.type_id, retries=batch.retries, worker=batch.worker,
+            instance_key=batch.instance_key, aux_key=batch.aux_key,
+            rej=rb.REJ_SUB_NOT_ACTIVE,
+            req=batch.req, req_stream=batch.req_stream, resp=batch.req >= 0,
+        )
+    else:
+        e2 = None
+
     # jev_completed payload = job payload (record payload already in columns)
     # (value defaults carry batch payload, which is the job's — correct)
 
     # --- fork slots (parallel split) + assemble [B, E]
     em = {}
+    slots = [e0, e1] + ([e2] if e2 is not None else [])
     for name in e0:
-        a0 = e0[name]
-        a1 = e1[name]
-        if a0.ndim == 1:
-            stack = [a0, a1] + [
-                jnp.zeros_like(a0) for _ in range(e_w - 2)
-            ]
-            em[name] = jnp.stack(stack, axis=1)  # [B, E]
-        else:
-            stack = [a0, a1] + [jnp.zeros_like(a0) for _ in range(e_w - 2)]
-            em[name] = jnp.stack(stack, axis=1)  # [B, E, V]
+        parts = [e[name] for e in slots]
+        stack = parts + [jnp.zeros_like(parts[0]) for _ in range(e_w - len(parts))]
+        em[name] = jnp.stack(stack, axis=1)  # [B, E] or [B, E, V]
 
     fork_flows = graph.out_flows[wf_c, el_c]  # [B, F<=E]
     fan_out = fork_flows.shape[1]
@@ -1232,6 +1466,99 @@ def step_kernel(
         timer_wf_arr = state.timer_wf
         timer_map = state.timer_map
 
+    # ---------------- message tables ----------------
+    if graph.has_messages:
+        neg64 = jnp.full((b,), -1, jnp.int64)
+        # subscription inserts (OPEN) / removals (CLOSE)
+        msfree = _first_true_indices(state.msub_ckey < 0, b)
+        ms_rank = _excl_cumsum(open_ok.astype(jnp.int32))
+        ms_slot_new = msfree[jnp.clip(ms_rank, 0, b - 1)]
+        msub_overflow = jnp.any(open_ok & (ms_slot_new >= ms_cap))
+        msub_ckey_arr = pops.masked_vec64_update(
+            state.msub_ckey, ms_slot_new, open_ok, ckey
+        )
+        msub_i32_arr = pops.masked_row_update(
+            state.msub_i32, ms_slot_new, open_ok,
+            jnp.stack(
+                [batch.type_id, batch.retries, batch.worker, batch.wf], axis=-1
+            ),
+        )
+        msub_i64_pl = pops.i64_to_planes(state.msub_i64)
+        msub_i64_pl = pops.masked_row_update(
+            msub_i64_pl, ms_slot_new, open_ok,
+            pops.i64_to_planes(
+                jnp.stack([batch.instance_key, batch.aux_key], axis=-1)
+            ),
+        )
+        msub_map_arr, msub_ins_ok = pops.insert(
+            state.msub_map, ckey, ms_slot_new, open_ok
+        )
+        msub_ckey_arr = pops.masked_vec64_update(
+            msub_ckey_arr, msub_clip, close_ok, neg64
+        )
+        msub_map_arr = pops.delete(msub_map_arr, ckey, close_ok)
+        msub_i64_arr = pops.planes_to_i64(msub_i64_pl)
+
+        # stored messages (PUBLISH with TTL) / deletions
+        mgfree = _first_true_indices(state.msg_key < 0, b)
+        mg_rank = _excl_cumsum(pub_store.astype(jnp.int32))
+        mg_slot_new = mgfree[jnp.clip(mg_rank, 0, b - 1)]
+        msg_overflow = jnp.any(pub_store & (mg_slot_new >= mg_cap))
+        msg_key_arr = pops.masked_vec64_update(
+            state.msg_key, mg_slot_new, pub_store, key0
+        )
+        msg_ckey_arr = pops.masked_vec64_update(
+            state.msg_ckey, mg_slot_new, pub_store, ckey
+        )
+        msg_i32_arr = pops.masked_row_update(
+            state.msg_i32, mg_slot_new, pub_store,
+            jnp.stack(
+                [batch.type_id, batch.retries, batch.worker,
+                 batch.aux2_key.astype(jnp.int32)], axis=-1,
+            ),
+        )
+        msg_deadline_arr = pops.masked_vec64_update(
+            state.msg_deadline, mg_slot_new, pub_store, now + batch.deadline
+        )
+        msg_pay_arr = pops.masked_row_update(
+            state.msg_pay, mg_slot_new, pub_store, b_pay
+        )
+        msg_map_arr, msg_ins_ok = pops.insert(
+            state.msg_map, ckey, mg_slot_new, pub_store
+        )
+        msg_key_arr = pops.masked_vec64_update(
+            msg_key_arr, mmsg_clip, del_ok, neg64
+        )
+        msg_deadline_arr = pops.masked_vec64_update(
+            msg_deadline_arr, mmsg_clip, del_ok, neg64
+        )
+        msg_map_arr = pops.delete(msg_map_arr, ckey, del_ok)
+
+        # correlate arrival → instance completes with the message payload
+        ei_i32_arr = _col_update(
+            ei_i32_arr, aik_clip, corr_inst_ok, EI_STATE,
+            int(WI.ELEMENT_COMPLETING),
+        )
+        ei_pay = _scatter_pay(ei_pay, aik_clip, corr_inst_ok, b_pay, n_cap)
+
+        message_overflow = (
+            msub_overflow | msg_overflow
+            | ~jnp.all(msub_ins_ok == open_ok)
+            | ~jnp.all(msg_ins_ok == pub_store)
+        )
+    else:
+        msub_ckey_arr = state.msub_ckey
+        msub_i32_arr = state.msub_i32
+        msub_i64_arr = state.msub_i64
+        msub_map_arr = state.msub_map
+        msg_key_arr = state.msg_key
+        msg_ckey_arr = state.msg_ckey
+        msg_i32_arr = state.msg_i32
+        msg_deadline_arr = state.msg_deadline
+        msg_pay_arr = state.msg_pay
+        msg_map_arr = state.msg_map
+        message_overflow = jnp.zeros((), bool)
+
     # ---------------- output compaction ----------------
     flat_valid = em["valid"].reshape(-1)
     be = b * e_w
@@ -1303,6 +1630,11 @@ def step_kernel(
         timer_key=timer_key_arr, timer_due=timer_due_arr,
         timer_aik=timer_aik_arr, timer_instance_key=timer_ik_arr,
         timer_elem=timer_elem_arr, timer_wf=timer_wf_arr, timer_map=timer_map,
+        msub_ckey=msub_ckey_arr, msub_i32=msub_i32_arr,
+        msub_i64=msub_i64_arr, msub_map=msub_map_arr,
+        msg_key=msg_key_arr, msg_ckey=msg_ckey_arr, msg_i32=msg_i32_arr,
+        msg_deadline=msg_deadline_arr, msg_pay=msg_pay_arr,
+        msg_map=msg_map_arr,
         sub_key=state.sub_key, sub_type=state.sub_type,
         sub_worker=state.sub_worker, sub_credits=sub_credits,
         sub_timeout=state.sub_timeout, sub_valid=state.sub_valid,
@@ -1312,7 +1644,8 @@ def step_kernel(
     stats = {
         "processed": jnp.sum(valid, dtype=jnp.int32),
         "stepped": jnp.sum(stepped, dtype=jnp.int32)
-        + jnp.sum(job_cmd | job_ev | timer_cmd | m_create | m_created_ev,
+        + jnp.sum(job_cmd | job_ev | timer_cmd | m_create | m_created_ev
+                  | msg_pub | msg_del | ms_open | ms_close | wisub_corr,
                   dtype=jnp.int32),
         "emitted": count,
         "completed_roots": jnp.sum(
@@ -1320,6 +1653,7 @@ def step_kernel(
         ),
         "overflow": (
             ei_overflow | job_overflow | join_overflow | timer_overflow
+            | message_overflow
             | ~jnp.all(ei_ins_ok == ins) | ~jnp.all(job_ins_ok == job_ins)
         ),
     }

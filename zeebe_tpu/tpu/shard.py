@@ -28,16 +28,61 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from zeebe_tpu.engine import keyspace
+from zeebe_tpu.protocol.enums import RecordType, ValueType
 from zeebe_tpu.tpu import batch as rb
 from zeebe_tpu.tpu import state as state_mod
 from zeebe_tpu.tpu.batch import RecordBatch
 from zeebe_tpu.tpu.graph import DeviceGraph
 from zeebe_tpu.tpu.kernel import step_kernel
-from zeebe_tpu.tpu.state import EngineState
+from zeebe_tpu.tpu.state import EngineState, corr_composite
 
 # partition id lives in the key's high bits (reference Protocol.java keeps
 # partition-local key spaces; 13 bits of partition, 51 bits of counter)
 PARTITION_KEY_SHIFT = 51
+
+
+def correlation_route(out: RecordBatch, nparts: int, my_pid):
+    """Destination partition per emission row.
+
+    Message-subscription commands (OPEN/CLOSE) hash their correlation
+    composite — the device mesh's analogue of the oracle's
+    ``partition_for_correlation_key`` (``SubscriptionCommandSender.java:
+    96-108``; the hash FUNCTION differs from the host's string hash, which
+    only matters when comparing partition assignments across engine kinds
+    — the mesh is self-consistent). CORRELATE commands carry their
+    destination (the subscribing instance's partition) in the ``wf``
+    column. Everything else stays local."""
+    rt_cmd = out.rtype == int(RecordType.COMMAND)
+    is_msub = out.valid & rt_cmd & (
+        out.vtype == int(ValueType.MESSAGE_SUBSCRIPTION)
+    )
+    is_corr = out.valid & rt_cmd & (
+        out.vtype == int(ValueType.WORKFLOW_INSTANCE_SUBSCRIPTION)
+    )
+    ckey = corr_composite(out.type_id, out.retries, out.worker)
+    # Fibonacci multiplicative hash on the composite (wraps mod 2^64)
+    h = ((ckey * jnp.int64(-7046029254386353131)) >> 33) & jnp.int64(
+        0x7FFFFFFF
+    )
+    hash_target = (h % nparts).astype(jnp.int32)
+    return jnp.where(
+        is_msub, hash_target,
+        jnp.where(is_corr, jnp.clip(out.wf, 0, nparts - 1), my_pid),
+    )
+
+
+def _first_true_indices_local(mask, k):
+    """Indices of the first ``k`` True entries (kernel._first_true_indices
+    without the MXU scan — exchange blocks are small and this runs inside
+    shard_map where odd lengths are common)."""
+    n = mask.shape[0]
+    rank = jnp.cumsum(mask.astype(jnp.int32)) - mask.astype(jnp.int32)
+    tgt = jnp.where(mask & (rank < k), rank, k)
+    return (
+        jnp.full((k,), n, jnp.int32)
+        .at[tgt]
+        .set(jnp.arange(n, dtype=jnp.int32), mode="drop")
+    )
 
 
 def make_partitioned_state(
@@ -157,7 +202,7 @@ def make_exchange(num_partitions: int, slots: int, num_vars: int) -> RecordBatch
 
 def build_sharded_drive(
     mesh: Mesh, batch_size: int, synthetic_workers: bool = False,
-    max_rounds: int = 10_000,
+    max_rounds: int = 10_000, exchange_slots: int = 0,
 ):
     """The multi-partition drive-to-quiescence loop as ONE device program:
     per-partition record queues feed the step kernel under ``shard_map``,
@@ -166,6 +211,26 @@ def build_sharded_drive(
     processes empty batches until every partition drains — the sharded
     analogue of ``drive.run_to_quiescence``).
 
+    Cross-partition message correlation rides the ICI every round: emission
+    rows whose route (``correlation_route``) is another partition are
+    bucketed into per-destination blocks of ``exchange_slots`` rows and
+    delivered by ``all_to_all`` — the reference's subscription transport
+    (``SubscriptionCommandSender``) as a mesh collective. Arrivals enqueue
+    after local emissions; a block overflow aborts the drive loudly.
+
+    Queue sizing: ``drive.enqueue`` needs the whole PADDED incoming block
+    to fit, so with messages each per-partition queue must hold at least
+    ``batch_size * graph.emit_width + nparts * exchange_slots`` rows of
+    headroom above its backlog.
+
+    Staging contract: the mesh never materializes rows to host records, so
+    correlation VALUE-TYPE TAGS must agree between what the subscribe step
+    extracts from instance payloads and what staged publishes carry — a
+    publish staged with a VT_STR intern of "42" will NOT match a
+    subscription whose payload variable was numeric 42 (the serving path
+    normalizes through record materialization; the mesh path by staging
+    discipline).
+
     Returns ``drive(graph, state[P], queue[P], now) →
     (state', queue', totals[P])`` where totals carries per-shard processed/
     emitted/completed counts plus the shared overflow flag.
@@ -173,10 +238,13 @@ def build_sharded_drive(
     from zeebe_tpu.tpu import drive as drive_mod
 
     axis = mesh.axis_names[0]
+    nparts = mesh.devices.shape[0]
+    exchange_slots = exchange_slots or batch_size
 
     def shard_fn(graph, state, queue, now):
         state = _squeeze(state)
         queue = _squeeze(queue)
+        my_pid = jax.lax.axis_index(axis).astype(jnp.int32)
 
         totals0 = {
             "processed": jnp.zeros((), jnp.int64),
@@ -199,9 +267,56 @@ def build_sharded_drive(
             s, q, t, _pending = carry
             q, batch = drive_mod.dequeue(q, batch_size)
             s, out, stats = step_kernel(
-                graph, s, batch, now, synthetic_workers=synthetic_workers
+                graph, s, batch, now, synthetic_workers=synthetic_workers,
+                partition_id=my_pid,
             )
-            q = drive_mod.enqueue(q, out)
+            xover = jnp.zeros((), bool)
+            if graph.has_messages and nparts > 1:
+                target = correlation_route(out, nparts, my_pid)
+                stay = out.valid & (target == my_pid)
+                # per-destination blocks (own-destination block is empty by
+                # construction: target == my_pid rows are 'stay')
+                be = out.size
+                blocks = []
+                for p in range(nparts):
+                    m = out.valid & (target == p) & (target != my_pid)
+                    xover = xover | (
+                        jnp.sum(m, dtype=jnp.int32) > exchange_slots
+                    )
+                    idx = jnp.clip(
+                        _first_true_indices_local(m, exchange_slots),
+                        0, be - 1,
+                    )
+                    n_p = jnp.sum(m, dtype=jnp.int32)
+                    block = jax.tree.map(
+                        lambda a: jnp.take(a, idx, axis=0), out
+                    )
+                    block = dataclasses.replace(
+                        block,
+                        valid=jnp.arange(exchange_slots, dtype=jnp.int32)
+                        < n_p,
+                        # arrivals are fresh log entries at the destination
+                        src=jnp.full((exchange_slots,), -1, jnp.int32),
+                    )
+                    blocks.append(block)
+                sends = jax.tree.map(
+                    lambda *xs: jnp.stack(xs, axis=0), *blocks
+                )  # [P, S, ...]
+                arrivals = jax.tree.map(
+                    lambda a: jax.lax.all_to_all(a, axis, 0, 0), sends
+                )
+                flat = jax.tree.map(
+                    lambda a: a.reshape((nparts * exchange_slots,)
+                                        + a.shape[2:]),
+                    arrivals,
+                )
+                # local rows keep their emission order; exchanged arrivals
+                # append after (both prefix-compacted for enqueue)
+                local = rb.compact(dataclasses.replace(out, valid=stay))
+                q = drive_mod.enqueue(q, local)
+                q = drive_mod.enqueue(q, rb.compact(flat))
+            else:
+                q = drive_mod.enqueue(q, out)
             t = {
                 "processed": t["processed"] + stats["processed"].astype(jnp.int64),
                 "emitted": t["emitted"] + stats["emitted"].astype(jnp.int64),
@@ -211,7 +326,8 @@ def build_sharded_drive(
                 # overflow anywhere aborts everywhere (lockstep)
                 "overflow": t["overflow"]
                 | (jax.lax.psum(
-                    (stats["overflow"] | q.overflow).astype(jnp.int32), axis
+                    (stats["overflow"] | q.overflow | xover).astype(jnp.int32),
+                    axis,
                 ) > 0),
             }
             pending = jax.lax.psum(q.count, axis)

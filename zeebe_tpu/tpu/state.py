@@ -37,6 +37,32 @@ EI_ELEM, EI_STATE, EI_WF, EI_SCOPE, EI_TOKENS = 0, 1, 2, 3, 4
 EIL_KEY, EIL_IKEY, EIL_JOB_KEY = 0, 1, 2
 JB_STATE, JB_ELEM, JB_WF, JB_TYPE, JB_RETRIES, JB_WORKER = 0, 1, 2, 3, 4, 5
 JBL_KEY, JBL_IKEY, JBL_AIK, JBL_DEADLINE = 0, 1, 2, 3
+# message subscriptions (message-partition role): i32 cols = (name id,
+# correlation vt, correlation bits, workflow-instance partition);
+# i64 cols = (workflowInstanceKey, activityInstanceKey)
+MS_NAME, MS_CVT, MS_CBITS, MS_PART = 0, 1, 2, 3
+MSL_WIKEY, MSL_AIK = 0, 1
+# stored messages (TTL > 0): i32 cols = (name id, correlation vt,
+# correlation bits, interned message id)
+MG_NAME, MG_CVT, MG_CBITS, MG_MSGID = 0, 1, 2, 3
+
+
+def corr_composite(name_id, corr_vt, corr_bits):
+    """Injective i64 composite of (message name, correlation value) — the
+    hashmap key for subscription and stored-message lookups. The oracle
+    keys correlation on ``(message name, str(correlation key))``
+    (interpreter ``StoredSubscription``); on device the value is an
+    interned-string id or the f32 bit pattern, tagged by its value type so
+    numeric and string keys can never alias. Non-negative by construction
+    (intern ids are ≥ 0), so it never collides with the hashmap's
+    EMPTY/TOMBSTONE sentinels."""
+    import jax.numpy as _jnp
+
+    return (
+        (name_id.astype(_jnp.int64) << 35)
+        | (corr_vt.astype(_jnp.int64) << 32)
+        | corr_bits.astype(_jnp.uint32).astype(_jnp.int64)
+    )
 
 _STATE_FIELDS = [
     "ei_i32", "ei_i64", "ei_pay", "ei_map",
@@ -45,6 +71,8 @@ _STATE_FIELDS = [
     "join_pos_stamp", "join_map",
     "timer_key", "timer_due", "timer_aik", "timer_instance_key", "timer_elem",
     "timer_wf", "timer_map",
+    "msub_ckey", "msub_i32", "msub_i64", "msub_map",
+    "msg_key", "msg_ckey", "msg_i32", "msg_deadline", "msg_pay", "msg_map",
     "sub_key", "sub_type", "sub_worker", "sub_credits", "sub_timeout", "sub_valid",
     "sub_rr",
     "next_wf_key", "next_job_key",
@@ -120,6 +148,24 @@ class EngineState:
     timer_elem: jax.Array      # i32 handler element
     timer_wf: jax.Array        # i32
     timer_map: hashmap.HashTable
+
+    # message subscriptions [MS] (this partition as MESSAGE partition —
+    # reference broker-core message correlation state; device redesign of
+    # the oracle's StoredSubscription list). One open subscription per
+    # (name, correlation) composite; a second OPEN on a live composite is
+    # a loud overflow (kernel stat), not silent data loss.
+    msub_ckey: jax.Array       # [MS] i64 corr_composite, -1 free
+    msub_i32: jax.Array        # [MS, 4] (name, cvt, cbits, wi partition)
+    msub_i64: jax.Array        # [MS, 2] (workflowInstanceKey, activityInstanceKey)
+    msub_map: hashmap.HashTable  # composite → slot
+
+    # stored messages with TTL [MG] (oracle StoredMessage dict)
+    msg_key: jax.Array         # [MG] i64 message key, -1 free
+    msg_ckey: jax.Array        # [MG] i64 corr_composite
+    msg_i32: jax.Array         # [MG, 4] (name, cvt, cbits, interned msg id)
+    msg_deadline: jax.Array    # [MG] i64 expiry timestamp
+    msg_pay: jax.Array         # [MG, 3V] packed payload
+    msg_map: hashmap.HashTable  # composite → slot
 
     # job worker subscriptions [S] (host-managed)
     sub_key: jax.Array         # i64 subscriber key
@@ -197,11 +243,15 @@ def make_state(
     timer_capacity: int = 0,
     sub_capacity: int = 64,
     max_join_in: int = 4,
+    msub_capacity: int = 0,
+    msg_capacity: int = 0,
 ) -> EngineState:
     n = capacity
     m = job_capacity or capacity
     j = join_capacity or max(capacity // 8, 256)
     tm = timer_capacity or max(capacity // 8, 256)
+    ms = msub_capacity or max(capacity // 2, 256)
+    mg = msg_capacity or max(capacity // 4, 256)
     v = num_vars
     i64, i32 = jnp.int64, jnp.int32
 
@@ -229,6 +279,16 @@ def make_state(
         timer_elem=jnp.zeros((tm,), i32),
         timer_wf=jnp.zeros((tm,), i32),
         timer_map=hashmap.make(_pow2(4 * tm)),
+        msub_ckey=jnp.full((ms,), -1, i64),
+        msub_i32=jnp.zeros((ms, 4), i32),
+        msub_i64=jnp.full((ms, 2), -1, i64),
+        msub_map=hashmap.make(_pow2(4 * ms)),
+        msg_key=jnp.full((mg,), -1, i64),
+        msg_ckey=jnp.full((mg,), -1, i64),
+        msg_i32=jnp.zeros((mg, 4), i32),
+        msg_deadline=jnp.full((mg,), -1, i64),
+        msg_pay=jnp.zeros((mg, 3 * v), i32),
+        msg_map=hashmap.make(_pow2(4 * mg)),
         sub_key=jnp.full((sub_capacity,), -1, i64),
         sub_type=jnp.zeros((sub_capacity,), i32),
         sub_worker=jnp.zeros((sub_capacity,), i32),
