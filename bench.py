@@ -117,39 +117,183 @@ def stage_creates(meta, wave, num_vars, interns):
     )
 
 
-def run_host_config(label, build_model, drive_fn, n_instances=512):
-    """Host-oracle engine bench for configs the device demotes this round
-    (message/boundary correlation, multi-instance). Measures the actual
-    serving interpreter: records processed per second through the broker
-    hot loop."""
-    import tempfile
+def build_graph_c4():
+    """Config 4: message catch + interrupting timer boundary — device-
+    compiled since round 4 (BASELINE.json configs[3])."""
+    return _compile(_config4_model())
+
+
+def build_graph_c5():
+    """Config 5: multi-instance sub-process, cardinality 4 (BASELINE.json
+    configs[4]) — device-compiled since round 4."""
+    return _compile(_config5_model())
+
+
+def stage_c4_creates(meta, wave, num_vars, base):
+    """CREATE commands with numeric correlation keys oid = base+i."""
+    import jax.numpy as jnp
+
+    from zeebe_tpu.protocol.enums import RecordType, ValueType
+    from zeebe_tpu.protocol.intents import WorkflowInstanceIntent as WI
+    from zeebe_tpu.tpu import batch as rb
+    from zeebe_tpu.tpu.conditions import VT_NUM
+
+    b = rb.empty(wave, num_vars)
+    oid = meta.varspace.column("oid")
+    v_vt = np.zeros((wave, num_vars), np.int8)
+    v_num = np.zeros((wave, num_vars), np.float32)
+    v_vt[:, oid] = VT_NUM
+    v_num[:, oid] = base + np.arange(wave)
+    return dataclasses.replace(
+        b,
+        valid=jnp.ones((wave,), bool),
+        rtype=jnp.full((wave,), int(RecordType.COMMAND), jnp.int32),
+        vtype=jnp.full((wave,), int(ValueType.WORKFLOW_INSTANCE), jnp.int32),
+        intent=jnp.full((wave,), int(WI.CREATE), jnp.int32),
+        wf=jnp.zeros((wave,), jnp.int32),
+        v_vt=jnp.asarray(v_vt),
+        v_num=jnp.asarray(v_num),
+    )
+
+
+def stage_c4_publishes(meta, wave, num_vars, base):
+    """PUBLISH commands correlating every EVEN oid of the wave (the odd
+    half expires through the interrupting timer boundary)."""
+    import jax.numpy as jnp
+
+    from zeebe_tpu.protocol.enums import RecordType, ValueType
+    from zeebe_tpu.protocol.intents import MessageIntent as MI
+    from zeebe_tpu.tpu import batch as rb
+    from zeebe_tpu.tpu.conditions import VT_BOOL, VT_NUM
+
+    half = wave // 2
+    b = rb.empty(wave, num_vars)
+    paid = meta.varspace.column("paid")
+    v_vt = np.zeros((wave, num_vars), np.int8)
+    v_num = np.zeros((wave, num_vars), np.float32)
+    v_vt[:half, paid] = VT_BOOL
+    v_num[:half, paid] = 1.0
+    name_id = meta.interns.intern("paid")
+    worker = np.zeros((wave,), np.int32)
+    worker[:half] = (
+        (base + 2 * np.arange(half)).astype(np.float32).view(np.int32)
+    )
+    return dataclasses.replace(
+        b,
+        valid=jnp.asarray(np.arange(wave) < half),
+        rtype=jnp.full((wave,), int(RecordType.COMMAND), jnp.int32),
+        vtype=jnp.full((wave,), int(ValueType.MESSAGE), jnp.int32),
+        intent=jnp.full((wave,), int(MI.PUBLISH), jnp.int32),
+        type_id=jnp.full((wave,), name_id, jnp.int32),
+        retries=jnp.full((wave,), int(VT_NUM), jnp.int32),
+        worker=jnp.asarray(worker),
+        v_vt=jnp.asarray(v_vt),
+        v_num=jnp.asarray(v_num),
+    )
+
+
+def run_device_config_c4(total_instances, wave, progress):
+    """Config 4 on the DEVICE kernel: per wave — create (instances open
+    subscriptions), publish (even half correlates), then a timer tick 31s
+    later fires the interrupting deadline boundary for the odd half."""
+    import dataclasses as _dc
     import time as _time
 
-    from zeebe_tpu.gateway import JobWorker, ZeebeClient
-    from zeebe_tpu.runtime import Broker, ControlledClock
+    import jax
+    import jax.numpy as jnp
 
-    clock = ControlledClock(start_ms=1_000_000)
-    broker = Broker(
-        num_partitions=1, data_dir=tempfile.mkdtemp(), clock=clock
+    from zeebe_tpu.tpu import drive, kernel as kernel_mod, state as state_mod
+
+    graph, meta = build_graph_c4()
+    meta.varspace.column("paid")
+    num_vars = max(graph.num_vars, 8)
+    graph = _dc.replace(graph, num_vars=num_vars)
+    capacity = 4 * wave
+    state = state_mod.make_state(
+        capacity=capacity, num_vars=num_vars, job_capacity=capacity,
+        timer_capacity=2 * wave, msub_capacity=2 * wave, msg_capacity=wave,
     )
-    try:
-        client = ZeebeClient(broker)
-        client.deploy_model(build_model())
-        JobWorker(broker, "bench-service", lambda ctx: {})
-        t0 = _time.perf_counter()
-        drive_fn(client, broker, clock, n_instances)
-        elapsed = _time.perf_counter() - t0
-        records = sum(1 for _ in broker.records(0))
-        return {
-            "config": label,
-            "engine": "host",
-            "instances": n_instances,
-            "records": records,
-            "elapsed_sec": round(elapsed, 3),
-            "transitions_per_sec": round(records / elapsed, 1),
-        }
-    finally:
-        broker.close()
+    queue = drive.make_queue(8 * wave * max(graph.emit_width // 2, 1), num_vars)
+    enqueue_jit = jax.jit(drive.enqueue, donate_argnums=(0,))
+    tick = jax.jit(kernel_mod.tick_kernel)
+
+    from zeebe_tpu.tpu import hashmap
+
+    def _rebuild(st):
+        # hashmap.insert only claims EMPTY buckets; per-wave delete churn
+        # (instances, timers, subscriptions) leaves tombstones that must
+        # be compacted away or probes exhaust (same cadence as config 1)
+        iota = lambda a: jnp.arange(a.shape[0], dtype=jnp.int32)  # noqa: E731
+        return _dc.replace(
+            st,
+            ei_map=hashmap.rebuild_from(
+                st.ei_map.keys.shape[0], st.ei_key, iota(st.ei_key),
+                st.ei_state >= 0)[0],
+            job_map=hashmap.rebuild_from(
+                st.job_map.keys.shape[0], st.job_key, iota(st.job_key),
+                st.job_state >= 0)[0],
+            timer_map=hashmap.rebuild_from(
+                st.timer_map.keys.shape[0], st.timer_key,
+                iota(st.timer_key), st.timer_key >= 0)[0],
+            msub_map=hashmap.rebuild_from(
+                st.msub_map.keys.shape[0], st.msub_ckey,
+                iota(st.msub_ckey), st.msub_ckey >= 0)[0],
+            msg_map=hashmap.rebuild_from(
+                st.msg_map.keys.shape[0], st.msg_ckey,
+                iota(st.msg_ckey), st.msg_key >= 0)[0],
+        )
+
+    rebuild_jit = jax.jit(_rebuild, donate_argnums=(0,))
+
+    def run_wave(state, queue, idx, sync):
+        base = idx * wave
+        now = jnp.asarray(idx * 100_000, jnp.int64)
+        queue = enqueue_jit(queue, stage_c4_creates(meta, wave, num_vars, base))
+        state, queue, t1 = drive.run_to_quiescence(
+            graph, state, queue, now, wave, sync=sync)
+        queue = enqueue_jit(
+            queue, stage_c4_publishes(meta, wave, num_vars, base))
+        state, queue, t2 = drive.run_to_quiescence(
+            graph, state, queue, now, wave, sync=sync)
+        trig, _count = tick(state, now + 31_000)
+        queue = enqueue_jit(queue, trig)
+        state, queue, t3 = drive.run_to_quiescence(
+            graph, state, queue, now + 31_000, wave, sync=sync)
+        return state, queue, (t1, t2, t3)
+
+    progress("[4-message-timer-boundary] compiling warmup wave...")
+    state, queue, _ = run_wave(state, queue, 0, sync=True)
+    state = rebuild_jit(state)
+    progress("[4-message-timer-boundary] timing...")
+    waves = max(total_instances // wave - 1, 1)
+    processed = jnp.zeros((), jnp.int64)
+    completed = jnp.zeros((), jnp.int64)
+    overflow = jnp.zeros((), bool)
+    t0 = _time.perf_counter()
+    for i in range(waves):
+        state, queue, (t1, t2, t3) = run_wave(state, queue, i + 1, sync=False)
+        for t in (t1, t2, t3):
+            processed = processed + t["processed"]
+            completed = completed + t["completed_roots"]
+            overflow = overflow | t["overflow"]
+        if (i + 1) % 3 == 0:
+            state = rebuild_jit(state)
+        if i % 8 == 0:
+            progress(f"[4-message-timer-boundary] wave {i}/{waves}")
+    jax.block_until_ready(state.ei_i32)
+    elapsed = _time.perf_counter() - t0
+    host = jax.device_get({"p": processed, "c": completed, "o": overflow})
+    assert not bool(host["o"]), "c4: device table overflow"
+    assert int(host["c"]) == waves * wave, (int(host["c"]), waves * wave)
+    return {
+        "config": "4-message-timer-boundary",
+        "engine": f"{jax.default_backend()}-kernel",
+        "instances": waves * wave,
+        "records": int(host["p"]),
+        "elapsed_sec": round(elapsed, 3),
+        "wave": wave,
+        "transitions_per_sec": round(int(host["p"]) / elapsed, 1),
+    }
 
 
 def _config4_model():
@@ -168,19 +312,6 @@ def _config4_model():
     )
 
 
-def _config4_drive(client, broker, clock, n):
-    for i in range(n):
-        client.create_instance("c4", {"oid": f"o-{i}"})
-    broker.run_until_idle()
-    # correlate half, let the boundary timer fire for the other half
-    for i in range(0, n, 2):
-        client.publish_message("paid", f"o-{i}", {"paid": True})
-    broker.run_until_idle()
-    clock.advance(31_000)
-    broker.tick()
-    broker.run_until_idle()
-
-
 def _config5_model():
     """Multi-instance subprocess (BASELINE configs[4])."""
     from zeebe_tpu.models.bpmn.builder import Bpmn
@@ -189,14 +320,10 @@ def _config5_model():
     sub = builder.start_event("start").sub_process(
         "each", multi_instance={"cardinality": 4}
     )
-    sub.start_event("s").service_task("work", type="bench-service").end_event("e")
+    sub.start_event("s").service_task(
+        "work", type="payment-service"  # served by the bench's synthetic sub
+    ).end_event("e")
     return sub.embedded_done().end_event("done").done()
-
-
-def _config5_drive(client, broker, clock, n):
-    for i in range(n):
-        client.create_instance("c5", {"batch": i})
-    broker.run_until_idle()
 
 
 def run_serving_path(n_instances=2048, engine="tpu", threads=8):
@@ -299,9 +426,12 @@ def run_serving_path(n_instances=2048, engine="tpu", threads=8):
         broker.close()
 
 
-def run_device_config(build_fn, label, total_instances, wave, progress):
+def run_device_config(build_fn, label, total_instances, wave, progress,
+                      cap_factor=4):
     """One device-engine bench: stage CREATE waves, drive to quiescence
-    with synthetic workers, count transitions."""
+    with synthetic workers, count transitions. ``cap_factor`` scales the
+    state tables for configs with per-instance fan-out (multi-instance
+    spawns cardinality+1 element instances per root)."""
     import dataclasses as _dc
     import time as _time
 
@@ -311,7 +441,7 @@ def run_device_config(build_fn, label, total_instances, wave, progress):
     from zeebe_tpu.tpu import drive, hashmap, state as state_mod
 
     batch_size = wave
-    capacity = 4 * wave
+    capacity = cap_factor * wave
     graph, meta = build_fn()
     meta.varspace.column("orderId")
     meta.varspace.column("orderValue")
@@ -335,7 +465,9 @@ def run_device_config(build_fn, label, total_instances, wave, progress):
         sub_timeout=state.sub_timeout.at[0].set(300_000),
         sub_valid=state.sub_valid.at[0].set(True),
     )
-    queue = drive.make_queue(8 * wave, num_vars)
+    # queue headroom scales with the emission fan (multi-instance graphs
+    # emit up to emit_width rows per record)
+    queue = drive.make_queue(4 * wave * max(2, graph.emit_width), num_vars)
     creates = stage_creates(meta, wave, num_vars, meta.interns)
     enqueue_jit = jax.jit(drive.enqueue, donate_argnums=(0,))
     rebuild_jit = jax.jit(
@@ -533,23 +665,22 @@ def main():
             )
         except Exception as e:  # noqa: BLE001
             configs.append({"config": "3-parallel-fork-join", "error": str(e)[:200]})
-        # configs 4-5 exercise message/boundary correlation and
-        # multi-instance — host-engine-served this round (the device graph
-        # demotes those workflows); numbers are the oracle interpreter's
+        # configs 4-5 run on the DEVICE kernel since round 4 (message
+        # correlation, boundary events, and cardinality multi-instance
+        # compile to the device graph)
         try:
             configs.append(
-                run_host_config(
-                    "4-message-timer-boundary", _config4_model, _config4_drive,
-                    n_instances=1024 if accel else 128,
+                run_device_config_c4(
+                    side_total, wave if accel else wave // 2, _progress
                 )
             )
         except Exception as e:  # noqa: BLE001
             configs.append({"config": "4-message-timer-boundary", "error": str(e)[:200]})
         try:
             configs.append(
-                run_host_config(
-                    "5-multi-instance-subprocess", _config5_model, _config5_drive,
-                    n_instances=1024 if accel else 128,
+                run_device_config(
+                    build_graph_c5, "5-multi-instance-subprocess",
+                    side_total, wave, _progress, cap_factor=16,
                 )
             )
         except Exception as e:  # noqa: BLE001

@@ -858,3 +858,266 @@ class TestMessageStoreLimits:
             assert len(completed) == 1
         finally:
             broker.close()
+
+
+def boundary_timer_process(interrupting=True):
+    return (
+        Bpmn.create_process("bdflow")
+        .start_event("start")
+        .service_task("slow", type="slow-service")
+        .boundary_event("deadline", duration_ms=30_000, interrupting=interrupting)
+        .service_task("escalate", type="esc-service")
+        .end_event("late-end")
+        .move_to("slow")
+        .end_event("end")
+        .done()
+    )
+
+
+def boundary_message_process(interrupting=True):
+    return (
+        Bpmn.create_process("bdmsg")
+        .start_event("start")
+        .service_task("work", type="work-service")
+        .boundary_event(
+            "stop", message_name="halt", correlation_key="$.wid",
+            interrupting=interrupting,
+        )
+        .end_event("halted")
+        .move_to("work")
+        .end_event("end")
+        .done()
+    )
+
+
+def mi_cardinality_process(cardinality=3):
+    builder = Bpmn.create_process("miflow")
+    sub = builder.start_event("start").sub_process(
+        "each", multi_instance={"cardinality": cardinality}
+    )
+    sub.start_event("s").service_task("work", type="mi-service").end_event("e")
+    return sub.embedded_done().end_event("done").done()
+
+
+class TestBoundaryEventParity:
+    """Round 4: timer and message boundary events on tasks compile to the
+    device — arming, disarming, interrupting termination (job cancel +
+    continuation at the boundary), non-interrupting token fan-out — with
+    logs bit-identical to the oracle (reference model BoundaryEvent.java;
+    the reference engine never executes it)."""
+
+    def test_interrupting_timer_fires(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(boundary_timer_process())
+            done = []
+            JobWorker(broker, "esc-service", lambda ctx: done.append(1) or {})
+            # no slow-service worker: the job stays out; the timer wins
+            client.create_instance("bdflow", {"orderId": 1})
+            broker.run_until_idle()
+            clock.advance(31_000)
+            broker.tick()
+            broker.run_until_idle()
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_interrupting_timer_beaten_by_completion(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(boundary_timer_process())
+            JobWorker(broker, "slow-service", lambda ctx: {"done": True})
+            client.create_instance("bdflow", {"orderId": 2})
+            broker.run_until_idle()
+            clock.advance(31_000)
+            broker.tick()
+            broker.run_until_idle()
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_non_interrupting_timer_fires_host_continues(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(boundary_timer_process(interrupting=False))
+            done = []
+            JobWorker(broker, "esc-service", lambda ctx: done.append(1) or {})
+            client.create_instance("bdflow", {"orderId": 3})
+            broker.run_until_idle()
+            clock.advance(31_000)
+            broker.tick()
+            broker.run_until_idle()
+            # the host task is still live after the boundary fired —
+            # completing it now finishes the instance
+            JobWorker(broker, "slow-service", lambda ctx: {"late": True})
+            broker.run_until_idle()
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_interrupting_message_boundary(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(boundary_message_process())
+            client.create_instance("bdmsg", {"wid": "w-1"})
+            broker.run_until_idle()
+            client.publish_message("halt", "w-1", {"reason": "stop"})
+            broker.run_until_idle()
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_message_boundary_disarms_on_completion(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(boundary_message_process())
+            JobWorker(broker, "work-service", lambda ctx: {"ok": 1})
+            client.create_instance("bdmsg", {"wid": "w-2"})
+            broker.run_until_idle()
+            # late publish: the subscription is closed, message buffers
+            client.publish_message("halt", "w-2", {"late": 1}, time_to_live_ms=5_000)
+            broker.run_until_idle()
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_receive_task_with_timer_boundary_config4(self, rig):
+        """The BASELINE config-4 shape: message catch + interrupting timer
+        deadline — half the instances correlate, half expire."""
+        def scenario(broker, client, clock):
+            model = (
+                Bpmn.create_process("c4")
+                .start_event("start")
+                .receive_task("wait-pay", message_name="paid",
+                              correlation_key="$.oid")
+                .boundary_event("deadline", duration_ms=30_000)
+                .end_event("expired")
+                .move_to("wait-pay")
+                .end_event("done")
+                .done()
+            )
+            client.deploy_model(model)
+            for i in range(6):
+                client.create_instance("c4", {"oid": f"o-{i}"})
+            broker.run_until_idle()
+            for i in range(0, 6, 2):
+                client.publish_message("paid", f"o-{i}", {"paid": True})
+            broker.run_until_idle()
+            clock.advance(31_000)
+            broker.tick()
+            broker.run_until_idle()
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+
+class TestMultiInstanceParity:
+    """Round 4: cardinality-based multi-instance sub-processes fan out on
+    the device (collection-driven MI keeps the host path — collections
+    have no columnar form)."""
+
+    def test_cardinality_fanout_completes(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(mi_cardinality_process(3))
+            seen = []
+            JobWorker(
+                broker, "mi-service",
+                lambda ctx: seen.append(ctx.job.payload.get("loopCounter")) or {},
+                credits=16,
+            )
+            client.create_instance("miflow", {"batch": 7})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_two_instances_interleaved(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(mi_cardinality_process(2))
+            JobWorker(broker, "mi-service", lambda ctx: {}, credits=16)
+            client.create_instance("miflow", {"a": 1})
+            client.create_instance("miflow", {"a": 2})
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_collection_mi_stays_host_side(self):
+        from tests.conftest import make_tpu_broker
+
+        broker = make_tpu_broker()
+        try:
+            client = ZeebeClient(broker)
+            builder = Bpmn.create_process("coll")
+            sub = builder.start_event("s").sub_process(
+                "each", multi_instance={"input_collection": "$.items",
+                                        "input_element": "item"}
+            )
+            sub.start_event("ss").service_task("w", type="c-svc").end_event("se")
+            client.deploy_model(sub.embedded_done().end_event("e").done())
+            assert broker.partitions[0].engine._host_only_keys
+            seen = []
+            JobWorker(
+                broker, "c-svc",
+                lambda ctx: seen.append(ctx.job.payload["item"]) or {},
+            )
+            client.create_instance("coll", {"items": ["x", "y"]})
+            broker.run_until_idle()
+            assert sorted(seen) == ["x", "y"]
+        finally:
+            broker.close()
+
+
+def dual_boundary_process():
+    """Receive task with BOTH an interrupting message boundary and a timer
+    boundary — the terminate-catch path must re-scan timers exactly like
+    the oracle (two CANCEL commands for the armed timer: disarm + the
+    terminate-catch scan)."""
+    return (
+        Bpmn.create_process("dual")
+        .start_event("start")
+        .receive_task("wait", message_name="main", correlation_key="$.cid")
+        .boundary_event(
+            "abort", message_name="abort", correlation_key="$.cid",
+            interrupting=True,
+        )
+        .end_event("aborted")
+        .move_to("wait")
+        .boundary_event("late", duration_ms=60_000)
+        .end_event("timed-out")
+        .move_to("wait")
+        .end_event("done")
+        .done()
+    )
+
+
+class TestDualBoundaryParity:
+    def test_message_boundary_fires_while_timer_armed(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(dual_boundary_process())
+            client.create_instance("dual", {"cid": "c-1"})
+            broker.run_until_idle()
+            client.publish_message("abort", "c-1", {"why": "stop"})
+            broker.run_until_idle()
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_timer_fires_while_message_boundary_armed(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(dual_boundary_process())
+            client.create_instance("dual", {"cid": "c-2"})
+            broker.run_until_idle()
+            clock.advance(61_000)
+            broker.tick()
+            broker.run_until_idle()
+
+        rig.run(scenario)
+        rig.assert_parity()
+
+    def test_main_message_wins_disarms_both(self, rig):
+        def scenario(broker, client, clock):
+            client.deploy_model(dual_boundary_process())
+            client.create_instance("dual", {"cid": "c-3"})
+            broker.run_until_idle()
+            client.publish_message("main", "c-3", {"ok": 1})
+            broker.run_until_idle()
+            clock.advance(61_000)
+            broker.tick()
+            broker.run_until_idle()
+
+        rig.run(scenario)
+        rig.assert_parity()

@@ -2144,6 +2144,13 @@ class PartitionEngine:
                 if not (
                     s.activity_instance_key == value.activity_instance_key
                     and s.workflow_instance_key == value.workflow_instance_key
+                    # name-scoped: an activity instance holds one
+                    # subscription per message (own catch + message
+                    # boundaries); each CLOSE names the one it consumes
+                    and (
+                        not value.message_name
+                        or s.message_name == value.message_name
+                    )
                 )
             ]
             if len(self.message_subscriptions) != before:

@@ -463,6 +463,15 @@ class TpuPartitionEngine:
             )
             inst.job_key = int(ei_i64[slot, 2])
             inst.active_tokens = int(ei_i32[slot, state_mod.EI_TOKENS])
+            pending_elem = int(ei_i32[slot, state_mod.EI_PENDING_BD])
+            if pending_elem >= 0 and self.meta:
+                # in-flight interrupting-boundary continuation migrates to
+                # the oracle's _pending_boundary (ei_pay holds the trigger
+                # payload by construction)
+                self._host._pending_boundary[key] = (
+                    self.meta.element_id(wf_slot, pending_elem),
+                    dict(value.payload),
+                )
             by_slot[slot] = inst
 
         tree_keys = {int(ei_i64[sl, 0]) for sl in tree}
@@ -956,6 +965,14 @@ class TpuPartitionEngine:
                     keys=jnp.asarray(arrays[f.name + ".keys"]),
                     vals=jnp.asarray(arrays[f.name + ".vals"]),
                 )
+            elif f.name == "ei_i32" and arrays[f.name].shape[1] == 5:
+                # pre-round-4 snapshot: pad the pending-boundary column
+                kwargs[f.name] = jnp.concatenate(
+                    [jnp.asarray(arrays[f.name]),
+                     jnp.full((arrays[f.name].shape[0], 1), -1, jnp.int32)],
+                    axis=1,
+                )
+                pre_round4_arrays = True
             elif f.name in arrays:
                 kwargs[f.name] = jnp.asarray(arrays[f.name])
             else:
@@ -1340,6 +1357,18 @@ class TpuPartitionEngine:
             cols["aux_key"][i] = value.activity_instance_key
             cols["instance_key"][i] = value.workflow_instance_key
             cols["deadline"][i] = value.due_date
+            # the handler element (a boundary event or the catch element
+            # itself) re-resolves from the owning instance's workflow —
+            # TimerRecord carries no workflow reference
+            if value.handler_element_id and self.meta is not None:
+                wf_slot = self._wf_slot_of_instance(
+                    value.activity_instance_key
+                )
+                if wf_slot >= 0:
+                    cols["wf"][i] = wf_slot
+                    cols["elem"][i] = self.meta.elem_idx[wf_slot].get(
+                        value.handler_element_id, -1
+                    )
         elif vt == int(ValueType.MESSAGE):
             self._stage_corr(cols, i, value.name, value.correlation_key)
             cols["deadline"][i] = value.time_to_live
@@ -1361,6 +1390,17 @@ class TpuPartitionEngine:
             cols["instance_key"][i] = value.workflow_instance_key
             cols["aux_key"][i] = value.activity_instance_key
             self._stage_payload(cols, i, value.payload)
+
+    def _wf_slot_of_instance(self, key: int) -> int:
+        """Workflow slot of a live device element instance (host-side scan;
+        timer creates are rare control records)."""
+        if key < 0:
+            return -1
+        keys = np.asarray(self.state.ei_i64[:, 0])
+        hits = np.nonzero(keys == key)[0]
+        if not len(hits):
+            return -1
+        return int(np.asarray(self.state.ei_i32)[int(hits[0]), state_mod.EI_WF])
 
     def _stage_corr(self, cols, i, name: str, correlation_key) -> None:
         """Message-family correlation columns: type_id = interned name,

@@ -50,7 +50,47 @@ _DEVICE_ELEMENT_TYPES = {
     ElementType.SUB_PROCESS,
     ElementType.INTERMEDIATE_CATCH_EVENT,  # timer + message catch
     ElementType.RECEIVE_TASK,              # message catch (round 4)
+    ElementType.BOUNDARY_EVENT,            # on tasks (round 4)
 }
+
+# device multi-instance: cardinality-based fan-out through the emission
+# slots; larger cardinalities (or collection-driven MI — collections have
+# no columnar form) run on the host oracle
+MAX_DEVICE_MI_CARDINALITY = 16
+
+
+def _device_boundary_reason(el) -> Optional[str]:
+    """None when el's attached boundary events can run on device."""
+    from zeebe_tpu.models.bpmn.model import ElementType as ET
+
+    if not el.boundary_events:
+        return None
+    if el.element_type not in (ET.SERVICE_TASK, ET.RECEIVE_TASK):
+        return (
+            f"boundary events on {el.element_type.name} ({el.id}) — "
+            "host-only (contained-instance termination)"
+        )
+    return None
+
+
+def _device_mi_reason(el) -> Optional[str]:
+    """None when el's multi-instance shape can run on device."""
+    if not el.is_multi_instance:
+        return None
+    # mi_output_element has a default value; it only matters when an
+    # output collection is actually collected
+    if el.mi_input_collection or el.mi_output_collection:
+        return (
+            f"collection-driven multi-instance ({el.id}) — host-only "
+            "(collections have no device column form)"
+        )
+    card = el.mi_cardinality or 0
+    if not (0 < card <= MAX_DEVICE_MI_CARDINALITY):
+        return (
+            f"multi-instance cardinality {card} ({el.id}) exceeds the "
+            f"device fan-out budget ({MAX_DEVICE_MI_CARDINALITY})"
+        )
+    return None
 
 
 class VarSpace:
@@ -85,7 +125,10 @@ _DATA = [
     "join_nin", "join_pos", "job_type", "job_retries",
     "in_map_src", "in_map_dst", "in_map_n", "in_root",
     "out_map_src", "out_map_dst", "out_map_n", "out_root", "out_behavior",
-    "timer_dur", "msg_name", "corr_var", "progs", "lit_nums",
+    "timer_dur", "msg_name", "corr_var",
+    "bd_elem", "bd_timer", "bd_msg", "bd_corr", "bd_interrupt", "bd_count",
+    "bd_is_boundary", "bd_host_interrupt", "mi_cardinality",
+    "progs", "lit_nums",
 ]
 
 
@@ -94,7 +137,8 @@ _DATA = [
     data_fields=_DATA,
     meta_fields=["num_vars", "emit_width", "max_join_in", "has_conditions",
                  "has_parallel_joins", "has_timers", "has_mappings",
-                 "has_messages"],
+                 "has_messages", "has_boundaries", "has_multi_instance",
+                 "mi_loop_var"],
 )
 @dataclasses.dataclass
 class DeviceGraph:
@@ -125,6 +169,18 @@ class DeviceGraph:
     timer_dur: jax.Array             # i64, -1 = no timer
     msg_name: jax.Array              # interned message name, 0 = none
     corr_var: jax.Array              # correlation-key payload column, -1 none
+    # boundary events attached per host element (round 4: device-served
+    # for tasks; reference model BoundaryEvent.java — the reference engine
+    # never executes it)
+    bd_elem: jax.Array               # [W, E, BD] boundary element idx, -1 pad
+    bd_timer: jax.Array              # [W, E, BD] i64 duration, -1 = message
+    bd_msg: jax.Array                # [W, E, BD] interned message name
+    bd_corr: jax.Array               # [W, E, BD] correlation payload column
+    bd_interrupt: jax.Array          # [W, E, BD] bool
+    bd_count: jax.Array              # [W, E]
+    bd_is_boundary: jax.Array        # [W, E] bool: element IS a boundary event
+    bd_host_interrupt: jax.Array     # [W, E] bool: boundary elem interrupts
+    mi_cardinality: jax.Array        # [W, E] i32, 0 = not multi-instance
     progs: jax.Array                 # [P, L, 6] predicate programs
     lit_nums: jax.Array              # [Q] f32
     # static meta
@@ -140,6 +196,9 @@ class DeviceGraph:
     has_timers: bool = True
     has_mappings: bool = True
     has_messages: bool = False
+    has_boundaries: bool = False
+    has_multi_instance: bool = False
+    mi_loop_var: int = -1  # payload column of loopCounter, -1 when no MI
 
 
 @dataclasses.dataclass
@@ -200,10 +259,18 @@ def check_device_compatible(workflow: ExecutableWorkflow) -> Optional[str]:
                     varspace, el.correlation_key_path,
                     f"correlation key of {el.id}",
                 )
-            if el.is_multi_instance:
-                return f"multi-instance activity ({el.id}) — host-only in this round"
-            if el.boundary_events:
-                return f"boundary events on {el.id} — host-only in this round"
+            reason = _device_mi_reason(el)
+            if reason:
+                return reason
+            reason = _device_boundary_reason(el)
+            if reason:
+                return reason
+            for boundary in el.boundary_events:
+                if boundary.message_name:
+                    _flat_var(
+                        varspace, boundary.correlation_key_path,
+                        f"correlation key of {boundary.id}",
+                    )
             _compile_mappings(varspace, el.input_mappings, f"input mapping of {el.id}")
             _compile_mappings(varspace, el.output_mappings, f"output mapping of {el.id}")
             if el.condition is not None:
@@ -238,11 +305,15 @@ def compile_graph(
     fan = 2
     join_in = 2
     num_maps = 2
+    max_bd = 1
     for w in workflows:
         for el in w.elements:
             fan = max(fan, len(el.outgoing), len(el.outgoing_with_condition))
+            if el.is_multi_instance and not _device_mi_reason(el):
+                fan = max(fan, int(el.mi_cardinality or 0))
             join_in = max(join_in, len(el.incoming))
             num_maps = max(num_maps, len(el.input_mappings), len(el.output_mappings))
+            max_bd = max(max_bd, len(el.boundary_events))
 
     shape = (num_wf, num_elems)
     import numpy as np
@@ -273,6 +344,15 @@ def compile_graph(
     timer_dur = np.full(shape, -1, np.int64)
     msg_name = np.zeros(shape, np.int32)
     corr_var = np.full(shape, -1, np.int32)
+    bd_elem = np.full(shape + (max_bd,), -1, np.int32)
+    bd_timer = np.full(shape + (max_bd,), -1, np.int64)
+    bd_msg = np.zeros(shape + (max_bd,), np.int32)
+    bd_corr = np.full(shape + (max_bd,), -1, np.int32)
+    bd_interrupt = np.zeros(shape + (max_bd,), bool)
+    bd_count = np.zeros(shape, np.int32)
+    bd_is_boundary = np.zeros(shape, bool)
+    bd_host_interrupt = np.zeros(shape, bool)
+    mi_cardinality = np.zeros(shape, np.int32)
 
     slot_by_key: Dict[int, int] = {}
     elem_ids: List[List[str]] = []
@@ -332,12 +412,39 @@ def compile_graph(
                     varspace, el.correlation_key_path,
                     f"correlation key of {el.id}",
                 )
+            if el.element_type == ElementType.BOUNDARY_EVENT:
+                bd_is_boundary[w, e] = True
+                bd_host_interrupt[w, e] = bool(el.cancel_activity)
+            bd_count[w, e] = len(el.boundary_events)
+            for i, boundary in enumerate(el.boundary_events):
+                bd_elem[w, e, i] = boundary.index
+                if boundary.timer_duration_ms is not None:
+                    bd_timer[w, e, i] = int(boundary.timer_duration_ms)
+                if boundary.message_name:
+                    bd_msg[w, e, i] = interns.intern(boundary.message_name)
+                    bd_corr[w, e, i] = _flat_var(
+                        varspace, boundary.correlation_key_path,
+                        f"correlation key of {boundary.id}",
+                    )
+                bd_interrupt[w, e, i] = bool(boundary.cancel_activity)
+            if el.is_multi_instance and not _device_mi_reason(el):
+                mi_cardinality[w, e] = int(el.mi_cardinality or 0)
+                varspace.column("loopCounter")
 
     progs, lit_nums = pool.tensors()
     emit_width = max(2, int(out_count.max()) if workflows else 2)
-    if (msg_name > 0).any():
+    if (msg_name > 0).any() or (bd_msg > 0).any():
         # a CORRELATE arrival emits CORRELATED + ELEMENT_COMPLETING + CLOSE
         emit_width = max(emit_width, 3)
+    if (bd_count > 0).any():
+        # rows on boundary-carrying elements mirror the oracle's written
+        # order: arms/disarm-cancels (slots 0..BD-1), closes (BD..2BD-1),
+        # the row's own step output (2BD), terminate-catch re-scan cancels
+        # (2BD+1..3BD), TERMINATED (3BD+1)
+        emit_width = max(emit_width, 3 * int(bd_count.max()) + 2)
+    if (mi_cardinality > 0).any():
+        # multi-instance fan-out rides the fork slots
+        emit_width = max(emit_width, int(mi_cardinality.max()))
 
     graph = DeviceGraph(
         step_table=jnp.asarray(step_table),
@@ -366,6 +473,15 @@ def compile_graph(
         timer_dur=jnp.asarray(timer_dur),
         msg_name=jnp.asarray(msg_name),
         corr_var=jnp.asarray(corr_var),
+        bd_elem=jnp.asarray(bd_elem),
+        bd_timer=jnp.asarray(bd_timer),
+        bd_msg=jnp.asarray(bd_msg),
+        bd_corr=jnp.asarray(bd_corr),
+        bd_interrupt=jnp.asarray(bd_interrupt),
+        bd_count=jnp.asarray(bd_count),
+        bd_is_boundary=jnp.asarray(bd_is_boundary),
+        bd_host_interrupt=jnp.asarray(bd_host_interrupt),
+        mi_cardinality=jnp.asarray(mi_cardinality),
         progs=progs,
         lit_nums=lit_nums,
         num_vars=max(len(varspace), 1),
@@ -373,12 +489,18 @@ def compile_graph(
         max_join_in=join_in,
         has_conditions=bool((cond_prog >= 0).any()),
         has_parallel_joins=bool((join_nin >= 2).any()),
-        has_timers=bool((timer_dur >= 0).any()),
+        has_timers=bool((timer_dur >= 0).any() or (bd_timer >= 0).any()),
         has_mappings=bool(
             (in_map_n > 0).any() or (out_map_n > 0).any()
             or in_root.any() or out_root.any()
         ),
-        has_messages=bool((msg_name > 0).any()),
+        has_messages=bool((msg_name > 0).any() or (bd_msg > 0).any()),
+        has_boundaries=bool((bd_count > 0).any()),
+        has_multi_instance=bool((mi_cardinality > 0).any()),
+        mi_loop_var=(
+            varspace.lookup("loopCounter") if (mi_cardinality > 0).any()
+            else -1
+        ),
     )
     meta = GraphMeta(
         workflows=list(workflows),

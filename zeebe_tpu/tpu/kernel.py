@@ -23,7 +23,7 @@ Kernel phases:
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -57,7 +57,7 @@ from zeebe_tpu.tpu.state import (
     EngineState,
     corr_composite,
     pack_payload, unpack_payload,
-    EI_ELEM, EI_STATE, EI_WF, EI_SCOPE, EI_TOKENS,
+    EI_ELEM, EI_STATE, EI_WF, EI_SCOPE, EI_TOKENS, EI_PENDING_BD,
     EIL_KEY, EIL_IKEY, EIL_JOB_KEY,
     JB_STATE, JB_ELEM, JB_WF, JB_TYPE, JB_RETRIES, JB_WORKER,
     JBL_KEY, JBL_IKEY, JBL_AIK, JBL_DEADLINE,
@@ -352,6 +352,14 @@ def step_kernel(
         | (it == int(WI.GATEWAY_ACTIVATED))
         | (it == int(WI.START_EVENT_OCCURRED))
         | (it == int(WI.SEQUENCE_FLOW_TAKEN))
+        | (it == int(WI.BOUNDARY_EVENT_OCCURRED))
+    )
+    # pending interrupting-boundary continuation (the oracle's
+    # _pending_boundary dict as the instance column EI_PENDING_BD):
+    # ELEMENT_TERMINATED with a pending boundary processes while the scope
+    # stays ACTIVATED (the token moves to the boundary event)
+    pending_bd = jnp.where(
+        ei_found, state.ei_i32[ei_clip, EI_PENDING_BD], -1
     )
     guard = jnp.where(
         g_own,
@@ -361,7 +369,12 @@ def step_kernel(
             sc_found & (scope_state == int(WI.ELEMENT_ACTIVATED)),
             jnp.where(
                 it == int(WI.ELEMENT_TERMINATED),
-                sc_found & (scope_state == int(WI.ELEMENT_TERMINATING)),
+                sc_found & jnp.where(
+                    pending_bd >= 0,
+                    (scope_state == int(WI.ELEMENT_ACTIVATED))
+                    | (scope_state == int(WI.ELEMENT_TERMINATING)),
+                    scope_state == int(WI.ELEMENT_TERMINATING),
+                ),
                 jnp.where(
                     g_flow, sc_found & (scope_state == int(WI.ELEMENT_ACTIVATED)), True
                 ),
@@ -394,6 +407,10 @@ def step_kernel(
     m_pmerge = m_step(BS.PARALLEL_MERGE)
     m_timer_step = m_step(BS.CREATE_TIMER)
     m_subscribe = m_step(BS.SUBSCRIBE_TO_INTERMEDIATE_MESSAGE)
+    m_term_job = m_step(BS.TERMINATE_JOB_TASK)
+    m_term_catch = m_step(BS.TERMINATE_CATCH_EVENT)
+    m_term_elem = m_step(BS.TERMINATE_ELEMENT)
+    m_mi = m_step(BS.MULTI_INSTANCE_SPLIT)
 
     # job commands
     job_state = jnp.where(jb_found, state.job_state[jb_clip], -1)
@@ -445,6 +462,54 @@ def step_kernel(
     ttrig_inst = ttrig_ok & aik_found & (
         jnp.where(aik_found, state.ei_state[aik_clip], -1) == int(WI.ELEMENT_ACTIVATED)
     )
+    # boundary-event triggers: the timer's handler element is a BOUNDARY
+    # event attached to the instance's element (oracle _boundary_for +
+    # _fire_boundary_event); interrupting boundaries terminate the host
+    # and continue at the boundary when ELEMENT_TERMINATED processes
+    # the trigger's handler element comes from the TIMER TABLE (a
+    # host-staged TRIGGER command does not carry element columns)
+    trig_elem = jnp.where(tm_found, state.timer_elem[tm_clip], batch.elem)
+    trig_wf = jnp.where(tm_found, state.timer_wf[tm_clip], 0)
+    if graph.has_boundaries:
+        trig_elem_c = jnp.clip(trig_elem, 0, graph.elem_type.shape[1] - 1)
+        trig_wf_c = jnp.clip(trig_wf, 0, graph.elem_type.shape[0] - 1)
+        trig_is_bd = graph.bd_is_boundary[trig_wf_c, trig_elem_c]
+        ttrig_catch = ttrig_inst & ~trig_is_bd
+        ttrig_bd = ttrig_inst & trig_is_bd
+        ttrig_bd_int = ttrig_bd & graph.bd_host_interrupt[trig_wf_c, trig_elem_c]
+        ttrig_bd_non = ttrig_bd & ~graph.bd_host_interrupt[trig_wf_c, trig_elem_c]
+        # arming/disarming rides the host element's lifecycle events
+        # (oracle _arm_boundary_events / _disarm_boundary_events)
+        lifecycle_ok = (
+            wi_ev & ~m_created_ev & shall & guard
+            & (batch.wf >= 0) & (batch.elem >= 0)
+        )
+        bd_n = graph.bd_count[wf_c, el_c]
+        m_arm = lifecycle_ok & (it == int(WI.ELEMENT_ACTIVATED)) & (bd_n > 0)
+        m_disarm_bd = lifecycle_ok & (
+            (it == int(WI.ELEMENT_COMPLETING))
+            | (it == int(WI.ELEMENT_TERMINATING))
+        ) & (bd_n > 0)
+        # TERMINATE_CATCH_EVENT re-scans timers by aik (the oracle's
+        # _h_terminate_catch_event scan — a SECOND cancel for timers the
+        # disarm already canceled, since state only mutates when the
+        # commands process)
+        m_cancel_timers = m_term_catch
+        # TERMINATED with a pending boundary: continue the token at the
+        # boundary element with the stored trigger payload
+        m_bd_continue = (
+            lifecycle_ok & (it == int(WI.ELEMENT_TERMINATED)) & (pending_bd >= 0)
+        )
+    else:
+        zbb = jnp.zeros((b,), bool)
+        ttrig_catch = ttrig_inst
+        ttrig_bd = ttrig_bd_int = ttrig_bd_non = zbb
+        m_arm = m_disarm_bd = m_bd_continue = zbb
+        m_cancel_timers = m_term_catch
+        bd_n = jnp.zeros((b,), jnp.int32)
+    # rows on boundary-carrying elements re-slot their own step output
+    # AFTER the arm/disarm records (the oracle writes arms/cancels first)
+    has_bd = bd_n > 0
 
     # message correlation guards (oracle: _process_message_command /
     # _process_message_subscription / _process_wi_subscription)
@@ -472,8 +537,46 @@ def step_kernel(
             & (state.msub_i64[msub_clip, MSL_WIKEY] == batch.instance_key)
         )
         del_ok = msg_del & mmsg_found & (state.msg_key[mmsg_clip] == batch.key)
-        corr_inst_ok = wisub_corr & aik_found
-        corr_rej = wisub_corr & ~aik_found
+        corr_live = wisub_corr & aik_found & (
+            jnp.where(aik_found, state.ei_state[aik_clip], -1)
+            == int(WI.ELEMENT_ACTIVATED)
+        )
+        corr_rej = wisub_corr & ~corr_live
+        # boundary-message correlate: the message name matches one of the
+        # instance element's attached boundary events (oracle
+        # _process_wi_subscription -> _boundary_for by message name)
+        ci_elem = jnp.where(aik_found, state.ei_elem[aik_clip], 0)
+        ci_wf = jnp.where(aik_found, state.ei_wf[aik_clip], 0)
+        ci_elem_c = jnp.clip(ci_elem, 0, graph.elem_type.shape[1] - 1)
+        ci_wf_c = jnp.clip(ci_wf, 0, graph.elem_type.shape[0] - 1)
+        if graph.has_boundaries:
+            bd_cnt_i = graph.bd_count[ci_wf_c, ci_elem_c]
+            corr_bd_elem = jnp.full((b,), -1, jnp.int32)
+            corr_bd_interrupt = jnp.zeros((b,), bool)
+            for bslot in range(graph.bd_elem.shape[2]):
+                match_b = (
+                    (bslot < bd_cnt_i)
+                    & (graph.bd_msg[ci_wf_c, ci_elem_c, bslot] == batch.type_id)
+                    & (graph.bd_msg[ci_wf_c, ci_elem_c, bslot] > 0)
+                    & (corr_bd_elem < 0)
+                )
+                corr_bd_elem = jnp.where(
+                    match_b, graph.bd_elem[ci_wf_c, ci_elem_c, bslot],
+                    corr_bd_elem,
+                )
+                corr_bd_interrupt = jnp.where(
+                    match_b,
+                    graph.bd_interrupt[ci_wf_c, ci_elem_c, bslot],
+                    corr_bd_interrupt,
+                )
+            corr_is_bd = corr_live & (corr_bd_elem >= 0)
+        else:
+            corr_bd_elem = jnp.full((b,), -1, jnp.int32)
+            corr_bd_interrupt = jnp.zeros((b,), bool)
+            corr_is_bd = jnp.zeros((b,), bool)
+        corr_inst_ok = corr_live & ~corr_is_bd
+        corr_bd_int = corr_is_bd & corr_bd_interrupt
+        corr_bd_non = corr_is_bd & ~corr_bd_interrupt
         # subscribe step: correlation key extracted from the payload column.
         # Accepted types mirror the oracle's isinstance(corr, (str, int)):
         # strings, ints, and bools (a Python bool IS an int); floats raise
@@ -501,6 +604,8 @@ def step_kernel(
         pub_dup = pub_chain = pub_ok = pub_store = pub_nostore = pub_corr = zb
         open_dup = open_ok = open_corr = close_ok = del_ok = zb
         corr_inst_ok = corr_rej = sub_ok = sub_err = zb
+        corr_bd_int = corr_bd_non = corr_is_bd = zb
+        corr_bd_elem = jnp.full((b,), -1, jnp.int32)
         corr_vt_ext = jnp.zeros((b,), jnp.int32)
         corr_bits_ext = jnp.zeros((b,), jnp.int32)
 
@@ -687,8 +792,15 @@ def step_kernel(
     single_key = (
         m_create | m_take | xs_ok | m_actgw | m_startst | m_trigend
         | m_trigstart | completer | m_tcreate | pub_ok | open_ok
+        | ttrig_bd_non | m_bd_continue | corr_bd_non
     )
-    n_wf = jnp.where(single_key, 1, jnp.where(m_psplit, out_count, 0))
+    n_wf = jnp.where(
+        single_key, 1,
+        jnp.where(
+            m_psplit, out_count,
+            jnp.where(m_mi, graph.mi_cardinality[wf_c, el_c], 0),
+        ),
+    )
     wf_base = state.next_wf_key + _KEY_STEP * _excl_cumsum(n_wf).astype(jnp.int64)
     key0 = wf_base  # key for single-allocation steps
     n_job = m_jcreate.astype(jnp.int32)
@@ -769,6 +881,21 @@ def step_kernel(
 
     e0 = blank()
     e1 = blank()
+    # emission slots ≥ 2 materialize lazily (messages, boundary arm/disarm
+    # fan-out); rows claiming the same slot index always have disjoint
+    # masks — compaction keeps slot order = the oracle's append order
+    extra_slots: Dict[int, dict] = {}
+
+    def eslot(i: int) -> dict:
+        if i == 0:
+            return e0
+        if i == 1:
+            return e1
+        if i not in extra_slots:
+            extra_slots[i] = blank()
+        return extra_slots[i]
+
+    pid_col = jnp.broadcast_to(jnp.asarray(partition_id, jnp.int32), (b,))
 
     # --- slot 0: workflow-instance emissions
     scope_parent = jnp.where(
@@ -809,6 +936,24 @@ def step_kernel(
         intent=int(WI.ELEMENT_COMPLETING), key=batch.scope_key, elem=scope_elem,
         scope_key=scope_parent_key,
     )
+    if graph.has_multi_instance:
+        # a completing multi-instance container keeps ITS OWN payload (the
+        # oracle never copies iteration payloads into an MI scope)
+        sc_elem_c = jnp.clip(scope_elem, 0, graph.elem_type.shape[1] - 1)
+        sc_wf_c = jnp.clip(
+            jnp.where(sc_found, state.ei_wf[sc_clip], 0),
+            0, graph.elem_type.shape[0] - 1,
+        )
+        mi_completer = (
+            consume_completer
+            & (graph.mi_cardinality[sc_wf_c, sc_elem_c] > 0)
+        )
+        sc_vt, sc_sid, sc_num = unpack_payload(state.ei_pay[sc_clip])
+        e0["v_vt"] = jnp.where(
+            mi_completer[:, None], sc_vt.astype(jnp.int8), e0["v_vt"]
+        )
+        e0["v_num"] = jnp.where(mi_completer[:, None], sc_num, e0["v_num"])
+        e0["v_str"] = jnp.where(mi_completer[:, None], sc_sid, e0["v_str"])
     e0 = put(
         e0, xs_ok,
         valid=True, rtype=RT_EVENT, vtype=VT_WI,
@@ -821,7 +966,7 @@ def step_kernel(
         rej=jnp.where(xs_nofl, rb.ERR_CONDITION_NO_FLOW, rb.ERR_CONDITION_EVAL),
     )
     e0 = put(
-        e0, m_createjob,
+        e0, m_createjob & ~has_bd,
         valid=True, rtype=RT_CMD, vtype=VT_JOB, intent=int(JI.CREATE),
         key=jnp.int64(-1), elem=batch.elem, aux_key=batch.key,
         type_id=graph.job_type[wf_c, el_c], retries=graph.job_retries[wf_c, el_c],
@@ -835,13 +980,13 @@ def step_kernel(
     e0["v_num"] = jnp.where(inmap_ok[:, None], in_num, e0["v_num"])
     e0["v_str"] = jnp.where(inmap_ok[:, None], in_sid, e0["v_str"])
     e0 = put(
-        e0, outmap_ok,
+        e0, outmap_ok & ~has_bd,
         valid=True, rtype=RT_EVENT, vtype=VT_WI,
         intent=int(WI.ELEMENT_COMPLETED), key=batch.key, elem=batch.elem,
     )
-    e0["v_vt"] = jnp.where(outmap_ok[:, None], out_vt, e0["v_vt"])
-    e0["v_num"] = jnp.where(outmap_ok[:, None], out_num, e0["v_num"])
-    e0["v_str"] = jnp.where(outmap_ok[:, None], out_sid, e0["v_str"])
+    e0["v_vt"] = jnp.where((outmap_ok & ~has_bd)[:, None], out_vt, e0["v_vt"])
+    e0["v_num"] = jnp.where((outmap_ok & ~has_bd)[:, None], out_num, e0["v_num"])
+    e0["v_str"] = jnp.where((outmap_ok & ~has_bd)[:, None], out_sid, e0["v_str"])
     e0 = put(
         e0, inmap_err | outmap_err,
         valid=True, rtype=RT_CMD, vtype=VT_INCIDENT, intent=0,
@@ -1046,20 +1191,36 @@ def step_kernel(
     e0 = put(
         e0, ttrig_ok,
         valid=True, rtype=RT_EVENT, vtype=VT_TIMER, intent=int(TI.TRIGGERED),
-        key=batch.key, elem=batch.elem, aux_key=batch.aux_key,
+        key=batch.key, elem=trig_elem, wf=trig_wf, aux_key=batch.aux_key,
         deadline=batch.deadline,
     )
     e1 = put(
-        e1, ttrig_inst,
+        e1, ttrig_catch,
         valid=True, rtype=RT_EVENT, vtype=VT_WI,
         intent=int(WI.ELEMENT_COMPLETING), key=batch.aux_key,
         elem=inst_elem, wf=inst_wf, scope_key=inst_scope_key,
     )
-    e1["v_vt"] = jnp.where(ttrig_inst[:, None], wi_of_inst_vt, e1["v_vt"])
-    e1["v_num"] = jnp.where(ttrig_inst[:, None], wi_of_inst_num, e1["v_num"])
-    e1["v_str"] = jnp.where(ttrig_inst[:, None], wi_of_inst_sid, e1["v_str"])
+    # interrupting boundary: terminate the host (the continuation fires at
+    # ELEMENT_TERMINATED); non-interrupting: the token appears at the
+    # boundary event, the host keeps running (oracle _fire_boundary_event)
+    e1 = put(
+        e1, ttrig_bd_int,
+        valid=True, rtype=RT_EVENT, vtype=VT_WI,
+        intent=int(WI.ELEMENT_TERMINATING), key=batch.aux_key,
+        elem=inst_elem, wf=inst_wf, scope_key=inst_scope_key,
+    )
+    e1 = put(
+        e1, ttrig_bd_non,
+        valid=True, rtype=RT_EVENT, vtype=VT_WI,
+        intent=int(WI.BOUNDARY_EVENT_OCCURRED), key=key0,
+        elem=trig_elem, wf=inst_wf, scope_key=inst_scope_key,
+    )
+    ttrig_any_inst = ttrig_catch | ttrig_bd_int | ttrig_bd_non
+    e1["v_vt"] = jnp.where(ttrig_any_inst[:, None], wi_of_inst_vt, e1["v_vt"])
+    e1["v_num"] = jnp.where(ttrig_any_inst[:, None], wi_of_inst_num, e1["v_num"])
+    e1["v_str"] = jnp.where(ttrig_any_inst[:, None], wi_of_inst_sid, e1["v_str"])
     e1["instance_key"] = jnp.where(
-        ttrig_inst, state.ei_instance_key[aik_clip], e1["instance_key"]
+        ttrig_any_inst, state.ei_instance_key[aik_clip], e1["instance_key"]
     )
     e0 = put(
         e0, ttrig_rej,
@@ -1076,14 +1237,11 @@ def step_kernel(
 
     # --- message correlation emissions
     if graph.has_messages:
-        e2 = blank()
-        pid_col = jnp.broadcast_to(
-            jnp.asarray(partition_id, jnp.int32), (b,)
-        )
+        e2 = eslot(2)
         # subscribe step → OPEN sent to the message partition (oracle
         # _h_subscribe_to_message); correlation-key failure → incident
         e0 = put(
-            e0, sub_ok,
+            e0, sub_ok & ~has_bd,
             valid=True, rtype=RT_CMD, vtype=VT_MSUB, intent=int(MS.OPEN),
             key=jnp.int64(-1), elem=batch.elem,
             type_id=graph.msg_name[wf_c, el_c],
@@ -1092,7 +1250,7 @@ def step_kernel(
             wf=pid_col,
         )
         e0 = put(
-            e0, sub_err,
+            e0, sub_err & ~has_bd,
             valid=True, rtype=RT_CMD, vtype=VT_INCIDENT, intent=0,
             key=jnp.int64(-1), elem=batch.elem, aux_key=batch.key,
             rej=rb.ERR_CORRELATION_KEY,
@@ -1173,10 +1331,13 @@ def step_kernel(
             worker=batch.worker, aux2_key=batch.aux2_key,
         )
         # workflow partition: CORRELATE arrival (oracle
-        # _process_wi_subscription) — CORRELATED + instance completes with
-        # the message payload + CLOSE back to the message partition
+        # _process_wi_subscription) — CORRELATED, then either the element
+        # completes with the message payload (own catch), a boundary event
+        # fires (non-interrupting keeps the subscription open), or the
+        # host terminates (interrupting); CLOSE goes back to the message
+        # partition except for non-interrupting boundaries
         e0 = put(
-            e0, corr_inst_ok,
+            e0, corr_live,
             valid=True, rtype=RT_EVENT, vtype=VT_WISUB,
             intent=int(WS.CORRELATED), key=batch.key,
             type_id=batch.type_id, retries=batch.retries, worker=batch.worker,
@@ -1188,11 +1349,30 @@ def step_kernel(
             intent=int(WI.ELEMENT_COMPLETING), key=batch.aux_key,
             elem=inst_elem, wf=inst_wf, scope_key=inst_scope_key,
         )
+        e1 = put(
+            e1, corr_bd_non,
+            valid=True, rtype=RT_EVENT, vtype=VT_WI,
+            intent=int(WI.BOUNDARY_EVENT_OCCURRED), key=key0,
+            elem=corr_bd_elem, wf=inst_wf, scope_key=inst_scope_key,
+        )
+        e1 = put(
+            e1, corr_bd_int,
+            valid=True, rtype=RT_EVENT, vtype=VT_WI,
+            intent=int(WI.ELEMENT_TERMINATING), key=batch.aux_key,
+            elem=inst_elem, wf=inst_wf, scope_key=inst_scope_key,
+        )
+        # interrupting-boundary TERMINATING carries the INSTANCE payload
+        # (oracle terminates with host_value); completion and boundary
+        # firing carry the MESSAGE payload (batch defaults)
+        e1["v_vt"] = jnp.where(corr_bd_int[:, None], wi_of_inst_vt, e1["v_vt"])
+        e1["v_num"] = jnp.where(corr_bd_int[:, None], wi_of_inst_num, e1["v_num"])
+        e1["v_str"] = jnp.where(corr_bd_int[:, None], wi_of_inst_sid, e1["v_str"])
+        corr_any_inst = corr_inst_ok | corr_bd_non | corr_bd_int
         e1["instance_key"] = jnp.where(
-            corr_inst_ok, state.ei_instance_key[aik_clip], e1["instance_key"]
+            corr_any_inst, state.ei_instance_key[aik_clip], e1["instance_key"]
         )
         e2 = put(
-            e2, corr_inst_ok,
+            e2, corr_inst_ok | corr_bd_int,
             valid=True, rtype=RT_CMD, vtype=VT_MSUB, intent=int(MS.CLOSE),
             key=jnp.int64(-1), wf=pid_col,
             type_id=batch.type_id, retries=batch.retries, worker=batch.worker,
@@ -1206,19 +1386,229 @@ def step_kernel(
             rej=rb.REJ_SUB_NOT_ACTIVE,
             req=batch.req, req_stream=batch.req_stream, resp=batch.req >= 0,
         )
-    else:
-        e2 = None
+    # --- boundary events: arm / disarm / terminate / continue.
+    # Slot plan for rows on boundary-carrying elements (written order
+    # mirrors the oracle: arms/cancels BEFORE the row's own step output):
+    #   slots 0..BD-1   arm records (ACTIVATED) / timer cancels (disarm)
+    #   slots BD..2BD-1 subscription closes (disarm; sends)
+    #   slot 2BD        the row's own step output (job CREATE / OPEN /
+    #                   COMPLETED / job CANCEL / own CLOSE)
+    #   slot 2BD+1      ELEMENT_TERMINATED (terminating rows)
+    if graph.has_boundaries:
+        bdw = graph.bd_elem.shape[2]
+        step_slot = eslot(2 * bdw)
+        t_iota = jnp.arange(t_cap, dtype=jnp.int32)
+        # disarm scan: this instance's armed timers by activityInstanceKey
+        # (oracle _disarm_boundary_events' self.timers scan)
+        cancel_mask = (
+            m_disarm_bd[:, None]
+            & (state.timer_key >= 0)[None, :]
+            & (state.timer_aik[None, :] == batch.key[:, None])
+        )
+        for bslot in range(bdw):
+            arm_b = m_arm & (bslot < bd_n)
+            b_elem = graph.bd_elem[wf_c, el_c, bslot]
+            b_tdur = graph.bd_timer[wf_c, el_c, bslot]
+            b_mname = graph.bd_msg[wf_c, el_c, bslot]
+            b_cvar = graph.bd_corr[wf_c, el_c, bslot]
+            es = eslot(bslot)
+            # timer boundary arm (oracle writes TimerIntent.CREATE)
+            es = put(
+                es, arm_b & (b_tdur >= 0),
+                valid=True, rtype=RT_CMD, vtype=VT_TIMER, intent=int(TI.CREATE),
+                key=jnp.int64(-1), elem=b_elem, aux_key=batch.key,
+                deadline=now + jnp.maximum(b_tdur, 0),
+            )
+            # message boundary arm: correlation key from this row's payload
+            b_cvar_c = jnp.clip(b_cvar, 0, v - 1)
+            b_cvt = batch.v_vt[rows, b_cvar_c].astype(jnp.int32)
+            b_cbits = jnp.where(
+                b_cvt == int(COND_VT_STR),
+                batch.v_str[rows, b_cvar_c],
+                jax.lax.bitcast_convert_type(
+                    batch.v_num[rows, b_cvar_c], jnp.int32
+                ),
+            )
+            b_extractable = (b_cvar >= 0) & (
+                (b_cvt == int(COND_VT_STR))
+                | (b_cvt == int(COND_VT_NUM))
+                | (b_cvt == int(COND_VT_BOOL))
+            )
+            es = put(
+                es, arm_b & (b_mname > 0) & b_extractable,
+                valid=True, rtype=RT_CMD, vtype=VT_MSUB, intent=int(MS.OPEN),
+                key=jnp.int64(-1), elem=b_elem, type_id=b_mname,
+                retries=b_cvt, worker=b_cbits,
+                instance_key=batch.instance_key, aux_key=batch.key,
+                wf=pid_col,
+            )
+            es = put(
+                es, arm_b & (b_mname > 0) & ~b_extractable,
+                valid=True, rtype=RT_CMD, vtype=VT_INCIDENT, intent=0,
+                key=jnp.int64(-1), elem=b_elem, aux_key=batch.key,
+                rej=rb.ERR_CORRELATION_KEY,
+            )
+            # disarm: bslot-th armed timer cancel
+            c_idx = jnp.min(
+                jnp.where(cancel_mask, t_iota[None, :], t_cap), axis=1
+            ).astype(jnp.int32)
+            c_found = c_idx < t_cap
+            c_clipd = jnp.clip(c_idx, 0, t_cap - 1)
+            es = put(
+                es, c_found,
+                valid=True, rtype=RT_CMD, vtype=VT_TIMER, intent=int(TI.CANCEL),
+                key=state.timer_key[c_clipd], elem=state.timer_elem[c_clipd],
+                aux_key=batch.key, deadline=state.timer_due[c_clipd],
+                instance_key=state.timer_instance_key[c_clipd],
+            )
+            cancel_mask = cancel_mask & (t_iota[None, :] != c_clipd[:, None])
+            # disarm: message-boundary subscription closes (sends)
+            es2 = eslot(bdw + bslot)
+            es2 = put(
+                es2, m_disarm_bd & (bslot < bd_n) & (b_mname > 0) & b_extractable,
+                valid=True, rtype=RT_CMD, vtype=VT_MSUB, intent=int(MS.CLOSE),
+                key=jnp.int64(-1), type_id=b_mname,
+                retries=b_cvt, worker=b_cbits,
+                instance_key=batch.instance_key, aux_key=batch.key,
+                wf=pid_col,
+            )
+
+        # re-slotted step outputs for boundary-carrying rows
+        step_slot = put(
+            step_slot, m_createjob & has_bd,
+            valid=True, rtype=RT_CMD, vtype=VT_JOB, intent=int(JI.CREATE),
+            key=jnp.int64(-1), elem=batch.elem, aux_key=batch.key,
+            type_id=graph.job_type[wf_c, el_c],
+            retries=graph.job_retries[wf_c, el_c],
+        )
+        step_slot = put(
+            step_slot, outmap_ok & has_bd,
+            valid=True, rtype=RT_EVENT, vtype=VT_WI,
+            intent=int(WI.ELEMENT_COMPLETED), key=batch.key, elem=batch.elem,
+        )
+        step_slot["v_vt"] = jnp.where(
+            (outmap_ok & has_bd)[:, None], out_vt, step_slot["v_vt"]
+        )
+        step_slot["v_num"] = jnp.where(
+            (outmap_ok & has_bd)[:, None], out_num, step_slot["v_num"]
+        )
+        step_slot["v_str"] = jnp.where(
+            (outmap_ok & has_bd)[:, None], out_sid, step_slot["v_str"]
+        )
+        if graph.has_messages:
+            step_slot = put(
+                step_slot, sub_ok & has_bd,
+                valid=True, rtype=RT_CMD, vtype=VT_MSUB, intent=int(MS.OPEN),
+                key=jnp.int64(-1), elem=batch.elem,
+                type_id=graph.msg_name[wf_c, el_c],
+                retries=corr_vt_ext, worker=corr_bits_ext,
+                instance_key=batch.instance_key, aux_key=batch.key,
+                wf=pid_col,
+            )
+            step_slot = put(
+                step_slot, sub_err & has_bd,
+                valid=True, rtype=RT_CMD, vtype=VT_INCIDENT, intent=0,
+                key=jnp.int64(-1), elem=batch.elem, aux_key=batch.key,
+                rej=rb.ERR_CORRELATION_KEY,
+            )
+            # TERMINATE_CATCH_EVENT: close the element's own subscription
+            step_slot = put(
+                step_slot,
+                m_term_catch & (graph.msg_name[wf_c, el_c] > 0)
+                & corr_extractable,
+                valid=True, rtype=RT_CMD, vtype=VT_MSUB, intent=int(MS.CLOSE),
+                key=jnp.int64(-1), type_id=graph.msg_name[wf_c, el_c],
+                retries=corr_vt_ext, worker=corr_bits_ext,
+                instance_key=batch.instance_key, aux_key=batch.key,
+                wf=pid_col,
+            )
+        # TERMINATE_JOB_TASK: cancel the instance's job, then TERMINATED
+        job_key_inst = jnp.where(ei_found, state.ei_job_key[ei_clip], -1)
+        tj_found, tj_slot = pops.lookup(
+            state.job_map, job_key_inst, m_term_job & (job_key_inst > 0)
+        )
+        tj_clip = jnp.clip(tj_slot, 0, m_cap - 1)
+        mask_jcancel = m_term_job & (job_key_inst > 0)
+        step_slot = put(
+            step_slot, mask_jcancel,
+            valid=True, rtype=RT_CMD, vtype=VT_JOB, intent=int(JI.CANCEL),
+            key=job_key_inst, elem=batch.elem, aux_key=batch.key,
+            type_id=jnp.where(tj_found, state.job_type[tj_clip], 0),
+            retries=jnp.int32(-1),  # JobRecord default — oracle sends a
+            # bare record: type + headers only, no payload
+        )
+        step_slot["v_vt"] = jnp.where(
+            mask_jcancel[:, None], jnp.int8(0), step_slot["v_vt"]
+        )
+        step_slot["v_num"] = jnp.where(
+            mask_jcancel[:, None], jnp.float32(0), step_slot["v_num"]
+        )
+        step_slot["v_str"] = jnp.where(
+            mask_jcancel[:, None], jnp.int32(0), step_slot["v_str"]
+        )
+        # TERMINATE_CATCH_EVENT's own timer scan (slots 2BD+1..3BD): the
+        # oracle writes these cancels between the step output and
+        # TERMINATED; a timer both disarmed and terminate-scanned cancels
+        # TWICE, exactly like the oracle's two passes over self.timers
+        tc_mask = (
+            m_cancel_timers[:, None]
+            & (state.timer_key >= 0)[None, :]
+            & (state.timer_aik[None, :] == batch.key[:, None])
+        )
+        for t in range(bdw):
+            tc_idx = jnp.min(
+                jnp.where(tc_mask, t_iota[None, :], t_cap), axis=1
+            ).astype(jnp.int32)
+            tc_found = tc_idx < t_cap
+            tc_clipd = jnp.clip(tc_idx, 0, t_cap - 1)
+            es3 = eslot(2 * bdw + 1 + t)
+            es3 = put(
+                es3, tc_found,
+                valid=True, rtype=RT_CMD, vtype=VT_TIMER, intent=int(TI.CANCEL),
+                key=state.timer_key[tc_clipd], elem=state.timer_elem[tc_clipd],
+                aux_key=batch.key, deadline=state.timer_due[tc_clipd],
+                instance_key=state.timer_instance_key[tc_clipd],
+            )
+            tc_mask = tc_mask & (t_iota[None, :] != tc_clipd[:, None])
+
+        term_tail = eslot(3 * bdw + 1)
+        term_tail = put(
+            term_tail, m_term_job | m_term_catch,
+            valid=True, rtype=RT_EVENT, vtype=VT_WI,
+            intent=int(WI.ELEMENT_TERMINATED), key=batch.key, elem=batch.elem,
+        )
+        e0 = put(
+            e0, m_term_elem,
+            valid=True, rtype=RT_EVENT, vtype=VT_WI,
+            intent=int(WI.ELEMENT_TERMINATED), key=batch.key, elem=batch.elem,
+        )
+        # ELEMENT_TERMINATED with a pending boundary: the token continues
+        # at the boundary event with the stored trigger payload
+        cont_vt, cont_sid, cont_num = unpack_payload(state.ei_pay[ei_clip])
+        e0 = put(
+            e0, m_bd_continue,
+            valid=True, rtype=RT_EVENT, vtype=VT_WI,
+            intent=int(WI.BOUNDARY_EVENT_OCCURRED), key=key0,
+            elem=pending_bd,
+        )
+        e0["v_vt"] = jnp.where(
+            m_bd_continue[:, None], cont_vt.astype(jnp.int8), e0["v_vt"]
+        )
+        e0["v_num"] = jnp.where(m_bd_continue[:, None], cont_num, e0["v_num"])
+        e0["v_str"] = jnp.where(m_bd_continue[:, None], cont_sid, e0["v_str"])
 
     # jev_completed payload = job payload (record payload already in columns)
     # (value defaults carry batch payload, which is the job's — correct)
 
-    # --- fork slots (parallel split) + assemble [B, E]
+    # --- fork slots (parallel split + multi-instance) + assemble [B, E]
     em = {}
-    slots = [e0, e1] + ([e2] if e2 is not None else [])
     for name in e0:
-        parts = [e[name] for e in slots]
-        stack = parts + [jnp.zeros_like(parts[0]) for _ in range(e_w - len(parts))]
-        em[name] = jnp.stack(stack, axis=1)  # [B, E] or [B, E, V]
+        parts = [e0[name], e1[name]] + [
+            extra_slots[i][name] if i in extra_slots
+            else jnp.zeros_like(e0[name])
+            for i in range(2, e_w)
+        ]
+        em[name] = jnp.stack(parts, axis=1)  # [B, E] or [B, E, V]
 
     fork_flows = graph.out_flows[wf_c, el_c]  # [B, F<=E]
     fan_out = fork_flows.shape[1]
@@ -1250,6 +1640,53 @@ def step_kernel(
             )
         em["src"] = em["src"].at[:, f].set(rows)
 
+    if graph.has_multi_instance:
+        # multi-instance fan-out (oracle _h_multi_instance_split,
+        # cardinality form): one body token per iteration, each carrying
+        # loopCounter = i+1; the container completes when the last body
+        # token is consumed (token counting, same as the parallel join)
+        mi_card = graph.mi_cardinality[wf_c, el_c]
+        lv = graph.mi_loop_var
+        for f in range(e_w):  # emit_width covers the max cardinality
+            mask_f = m_mi & (f < mi_card)
+            em["valid"] = em["valid"].at[:, f].set(
+                jnp.where(mask_f, True, em["valid"][:, f])
+            )
+            for name, val in (
+                ("rtype", RT_EVENT), ("vtype", VT_WI),
+                ("intent", int(WI.START_EVENT_OCCURRED)),
+            ):
+                em[name] = em[name].at[:, f].set(
+                    jnp.where(mask_f, val, em[name][:, f])
+                )
+            em["key"] = em["key"].at[:, f].set(
+                jnp.where(mask_f, wf_base + _KEY_STEP * f, em["key"][:, f])
+            )
+            em["elem"] = em["elem"].at[:, f].set(
+                jnp.where(mask_f, start_ev, em["elem"][:, f])
+            )
+            em["wf"] = em["wf"].at[:, f].set(
+                jnp.where(mask_f, batch.wf, em["wf"][:, f])
+            )
+            em["instance_key"] = em["instance_key"].at[:, f].set(
+                jnp.where(mask_f, batch.instance_key, em["instance_key"][:, f])
+            )
+            em["scope_key"] = em["scope_key"].at[:, f].set(
+                jnp.where(mask_f, batch.key, em["scope_key"][:, f])
+            )
+            mi_vt = batch.v_vt.at[:, lv].set(jnp.int8(COND_VT_NUM))
+            mi_num = batch.v_num.at[:, lv].set(jnp.float32(f + 1))
+            em["v_vt"] = em["v_vt"].at[:, f].set(
+                jnp.where(mask_f[:, None], mi_vt, em["v_vt"][:, f])
+            )
+            em["v_num"] = em["v_num"].at[:, f].set(
+                jnp.where(mask_f[:, None], mi_num, em["v_num"][:, f])
+            )
+            em["v_str"] = em["v_str"].at[:, f].set(
+                jnp.where(mask_f[:, None], batch.v_str, em["v_str"][:, f])
+            )
+            em["src"] = em["src"].at[:, f].set(rows)
+
     # ---------------- state scatters ----------------
     # token counters
     tok_delta = jnp.zeros((n_cap,), jnp.int32)
@@ -1263,16 +1700,46 @@ def step_kernel(
     tok_delta = pops.masked_lane_accum(
         tok_delta, sc_clip, completer, -(nin_rec - 1)
     )
+    if graph.has_boundaries:
+        # non-interrupting boundary fire: the host's scope gains a token
+        # for the boundary path (oracle: scope.active_tokens += 1)
+        tok_delta = pops.masked_lane_accum(
+            tok_delta, jnp.clip(inst_scope_slot, 0, n_cap - 1),
+            ttrig_bd_non | corr_bd_non, jnp.ones((b,), jnp.int32),
+        )
     ei_i32_arr = state.ei_i32.at[:, EI_TOKENS].add(tok_delta)
     ei_i32_arr = _col_update(ei_i32_arr, ei_clip, m_trigstart, EI_TOKENS, 1)
+    if graph.has_multi_instance:
+        # the container holds one token per body iteration
+        ei_i32_arr = _col_update(
+            ei_i32_arr, ei_clip, m_mi, EI_TOKENS,
+            graph.mi_cardinality[wf_c, el_c],
+        )
 
     # i64 columns operate on the planes view until the end of the phase
     # (TPU i64 is emulated; the pallas kernels take i32 planes)
     ei_i64_pl = pops.i64_to_planes(state.ei_i64)
 
-    # scope payload on consume (oracle: scope value.payload = record payload)
+    # scope payload on consume (oracle: scope value.payload = record
+    # payload — EXCEPT multi-instance containers, whose iteration-local
+    # variables must not leak into the container payload)
     b_pay = pack_payload(batch.v_vt, batch.v_str, batch.v_num)
-    ei_pay = _scatter_pay(state.ei_pay, sc_clip, m_consume, b_pay, n_cap)
+    if graph.has_multi_instance:
+        scope_elem_c = jnp.clip(
+            jnp.where(sc_found, state.ei_elem[sc_clip], 0),
+            0, graph.elem_type.shape[1] - 1,
+        )
+        scope_wf_c = jnp.clip(
+            jnp.where(sc_found, state.ei_wf[sc_clip], 0),
+            0, graph.elem_type.shape[0] - 1,
+        )
+        mi_scope = graph.mi_cardinality[scope_wf_c, scope_elem_c] > 0
+        ei_pay = _scatter_pay(
+            state.ei_pay, sc_clip, m_consume & ~mi_scope, b_pay, n_cap
+        )
+    else:
+        mi_scope = jnp.zeros((b,), bool)
+        ei_pay = _scatter_pay(state.ei_pay, sc_clip, m_consume, b_pay, n_cap)
     # scope state transition by consume completer
     ei_i32_arr = _col_update(
         ei_i32_arr, sc_clip, consume_completer, EI_STATE,
@@ -1297,13 +1764,34 @@ def step_kernel(
     ei_i64_pl = _col64_update(
         ei_i64_pl, aik_clip, jev_created & aik_found, EIL_JOB_KEY, batch.key
     )
-    # timer trigger → instance completing
+    # timer trigger → instance completing (catch events only; boundary
+    # triggers take the terminate/continue path below)
     ei_i32_arr = _col_update(
-        ei_i32_arr, aik_clip, ttrig_inst, EI_STATE, int(WI.ELEMENT_COMPLETING)
+        ei_i32_arr, aik_clip, ttrig_catch, EI_STATE, int(WI.ELEMENT_COMPLETING)
     )
 
+    if graph.has_boundaries:
+        # interrupting boundary trigger: host → TERMINATING with the
+        # pending boundary element recorded (the oracle's _pending_boundary)
+        bd_int_any = ttrig_bd_int | corr_bd_int
+        ei_i32_arr = _cols_update(
+            ei_i32_arr, aik_clip, bd_int_any,
+            (EI_STATE, EI_PENDING_BD),
+            (int(WI.ELEMENT_TERMINATING),
+             jnp.where(ttrig_bd_int, trig_elem, corr_bd_elem)),
+        )
+        # message-boundary interruption stores the MESSAGE payload as the
+        # pending continuation payload (timer boundaries continue with the
+        # instance payload, already in ei_pay)
+        ei_pay = _scatter_pay(ei_pay, aik_clip, corr_bd_int, b_pay, n_cap)
+        # TERMINATING step processed → TERMINATED written, state advances
+        term_all = m_term_job | m_term_catch | m_term_elem
+        ei_i32_arr = _col_update(
+            ei_i32_arr, ei_clip, term_all, EI_STATE, int(WI.ELEMENT_TERMINATED)
+        )
+
     # removals (final states written this round)
-    ei_remove = outmap_ok | m_complete_proc
+    ei_remove = outmap_ok | m_complete_proc | m_bd_continue
     ei_i32_arr = _col_update(ei_i32_arr, ei_clip, ei_remove, EI_STATE, -1)
     ei_i64_pl = _col64_update(
         ei_i64_pl, ei_clip, ei_remove, EIL_KEY, jnp.int64(-1)
@@ -1328,7 +1816,8 @@ def step_kernel(
     ei_i32_rows = jnp.stack(
         [ins_elem,
          jnp.full((b,), int(WI.ELEMENT_READY), jnp.int32),
-         batch.wf, ins_parent, jnp.zeros((b,), jnp.int32)], axis=-1,
+         batch.wf, ins_parent, jnp.zeros((b,), jnp.int32),
+         jnp.full((b,), -1, jnp.int32)], axis=-1,  # no pending boundary
     )
     ei_i32_arr = pops.masked_row_update(ei_i32_arr, ins_slot, ins, ei_i32_rows)
     ei_i64_rows = jnp.stack(
@@ -1696,8 +2185,12 @@ def tick_kernel(state: EngineState, now) -> Tuple[RecordBatch, jax.Array]:
     out = RecordBatch(
         valid=sel,
         rtype=jnp.full((size,), RT_CMD, jnp.int32),
-        vtype=jnp.where(is_timer, VT_TIMER, VT_JOB),
-        intent=jnp.where(is_timer, int(TI.TRIGGER), int(JI.TIME_OUT)),
+        vtype=jnp.where(
+            is_timer, jnp.int32(VT_TIMER), jnp.int32(VT_JOB)
+        ),
+        intent=jnp.where(
+            is_timer, jnp.int32(int(TI.TRIGGER)), jnp.int32(int(JI.TIME_OUT))
+        ),
         key=keys[order],
         elem=jnp.where(is_timer, state.timer_elem[tidx], state.job_elem[jidx]),
         wf=jnp.where(is_timer, state.timer_wf[tidx], state.job_wf[jidx]),

@@ -34,6 +34,10 @@ from zeebe_tpu.tpu import hashmap
 # [cap, K] matrix so inserts/updates touching many fields are ONE row
 # scatter instead of one scatter fusion per field
 EI_ELEM, EI_STATE, EI_WF, EI_SCOPE, EI_TOKENS = 0, 1, 2, 3, 4
+# pending interrupting-boundary continuation: the boundary element whose
+# BOUNDARY_EVENT_OCCURRED fires when this instance's ELEMENT_TERMINATED
+# processes (-1 none) — the oracle's _pending_boundary dict as a column
+EI_PENDING_BD = 5
 EIL_KEY, EIL_IKEY, EIL_JOB_KEY = 0, 1, 2
 JB_STATE, JB_ELEM, JB_WF, JB_TYPE, JB_RETRIES, JB_WORKER = 0, 1, 2, 3, 4, 5
 JBL_KEY, JBL_IKEY, JBL_AIK, JBL_DEADLINE = 0, 1, 2, 3
@@ -118,8 +122,9 @@ def unpack_payload(pay):
 class EngineState:
     # element instances [N] (ElementInstanceIndex analogue), packed:
     # ei_i32 cols = (elem, lifecycle state[-1 free], wf slot, scope slot,
-    # token count); ei_i64 cols = (key[-1 free], workflowInstanceKey, jobKey)
-    ei_i32: jax.Array          # [N, 5] i32
+    # token count, pending boundary elem[-1 none]);
+    # ei_i64 cols = (key[-1 free], workflowInstanceKey, jobKey)
+    ei_i32: jax.Array          # [N, 6] i32
     ei_i64: jax.Array          # [N, 3] i64
     ei_pay: jax.Array          # [N, 3V] i32 packed payload (vt | sid | f32 bits)
     ei_map: hashmap.HashTable  # key → slot
@@ -256,8 +261,8 @@ def make_state(
     i64, i32 = jnp.int64, jnp.int32
 
     return EngineState(
-        # ei_i32: elem=0, state=-1, wf=0, scope=-1, tokens=0
-        ei_i32=jnp.tile(jnp.array([[0, -1, 0, -1, 0]], i32), (n, 1)),
+        # ei_i32: elem=0, state=-1, wf=0, scope=-1, tokens=0, pending_bd=-1
+        ei_i32=jnp.tile(jnp.array([[0, -1, 0, -1, 0, -1]], i32), (n, 1)),
         ei_i64=jnp.full((n, 3), -1, i64),
         ei_pay=jnp.zeros((n, 3 * v), i32),
         ei_map=hashmap.make(_pow2(8 * n)),
