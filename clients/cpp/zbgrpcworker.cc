@@ -1,0 +1,496 @@
+// zbgrpcworker — C++ worker over the PUBLISHED gRPC gateway contract.
+//
+// Reference parity: the reference's second-language client is a Go worker
+// over gRPC (clients/go/client.go:16-38). This is the equivalent for this
+// framework: a zero-dependency C++17 client of gateway-protocol/
+// gateway.proto that deploys a workflow, creates instances, consumes the
+// ActivateJobs server stream, and completes each job — touching ONLY the
+// gRPC gateway, never the native broker protocol (zbclient.cc covers
+// that).
+//
+// Implemented from the open wire contracts, not from any gRPC library:
+//   - HTTP/2 framing (RFC 7540): connection preface, SETTINGS exchange,
+//     HEADERS with a minimal HPACK *encoder* (static-table indexing +
+//     literal-never-indexed strings; response header blocks are skipped —
+//     gRPC signals data on DATA frames, errors on RST_STREAM/GOAWAY),
+//     DATA, PING ack, WINDOW_UPDATE bookkeeping.
+//   - gRPC message framing: 5-byte prefix (compressed flag + u32 length).
+//   - protobuf wire format (varint / length-delimited fields) for the
+//     handful of gateway messages, hand-encoded.
+//   - msgpack for the payload documents the gateway forwards opaquely.
+//
+// Usage:
+//   zbgrpcworker <host> <port> run-order-process <process.bpmn> [n]
+//
+// Build: make -C clients/cpp   (g++ -std=c++17, no dependencies)
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <map>
+#include <set>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace zbg {
+
+// ---------------------------------------------------------------------------
+// byte buffer helpers
+// ---------------------------------------------------------------------------
+
+using Bytes = std::string;
+
+static void put_u24(Bytes& b, uint32_t v) {
+  b.push_back(char((v >> 16) & 0xff));
+  b.push_back(char((v >> 8) & 0xff));
+  b.push_back(char(v & 0xff));
+}
+static void put_u32(Bytes& b, uint32_t v) {
+  b.push_back(char((v >> 24) & 0xff));
+  b.push_back(char((v >> 16) & 0xff));
+  b.push_back(char((v >> 8) & 0xff));
+  b.push_back(char(v & 0xff));
+}
+static uint32_t get_u24(const uint8_t* p) {
+  return (uint32_t(p[0]) << 16) | (uint32_t(p[1]) << 8) | p[2];
+}
+static uint32_t get_u32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | p[3];
+}
+
+// ---------------------------------------------------------------------------
+// protobuf wire format (hand-encoded: the gateway messages only use
+// varint and length-delimited fields)
+// ---------------------------------------------------------------------------
+
+static void pb_varint(Bytes& out, uint64_t v) {
+  while (v >= 0x80) {
+    out.push_back(char((v & 0x7f) | 0x80));
+    v >>= 7;
+  }
+  out.push_back(char(v));
+}
+static void pb_tag(Bytes& out, int field, int wire) {
+  pb_varint(out, uint64_t(field) << 3 | wire);
+}
+static void pb_int(Bytes& out, int field, int64_t v) {
+  if (v == 0) return;  // proto3 default omitted
+  pb_tag(out, field, 0);
+  pb_varint(out, uint64_t(v));
+}
+static void pb_str(Bytes& out, int field, const Bytes& s) {
+  if (s.empty()) return;
+  pb_tag(out, field, 2);
+  pb_varint(out, s.size());
+  out += s;
+}
+
+struct PbReader {
+  const uint8_t* p;
+  const uint8_t* end;
+  explicit PbReader(const Bytes& b)
+      : p(reinterpret_cast<const uint8_t*>(b.data())),
+        end(p + b.size()) {}
+  bool done() const { return p >= end; }
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end) {
+      uint8_t byte = *p++;
+      v |= uint64_t(byte & 0x7f) << shift;
+      if (!(byte & 0x80)) return v;
+      shift += 7;
+    }
+    throw std::runtime_error("pb: truncated varint");
+  }
+  // returns field number, leaves value ready; wire type out-param
+  int next(int& wire) {
+    uint64_t tag = varint();
+    wire = int(tag & 7);
+    return int(tag >> 3);
+  }
+  Bytes bytes() {
+    uint64_t n = varint();
+    if (p + n > end) throw std::runtime_error("pb: truncated bytes");
+    Bytes out(reinterpret_cast<const char*>(p), size_t(n));
+    p += n;
+    return out;
+  }
+  void skip(int wire) {
+    if (wire == 0) {
+      varint();
+    } else if (wire == 2) {
+      bytes();
+    } else if (wire == 5) {
+      p += 4;
+    } else if (wire == 1) {
+      p += 8;
+    } else {
+      throw std::runtime_error("pb: unsupported wire type");
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// msgpack (payload documents; string keys, scalar values)
+// ---------------------------------------------------------------------------
+
+static void mp_str(Bytes& out, const Bytes& s) {
+  if (s.size() < 32) {
+    out.push_back(char(0xa0 | s.size()));
+  } else {
+    out.push_back(char(0xd9));
+    out.push_back(char(s.size()));
+  }
+  out += s;
+}
+static Bytes mp_map_int(const std::map<Bytes, int64_t>& doc) {
+  Bytes out;
+  out.push_back(char(0x80 | doc.size()));
+  for (const auto& kv : doc) {
+    mp_str(out, kv.first);
+    int64_t v = kv.second;
+    if (v >= 0 && v < 128) {
+      out.push_back(char(v));
+    } else {
+      out.push_back(char(0xd3));
+      for (int i = 7; i >= 0; --i) out.push_back(char((uint64_t(v) >> (8 * i)) & 0xff));
+    }
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// HTTP/2 client (the subset a gRPC client needs)
+// ---------------------------------------------------------------------------
+
+class Http2Conn {
+ public:
+  Http2Conn(const std::string& host, int port) : authority_(host + ":" + std::to_string(port)) {
+    struct addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    if (getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res) != 0)
+      throw std::runtime_error("resolve failed: " + host);
+    fd_ = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd_ < 0 || connect(fd_, res->ai_addr, res->ai_addrlen) != 0) {
+      freeaddrinfo(res);
+      throw std::runtime_error("connect failed: " + authority_);
+    }
+    freeaddrinfo(res);
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, 1 /*TCP_NODELAY*/, &one, sizeof(one));
+    // connection preface + our SETTINGS (defaults are fine)
+    send_raw("PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n");
+    send_frame(0x4 /*SETTINGS*/, 0, 0, "");
+  }
+  ~Http2Conn() {
+    if (fd_ >= 0) close(fd_);
+  }
+
+  // one gRPC call: returns the next stream id to read responses from
+  int start_call(const std::string& path, const Bytes& message) {
+    int sid = next_stream_;
+    next_stream_ += 2;
+    send_frame(0x1 /*HEADERS*/, 0x4 /*END_HEADERS*/, sid, hpack_request(path));
+    Bytes data;
+    data.push_back('\0');  // uncompressed
+    put_u32(data, uint32_t(message.size()));
+    data += message;
+    send_frame(0x0 /*DATA*/, 0x1 /*END_STREAM*/, sid, data);
+    return sid;
+  }
+
+  // next complete gRPC message on `sid` (drives the connection: handles
+  // SETTINGS/PING/WINDOW_UPDATE, skips header blocks, acks flow control).
+  // Returns false when the stream ended without another message.
+  bool next_message(int sid, Bytes& out) {
+    for (;;) {
+      auto& q = messages_[sid];
+      if (!q.empty()) {
+        out = q.front();
+        q.erase(q.begin());
+        return true;
+      }
+      if (closed_.count(sid)) return false;
+      pump();
+    }
+  }
+
+ private:
+  void send_raw(const Bytes& b) {
+    const char* p = b.data();
+    size_t n = b.size();
+    while (n) {
+      ssize_t w = ::send(fd_, p, n, 0);
+      if (w <= 0) throw std::runtime_error("send failed");
+      p += w;
+      n -= size_t(w);
+    }
+  }
+  void send_frame(uint8_t type, uint8_t flags, int sid, const Bytes& payload) {
+    Bytes f;
+    put_u24(f, uint32_t(payload.size()));
+    f.push_back(char(type));
+    f.push_back(char(flags));
+    put_u32(f, uint32_t(sid));
+    f += payload;
+    send_raw(f);
+  }
+
+  // HPACK: static-table indexing where possible, literal-never-indexed
+  // (0x10) strings elsewhere; no huffman, no dynamic table entries
+  static void hp_string(Bytes& out, const Bytes& s) {
+    if (s.size() < 127) {
+      out.push_back(char(s.size()));  // H=0, 7-bit length
+    } else {
+      out.push_back(char(127));
+      pb_varint(out, s.size() - 127);  // same varint continuation scheme
+    }
+    out += s;
+  }
+  static void hp_literal(Bytes& out, const Bytes& name, const Bytes& value) {
+    out.push_back(char(0x10));  // literal never-indexed, new name
+    hp_string(out, name);
+    hp_string(out, value);
+  }
+  Bytes hpack_request(const std::string& path) const {
+    Bytes h;
+    h.push_back(char(0x83));  // :method POST   (static 3)
+    h.push_back(char(0x86));  // :scheme http   (static 6)
+    h.push_back(char(0x04));  // :path, literal value, name index 4
+    hp_string(h, path);
+    h.push_back(char(0x01));  // :authority, literal value, name index 1
+    hp_string(h, authority_);
+    hp_literal(h, "content-type", "application/grpc+proto");
+    hp_literal(h, "te", "trailers");
+    return h;
+  }
+
+  void read_exact(uint8_t* dst, size_t n) {
+    while (n) {
+      ssize_t r = ::recv(fd_, dst, n, 0);
+      if (r <= 0) throw std::runtime_error("connection closed by gateway");
+      dst += r;
+      n -= size_t(r);
+    }
+  }
+
+  void pump() {
+    uint8_t head[9];
+    read_exact(head, 9);
+    uint32_t len = get_u24(head);
+    uint8_t type = head[3], flags = head[4];
+    uint32_t sid = get_u32(head + 5) & 0x7fffffff;
+    Bytes payload(len, '\0');
+    if (len) read_exact(reinterpret_cast<uint8_t*>(&payload[0]), len);
+
+    switch (type) {
+      case 0x0: {  // DATA → gRPC messages
+        partial_[sid] += payload;
+        auto& buf = partial_[sid];
+        while (buf.size() >= 5) {
+          uint32_t mlen = get_u32(reinterpret_cast<const uint8_t*>(buf.data()) + 1);
+          if (buf.size() < 5 + mlen) break;
+          messages_[sid].push_back(buf.substr(5, mlen));
+          buf.erase(0, 5 + mlen);
+        }
+        // return the received bytes to both flow-control windows
+        if (len) {
+          Bytes wu;
+          put_u32(wu, len);
+          send_frame(0x8 /*WINDOW_UPDATE*/, 0, 0, wu);
+          if (!(flags & 0x1)) {
+            Bytes wus;
+            put_u32(wus, len);
+            send_frame(0x8, 0, int(sid), wus);
+          }
+        }
+        if (flags & 0x1) closed_.insert(sid);
+        break;
+      }
+      case 0x1:  // HEADERS — initial or trailers; block content skipped
+        if (flags & 0x1) closed_.insert(sid);
+        break;
+      case 0x3:  // RST_STREAM
+        closed_.insert(sid);
+        throw std::runtime_error("stream reset by gateway (grpc error)");
+      case 0x4:  // SETTINGS
+        if (!(flags & 0x1)) send_frame(0x4, 0x1 /*ACK*/, 0, "");
+        break;
+      case 0x6:  // PING
+        if (!(flags & 0x1)) send_frame(0x6, 0x1, 0, payload);
+        break;
+      case 0x7:  // GOAWAY
+        throw std::runtime_error("gateway sent GOAWAY");
+      default:
+        break;  // WINDOW_UPDATE / PRIORITY / CONTINUATION(ignored) …
+    }
+  }
+
+  std::string authority_;
+  int fd_ = -1;
+  int next_stream_ = 1;
+  std::map<uint32_t, Bytes> partial_;
+  std::map<uint32_t, std::vector<Bytes>> messages_;
+  std::set<uint32_t> closed_;
+};
+
+// ---------------------------------------------------------------------------
+// gateway calls
+// ---------------------------------------------------------------------------
+
+
+static const char* kService = "/gateway_protocol.Gateway";
+
+static Bytes unary(Http2Conn& conn, const std::string& method, const Bytes& req) {
+  int sid = conn.start_call(std::string(kService) + "/" + method, req);
+  Bytes rsp;
+  if (!conn.next_message(sid, rsp))
+    throw std::runtime_error(method + ": no response message");
+  return rsp;
+}
+
+struct ActivatedJob {
+  int32_t partition_id = 0;
+  int64_t key = 0;
+  Bytes type;
+  Bytes payload_msgpack;
+  Bytes bpmn_process_id;
+  Bytes activity_id;
+  int64_t workflow_instance_key = 0;
+};
+
+static ActivatedJob parse_job(const Bytes& msg) {
+  ActivatedJob job;
+  PbReader r(msg);
+  while (!r.done()) {
+    int wire;
+    int field = r.next(wire);
+    switch (field) {
+      case 1: job.partition_id = int32_t(r.varint()); break;
+      case 2: job.key = int64_t(r.varint()); break;
+      case 3: job.type = r.bytes(); break;
+      case 7: job.payload_msgpack = r.bytes(); break;
+      case 8: job.bpmn_process_id = r.bytes(); break;
+      case 9: job.activity_id = r.bytes(); break;
+      case 10: job.workflow_instance_key = int64_t(r.varint()); break;
+      default: r.skip(wire);
+    }
+  }
+  return job;
+}
+
+static int run_order_process(const std::string& host, int port,
+                             const std::string& bpmn_path, int n_instances) {
+  std::ifstream f(bpmn_path, std::ios::binary);
+  if (!f) {
+    std::cerr << "cannot read " << bpmn_path << "\n";
+    return 2;
+  }
+  std::stringstream ss;
+  ss << f.rdbuf();
+  Bytes bpmn = ss.str();
+
+  Http2Conn conn(host, port);
+
+  // DeployWorkflow{resource_name=1, resource=2}
+  Bytes deploy;
+  pb_str(deploy, 1, "order.bpmn");
+  pb_str(deploy, 2, bpmn);
+  Bytes drsp = unary(conn, "DeployWorkflow", deploy);
+  {
+    PbReader r(drsp);
+    bool have_wf = false;
+    while (!r.done()) {
+      int wire;
+      int field = r.next(wire);
+      if (field == 2 && wire == 2) {
+        have_wf = true;
+        r.skip(wire);
+      } else {
+        r.skip(wire);
+      }
+    }
+    if (!have_wf) throw std::runtime_error("deploy returned no workflows");
+  }
+  std::cout << "deployed order-process over gRPC\n";
+
+  // CreateWorkflowInstance{bpmn_process_id=1, partition_id=2, payload=3}
+  for (int i = 0; i < n_instances; ++i) {
+    Bytes create;
+    pb_str(create, 1, "order-process");
+    pb_str(create, 3, mp_map_int({{"orderId", i}, {"orderValue", 99}}));
+    Bytes crsp = unary(conn, "CreateWorkflowInstance", create);
+    PbReader r(crsp);
+    int64_t ikey = 0;
+    while (!r.done()) {
+      int wire;
+      int field = r.next(wire);
+      if (field == 1) ikey = int64_t(r.varint());
+      else r.skip(wire);
+    }
+    std::cout << "created instance " << ikey << "\n";
+  }
+
+  // ActivateJobs{type=1, worker=2, max_jobs=3} — server stream
+  Bytes act;
+  pb_str(act, 1, "payment-service");
+  pb_str(act, 2, "zbgrpcworker");
+  pb_int(act, 3, 16);
+  int stream_sid = conn.start_call(std::string(kService) + "/ActivateJobs", act);
+
+  int completed = 0;
+  while (completed < n_instances) {
+    Bytes msg;
+    if (!conn.next_message(stream_sid, msg))
+      throw std::runtime_error("job stream ended early");
+    ActivatedJob job = parse_job(msg);
+    std::cout << "job " << job.key << " (" << job.type << ", "
+              << job.activity_id << ")\n";
+    // CompleteJob{partition_id=1, job_key=2, payload=3}
+    Bytes complete;
+    pb_int(complete, 1, job.partition_id);
+    pb_int(complete, 2, job.key);
+    pb_str(complete, 3, mp_map_int({{"paid", 1}}));
+    unary(conn, "CompleteJob", complete);
+    ++completed;
+    std::cout << "completed " << completed << "/" << n_instances << "\n";
+  }
+  std::cout << "OK run-order-process grpc completed=" << completed << "\n";
+  return 0;
+}
+
+}  // namespace zbg
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    std::cerr << "usage: zbgrpcworker <host> <port> run-order-process "
+                 "<process.bpmn> [n]\n";
+    return 2;
+  }
+  std::string host = argv[1];
+  int port = std::stoi(argv[2]);
+  std::string cmd = argv[3];
+  try {
+    if (cmd == "run-order-process") {
+      int n = argc > 5 ? std::stoi(argv[5]) : 1;
+      return zbg::run_order_process(host, port, argv[4], n);
+    }
+    std::cerr << "unknown command: " << cmd << "\n";
+    return 2;
+  } catch (const std::exception& e) {
+    std::cerr << "error: " << e.what() << "\n";
+    return 1;
+  }
+}

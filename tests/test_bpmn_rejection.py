@@ -1,0 +1,129 @@
+"""Deployment rejection surface for unsupported BPMN 2.0 constructs.
+
+Reference: ``broker-core/.../workflow/model/validation/`` — a resource the
+engine cannot execute rejects at deploy with the element id and a reason;
+silently dropping an element would run a different process than the one
+modeled. The executable subset and the rejection behavior are documented
+in ``docs/reference/bpmn-workflows.md``.
+"""
+
+import os
+
+import pytest
+
+from zeebe_tpu.gateway import ZeebeClient
+from zeebe_tpu.gateway.client import ClientException
+from zeebe_tpu.protocol.records import DeploymentResource
+from zeebe_tpu.models.bpmn.xml import UnsupportedBpmnElement, read_model
+from zeebe_tpu.runtime import Broker, ControlledClock
+
+REF_SAMPLES = "/root/reference/samples/src/main/resources"
+REF_QA = "/root/reference/qa/integration-tests/src/test/resources/workflows"
+REF_GATEWAY = "/root/reference/gateway/src/test/resources/workflows"
+
+
+def _deploy(xml: bytes):
+    broker = Broker(num_partitions=1, clock=ControlledClock())
+    try:
+        client = ZeebeClient(broker)
+        return client.deploy_resources([
+            DeploymentResource(
+                resource=xml, resource_type="BPMN_XML",
+                resource_name="wf.bpmn",
+            )
+        ])
+    finally:
+        broker.close()
+
+
+UNSUPPORTED = """<?xml version="1.0" encoding="UTF-8"?>
+<bpmn:definitions xmlns:bpmn="http://www.omg.org/spec/BPMN/20100524/MODEL">
+  <bpmn:process id="p" isExecutable="true">
+    <bpmn:startEvent id="s"/>
+    <bpmn:{tag} id="bad-{tag}"/>
+    <bpmn:endEvent id="e"/>
+    <bpmn:sequenceFlow id="f1" sourceRef="s" targetRef="bad-{tag}"/>
+    <bpmn:sequenceFlow id="f2" sourceRef="bad-{tag}" targetRef="e"/>
+  </bpmn:process>
+</bpmn:definitions>
+"""
+
+
+class TestUnsupportedElementRejection:
+    @pytest.mark.parametrize("tag", [
+        "userTask", "scriptTask", "callActivity", "businessRuleTask",
+        "eventBasedGateway", "inclusiveGateway", "intermediateThrowEvent",
+        "manualTask", "sendTask", "transaction",
+    ])
+    def test_reader_raises_with_element_id(self, tag):
+        xml = UNSUPPORTED.format(tag=tag)
+        with pytest.raises(UnsupportedBpmnElement) as e:
+            read_model(xml)
+        assert tag in str(e.value)
+        assert f"bad-{tag}" in str(e.value)
+        assert "supported elements" in str(e.value)
+
+    def test_deployment_rejects_with_diagnostic(self):
+        xml = UNSUPPORTED.format(tag="callActivity").encode()
+        with pytest.raises(ClientException) as e:
+            _deploy(xml)
+        assert "callActivity" in str(e.value)
+        assert "bad-callActivity" in str(e.value)
+
+    def test_non_executable_content_still_parses(self):
+        xml = """<?xml version="1.0"?>
+<bpmn:definitions xmlns:bpmn="http://www.omg.org/spec/BPMN/20100524/MODEL">
+  <bpmn:process id="p" isExecutable="true">
+    <bpmn:documentation>docs are fine</bpmn:documentation>
+    <bpmn:extensionElements/>
+    <bpmn:laneSet id="lanes"/>
+    <bpmn:textAnnotation id="note"/>
+    <bpmn:association id="assoc"/>
+    <bpmn:dataObject id="data"/>
+    <bpmn:startEvent id="s"/>
+    <bpmn:endEvent id="e"/>
+    <bpmn:sequenceFlow id="f" sourceRef="s" targetRef="e"/>
+  </bpmn:process>
+</bpmn:definitions>"""
+        model = read_model(xml)
+        assert "p" in model.elements
+
+
+class TestReferenceCorpus:
+    """The reference's own sample/test BPMN files within the executable
+    subset must parse and deploy."""
+
+    @pytest.mark.parametrize("path", [
+        os.path.join(REF_SAMPLES, "demoProcess.bpmn"),
+        os.path.join(REF_QA, "one-task-process.bpmn"),
+    ])
+    def test_reference_sample_parses_and_deploys(self, path):
+        if not os.path.exists(path):
+            pytest.skip(f"reference file missing: {path}")
+        with open(path, "rb") as f:
+            xml = f.read()
+        model = read_model(xml)
+        assert model.processes
+        deployed = _deploy(xml)
+        assert deployed is not None
+
+    def test_non_executable_process_parses(self):
+        path = os.path.join(REF_QA, "nonExecutableProcess.bpmn")
+        if not os.path.exists(path):
+            pytest.skip("reference file missing")
+        with open(path, "rb") as f:
+            model = read_model(f.read())
+        assert model.processes
+
+    def test_abstract_task_rejects_like_the_reference_broker(self):
+        """The gateway test resource uses a bare <bpmn:task> — an element
+        the 2018 reference broker's transformer does not execute either;
+        deployment rejects with the element id."""
+        path = os.path.join(REF_GATEWAY, "one-task-process.bpmn")
+        if not os.path.exists(path):
+            pytest.skip("reference file missing")
+        with open(path, "rb") as f:
+            xml = f.read()
+        with pytest.raises(ClientException) as e:
+            _deploy(xml)
+        assert "task" in str(e.value)

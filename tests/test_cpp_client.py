@@ -100,3 +100,45 @@ class TestCppClient:
             )
 
         assert wait_until(completed, 15)
+
+
+GRPC_WORKER_BIN = os.path.join(CLIENT_DIR, "zbgrpcworker")
+
+
+class TestGrpcCppWorker:
+    """The gRPC-speaking external worker (clients/cpp/zbgrpcworker.cc):
+    hand-rolled HTTP/2 + protobuf wire format against the PUBLISHED
+    gateway.proto — deploys, creates instances, consumes the ActivateJobs
+    stream, completes every job, touching ONLY the gRPC gateway
+    (reference: clients/go/client.go:16-38)."""
+
+    def test_worker_runs_order_process_via_gateway_only(
+        self, client_bin, broker, tmp_path
+    ):
+        from zeebe_tpu.gateway.cluster_client import ClusterClient
+        from zeebe_tpu.gateway.grpc_gateway import GrpcGateway
+
+        client = ClusterClient([broker.client_address])
+        gw = GrpcGateway(client)
+        try:
+            bpmn = tmp_path / "order.bpmn"
+            bpmn.write_bytes(write_model(
+                Bpmn.create_process("order-process")
+                .start_event("start")
+                .service_task("collect-money", type="payment-service")
+                .end_event("end")
+                .done()
+            ))
+            proc = subprocess.run(
+                [GRPC_WORKER_BIN, "127.0.0.1", str(gw.port),
+                 "run-order-process", str(bpmn), "3"],
+                capture_output=True, text=True, timeout=60,
+            )
+            assert proc.returncode == 0, (proc.stdout, proc.stderr)
+            assert "OK run-order-process grpc completed=3" in proc.stdout
+            # all three instances completed on the broker
+            engine = broker.partitions[0].engine
+            assert wait_until(lambda: not engine.element_instances.instances, 10)
+        finally:
+            gw.close()
+            client.close()

@@ -133,3 +133,83 @@ class TestGrpcGateway:
         assert err.value.code() in (
             grpc.StatusCode.FAILED_PRECONDITION, grpc.StatusCode.INTERNAL,
         )
+
+
+class TestActivateJobsStream:
+    """The polyglot worker surface: jobs stream over gRPC, the worker
+    completes them through CompleteJob — no native-protocol connection
+    involved (reference: clients/go/client.go:16-38)."""
+
+    def test_worker_completes_job_through_gateway_only(self, gateway):
+        stub, broker = gateway
+        stub.call(
+            "DeployWorkflow",
+            pb.DeployWorkflowRequest(resource=order_process_bytes()),
+        )
+        created = stub.call(
+            "CreateWorkflowInstance",
+            pb.CreateWorkflowInstanceRequest(
+                bpmn_process_id="order-process",
+                payload_msgpack=msgpack.pack({"orderId": 11}),
+                partition_id=0,
+            ),
+        )
+        instance_key = created.workflow_instance_key
+
+        stream = stub.activate_jobs(
+            pb.ActivateJobsRequest(
+                type="payment-service", worker="ext-worker", max_jobs=4
+            )
+        )
+        job = next(iter(stream))
+        assert job.type == "payment-service"
+        assert job.bpmn_process_id == "order-process"
+        assert job.activity_id == "collect-money"
+        assert job.workflow_instance_key == instance_key
+        assert msgpack.unpack(job.payload_msgpack) == {"orderId": 11}
+
+        stub.call(
+            "CompleteJob",
+            pb.CompleteJobRequest(
+                partition_id=job.partition_id, job_key=job.key,
+                payload_msgpack=msgpack.pack({"paid": True}),
+            ),
+        )
+        engine = broker.partitions[0].engine
+        assert wait_until(
+            lambda: engine.element_instances.get(instance_key) is None, 10
+        ), "instance must complete via the gRPC-only worker"
+        stream.cancel()
+
+    def test_stream_delivers_multiple_jobs(self, gateway):
+        stub, broker = gateway
+        stub.call(
+            "DeployWorkflow",
+            pb.DeployWorkflowRequest(resource=order_process_bytes()),
+        )
+        for i in range(3):
+            stub.call(
+                "CreateWorkflowInstance",
+                pb.CreateWorkflowInstanceRequest(
+                    bpmn_process_id="order-process",
+                    payload_msgpack=msgpack.pack({"orderId": i}),
+                    partition_id=0,
+                ),
+            )
+        stream = stub.activate_jobs(
+            pb.ActivateJobsRequest(type="payment-service", max_jobs=8)
+        )
+        it = iter(stream)
+        seen = set()
+        for _ in range(3):
+            job = next(it)
+            seen.add(msgpack.unpack(job.payload_msgpack)["orderId"])
+            stub.call(
+                "CompleteJob",
+                pb.CompleteJobRequest(
+                    partition_id=job.partition_id, job_key=job.key,
+                    payload_msgpack=msgpack.pack({}),
+                ),
+            )
+        assert seen == {0, 1, 2}
+        stream.cancel()

@@ -10,6 +10,7 @@ push records down their own connection (``JobSubscriber`` with credits).
 from __future__ import annotations
 
 import itertools
+import logging
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -35,6 +36,8 @@ from zeebe_tpu.protocol.records import (
     WorkflowInstanceRecord,
 )
 from zeebe_tpu.transport import ClientTransport, RemoteAddress, TransportError
+
+logger = logging.getLogger(__name__)
 
 _subscriber_keys = itertools.count(1_000)
 
@@ -268,7 +271,10 @@ class ClusterClient:
                 continue
             try:
                 record, _ = codec.decode_record(bytes(msg["frame"]))
-                handler(int(msg.get("partition", 0)), record)
+                handler(
+                    int(msg.get("partition", 0)), record,
+                    int(msg.get("epoch", -1)),
+                )
             except Exception:  # noqa: BLE001
                 import traceback
 
@@ -285,6 +291,23 @@ class ClusterClient:
     ) -> "RemoteJobWorker":
         return RemoteJobWorker(
             self, job_type, handler, worker_name, credits, timeout_ms,
+            partitions if partitions is not None else list(range(self.num_partitions)),
+        )
+
+    def open_job_stream(
+        self,
+        job_type: str,
+        worker_name: str = "stream-worker",
+        credits: int = 32,
+        timeout_ms: int = 300_000,
+        partitions: Optional[List[int]] = None,
+    ) -> "RemoteJobStream":
+        """A push stream of ACTIVATED jobs WITHOUT auto-completion — the
+        consumer completes/fails each job explicitly (the gateway's
+        ActivateJobs RPC rides this; reference: an external worker over
+        clients/go consumes the equivalent subscription)."""
+        return RemoteJobStream(
+            self, job_type, worker_name, credits, timeout_ms,
             partitions if partitions is not None else list(range(self.num_partitions)),
         )
 
@@ -363,32 +386,41 @@ class ClusterClient:
         self.transport.close()
 
 
-class RemoteJobWorker:
-    """Wire-level worker: subscribes on each partition leader, handles
-    pushes, completes jobs, replenishes credits (reference JobSubscriber)."""
+class _JobSubscriptionBase:
+    """Shared job-subscription plumbing: subscribe on each partition
+    leader, reopen on leader change, return credits robustly (owed
+    credits retry from the monitor when the leader is transiently
+    unknown), tear down on close. Subclasses deliver pushed jobs."""
 
-    def __init__(self, client, job_type, handler, worker_name, credits, timeout_ms, partitions):
+    _MONITOR_NAME = "zb-jobsub-monitor"
+
+    def __init__(self, client, job_type, worker_name, credits, timeout_ms,
+                 partitions):
         self.client = client
         self.job_type = job_type
-        self.handler = handler
         self.worker_name = worker_name
         self.credits = credits
         self.timeout_ms = timeout_ms
-        self.subscriber_key = next(_subscriber_keys)
         self.partitions = partitions
-        self.handled: List[Record] = []
+        self.subscriber_key = next(_subscriber_keys)
         self._subscribed_addr: Dict[int, RemoteAddress] = {}
+        self._owed_credits: Dict[int, int] = {}
+        self._owed_lock = threading.Lock()
         self._closed = False
         client._push_handlers[self.subscriber_key] = self._on_record
         for pid in partitions:
-            self._subscribe(pid, worker_name, credits, timeout_ms)
+            self._subscribe(pid)
         # reference: the client's subscription manager reopens subscriptions
         # when a partition's leader changes (topology listener); without
         # this a failover strands the worker on the old leader
         self._monitor = threading.Thread(
-            target=self._monitor_leaders, name="zb-worker-monitor", daemon=True
+            target=self._monitor_leaders, name=self._MONITOR_NAME, daemon=True
         )
         self._monitor.start()
+
+    # subclasses override
+    def _on_record(self, partition: int, record: Record, epoch: int = -1) -> None:
+        raise NotImplementedError
 
     def _monitor_leaders(self) -> None:
         while not self._closed and not self.client._closing:
@@ -403,13 +435,17 @@ class RemoteJobWorker:
                     continue
                 if self._subscribed_addr.get(pid) != addr:
                     try:
-                        self._subscribe(
-                            pid, self.worker_name, self.credits, self.timeout_ms
-                        )
+                        self._subscribe(pid)
+                        # a fresh "add" resets the server-side credit
+                        # budget — owed credits are covered
+                        with self._owed_lock:
+                            self._owed_credits.pop(pid, None)
                     except TransportError:
                         pass  # retried next tick
+                else:
+                    self._flush_owed(pid, addr)
 
-    def _subscribe(self, partition: int, worker_name: str, credits: int, timeout_ms: int) -> None:
+    def _subscribe(self, partition: int) -> None:
         request = msgpack.pack(
             {
                 "t": "job-subscription",
@@ -417,9 +453,9 @@ class RemoteJobWorker:
                 "partition": partition,
                 "subscriber_key": self.subscriber_key,
                 "job_type": self.job_type,
-                "worker": worker_name,
-                "credits": credits,
-                "timeout": timeout_ms,
+                "worker": self.worker_name,
+                "credits": self.credits,
+                "timeout": self.timeout_ms,
             }
         )
         deadline = time.monotonic() + 10
@@ -429,7 +465,9 @@ class RemoteJobWorker:
                 time.sleep(0.05)
                 continue
             try:
-                payload = self.client.transport.send_request(addr, request, timeout_ms=2000).join(5)
+                payload = self.client.transport.send_request(
+                    addr, request, timeout_ms=2000
+                ).join(5)
                 if msgpack.unpack(payload).get("t") == "ok":
                     self._subscribed_addr[partition] = addr
                     return
@@ -440,7 +478,81 @@ class RemoteJobWorker:
             time.sleep(0.05)
         raise TransportError(f"could not subscribe on partition {partition}")
 
-    def _on_record(self, partition: int, record: Record) -> None:
+    def _return_credit(self, partition: int, n: int = 1) -> None:
+        """Return consumed credits; a transiently-unknown leader (or a
+        failed send) OWES the credits, flushed by the monitor — silently
+        dropping them starved the subscription one credit at a time."""
+        addr = self.client._leader_for(partition)
+        if addr is not None and self._send_credits(partition, addr, n):
+            return
+        with self._owed_lock:
+            self._owed_credits[partition] = (
+                self._owed_credits.get(partition, 0) + n
+            )
+
+    def _flush_owed(self, partition: int, addr: RemoteAddress) -> None:
+        with self._owed_lock:
+            owed = self._owed_credits.pop(partition, 0)
+        if owed and not self._send_credits(partition, addr, owed):
+            with self._owed_lock:
+                self._owed_credits[partition] = (
+                    self._owed_credits.get(partition, 0) + owed
+                )
+
+    def _send_credits(self, partition: int, addr: RemoteAddress, n: int) -> bool:
+        try:
+            payload = self.client.transport.send_request(
+                addr,
+                msgpack.pack(
+                    {
+                        "t": "job-subscription",
+                        "action": "credits",
+                        "partition": partition,
+                        "subscriber_key": self.subscriber_key,
+                        "credits": n,
+                    }
+                ),
+                timeout_ms=2000,
+            ).join(3)
+            return msgpack.unpack(payload).get("t") == "ok"
+        except (TransportError, ValueError, TimeoutError):
+            return False
+
+    def close(self) -> None:
+        self._closed = True
+        self.client._push_handlers.pop(self.subscriber_key, None)
+        for pid, addr in list(self._subscribed_addr.items()):
+            try:
+                self.client.transport.send_request(
+                    addr,
+                    msgpack.pack(
+                        {
+                            "t": "job-subscription",
+                            "action": "remove",
+                            "partition": pid,
+                            "subscriber_key": self.subscriber_key,
+                        }
+                    ),
+                    timeout_ms=1000,
+                )
+            except TransportError:
+                pass
+
+
+class RemoteJobWorker(_JobSubscriptionBase):
+    """Wire-level worker: subscribes on each partition leader, handles
+    pushes, completes jobs, replenishes credits (reference JobSubscriber)."""
+
+    _MONITOR_NAME = "zb-worker-monitor"
+
+    def __init__(self, client, job_type, handler, worker_name, credits, timeout_ms, partitions):
+        self.handler = handler
+        self.handled: List[Record] = []
+        super().__init__(
+            client, job_type, worker_name, credits, timeout_ms, partitions
+        )
+
+    def _on_record(self, partition: int, record: Record, epoch: int = -1) -> None:
         self.handled.append(record)
         try:
             try:
@@ -470,45 +582,7 @@ class RemoteJobWorker:
                 # re-activates; this worker keeps its credit flowing
                 pass
         finally:
-            self._replenish(partition)
-
-    def _replenish(self, partition: int) -> None:
-        # replenish the consumed credit
-        addr = self.client._leader_for(partition)
-        if addr is not None:
-            self.client.transport.send_request(
-                addr,
-                msgpack.pack(
-                    {
-                        "t": "job-subscription",
-                        "action": "credits",
-                        "partition": partition,
-                        "subscriber_key": self.subscriber_key,
-                        "credits": 1,
-                    }
-                ),
-                timeout_ms=2000,
-            )
-
-    def close(self) -> None:
-        self._closed = True
-        self.client._push_handlers.pop(self.subscriber_key, None)
-        for pid, addr in list(self._subscribed_addr.items()):
-            try:
-                self.client.transport.send_request(
-                    addr,
-                    msgpack.pack(
-                        {
-                            "t": "job-subscription",
-                            "action": "remove",
-                            "partition": pid,
-                            "subscriber_key": self.subscriber_key,
-                        }
-                    ),
-                    timeout_ms=1000,
-                )
-            except TransportError:
-                pass
+            self._return_credit(partition)
 
 
 def _correlation_hash(key: str) -> int:
@@ -536,6 +610,12 @@ class RemoteTopicSubscriber:
         self._ack_batch = ack_batch or max(credits // 2, 1)
         self._since_ack = 0
         self._subscribed_addr: Optional[RemoteAddress] = None
+        # subscription epoch: bumped on every (re)open; pushes echo it so
+        # in-flight records from a superseded pusher (old leader, old
+        # connection) can never interleave with the new stream — the
+        # round-4 failover flake was exactly two pushers' TCP streams
+        # arriving out of order
+        self._epoch = 0
         self._closed = False
         client._push_handlers[self.subscriber_key] = self._on_record
         self._open(force_start=force_start)
@@ -563,6 +643,12 @@ class RemoteTopicSubscriber:
 
     def _open(self, force_start: bool = False) -> None:
         deadline = time.monotonic() + 10
+        # optimistic epoch bump (the new pusher's records may arrive
+        # before the open response) with ROLLBACK on failure: a failed
+        # reopen attempt against an unchanged leader must not deafen the
+        # still-live old-epoch pusher
+        prev_epoch = self._epoch
+        self._epoch = prev_epoch + 1
         body = {
             "t": "topic-subscription",
             "action": "open",
@@ -572,11 +658,13 @@ class RemoteTopicSubscriber:
             "start_position": -1 if self.start_position is None else self.start_position,
             "credits": self.credits,
             "force_start": force_start,
+            "epoch": self._epoch,
         }
         while time.monotonic() < deadline and not self._closed:
             if self._request(body):
                 return
             time.sleep(0.05)
+        self._epoch = prev_epoch
         if not self._closed:
             raise TransportError(f"could not open topic subscription {self.name!r}")
 
@@ -590,13 +678,56 @@ class RemoteTopicSubscriber:
             except Exception:  # noqa: BLE001
                 continue
             addr = leaders.get(self.partition_id)
-            if addr is not None and addr != self._subscribed_addr and not self._closed:
+            if addr is None or self._closed:
+                continue
+            if addr != self._subscribed_addr:
+                logger.debug(
+                    "topic sub %r: leader %s != subscribed %s, reopening",
+                    self.name, addr, self._subscribed_addr,
+                )
                 try:
                     self._open()
                 except TransportError:
-                    pass
+                    logger.debug("topic sub %r: reopen failed", self.name)
+                continue
+            # same leader address: verify the pusher survived leadership
+            # churn (pushers are leader-local server-side; a flap through
+            # the SAME broker clears them without an address change) and
+            # that it carries OUR epoch (a lost open response leaves the
+            # server one epoch ahead)
+            if not self._check_alive(addr):
+                logger.debug(
+                    "topic sub %r: pusher lost on %s, reopening",
+                    self.name, addr,
+                )
+                try:
+                    self._open()
+                except TransportError:
+                    logger.debug("topic sub %r: reopen failed", self.name)
 
-    def _on_record(self, partition_id: int, record: Record) -> None:
+    def _check_alive(self, addr: RemoteAddress) -> bool:
+        try:
+            payload = self.client.transport.send_request(
+                addr,
+                msgpack.pack({
+                    "t": "topic-subscription",
+                    "action": "check",
+                    "partition": self.partition_id,
+                    "subscriber_key": self.subscriber_key,
+                    "name": self.name,
+                }),
+                timeout_ms=2000,
+            ).join(3)
+            rsp = msgpack.unpack(payload)
+        except (TransportError, ValueError, TimeoutError):
+            return True  # inconclusive: don't churn the subscription
+        if rsp.get("t") != "ok":
+            return True  # e.g. NOT_LEADER mid-transition: topology follows
+        return bool(rsp.get("known")) and int(rsp.get("epoch", -1)) == self._epoch
+
+    def _on_record(self, partition_id: int, record: Record, epoch: int = -1) -> None:
+        if 0 <= epoch != self._epoch:
+            return  # superseded pusher's in-flight tail
         self.records.append(record)
         if self.handler is not None:
             self.handler(partition_id, record)
@@ -631,3 +762,38 @@ class RemoteTopicSubscriber:
             },
             timeout_s=1.0,
         )
+
+
+class RemoteJobStream(_JobSubscriptionBase):
+    """Wire-level job stream: subscribes on each partition leader and
+    queues activated-job pushes for explicit consumption — no automatic
+    completion (``RemoteJobWorker`` is the auto-completing variant). One
+    credit returns per consumed job; the broker's in-flight bound is
+    ``credits``. Reopens on leader change like the worker."""
+
+    _MONITOR_NAME = "zb-stream-monitor"
+
+    def __init__(self, client, job_type, worker_name, credits, timeout_ms,
+                 partitions):
+        import queue as _queue
+
+        self.jobs: "_queue.Queue" = _queue.Queue()
+        super().__init__(
+            client, job_type, worker_name, credits, timeout_ms, partitions
+        )
+
+    def _on_record(self, partition: int, record: Record, epoch: int = -1) -> None:
+        self.jobs.put((partition, record))
+
+    def take(self, timeout: Optional[float] = None):
+        """Next (partition, job record), or None on timeout. Returns one
+        credit to the partition (the consumer now owns the in-flight
+        job)."""
+        import queue as _queue
+
+        try:
+            partition, record = self.jobs.get(timeout=timeout)
+        except _queue.Empty:
+            return None
+        self._return_credit(partition)
+        return partition, record

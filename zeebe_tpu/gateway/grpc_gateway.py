@@ -14,6 +14,7 @@ opaquely like ``ClientApiMessageHandler`` does.
 
 from __future__ import annotations
 
+import threading
 from concurrent import futures
 
 import grpc
@@ -40,8 +41,15 @@ class GrpcGateway:
     the published gateway.proto."""
 
     def __init__(self, client, host: str = "127.0.0.1", port: int = 0,
-                 max_workers: int = 8):
+                 max_workers: int = 16, max_streams: int = 0):
         self.client = client
+        # each ActivateJobs stream occupies one executor thread for its
+        # lifetime; cap streams BELOW the pool size so unary RPCs (incl.
+        # the workers' own CompleteJob calls) always have threads —
+        # uncapped streams livelocked the whole gateway
+        self._max_streams = max_streams or max(1, max_workers // 2)
+        self._active_streams = 0
+        self._stream_lock = threading.Lock()
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
         rpcs = {
             "HealthCheck": (self._health_check, pb.HealthCheckRequest),
@@ -66,6 +74,11 @@ class GrpcGateway:
             )
             for name, (fn, req_cls) in rpcs.items()
         }
+        handlers["ActivateJobs"] = grpc.unary_stream_rpc_method_handler(
+            self._activate_jobs,
+            request_deserializer=pb.ActivateJobsRequest.FromString,
+            response_serializer=lambda m: m.SerializeToString(),
+        )
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
         )
@@ -174,6 +187,55 @@ class GrpcGateway:
         )
         return pb.UpdateJobRetriesResponse()
 
+    def _activate_jobs(self, req, context: grpc.ServicerContext):
+        """Server stream of activated jobs (reference: the polyglot worker
+        surface — clients/go/client.go:16-38 consumes the equivalent
+        subscription; later reference versions expose this exact RPC). The
+        gateway holds the broker job subscription; the caller completes or
+        fails each job via CompleteJob / FailJob and ends the stream by
+        cancelling the call."""
+        if not req.type:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "type is required")
+        with self._stream_lock:
+            if self._active_streams >= self._max_streams:
+                context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    f"gateway serves at most {self._max_streams} concurrent "
+                    "job streams; close one or raise max_workers",
+                )
+            self._active_streams += 1
+        stream = self.client.open_job_stream(
+            req.type,
+            worker_name=req.worker or "grpc-worker",
+            credits=req.max_jobs or 32,
+            timeout_ms=req.timeout_ms or 300_000,
+        )
+        try:
+            while context.is_active():
+                item = stream.take(timeout=0.2)
+                if item is None:
+                    continue
+                partition, record = item
+                value = record.value
+                headers = value.headers
+                yield pb.ActivatedJob(
+                    partition_id=partition,
+                    key=record.key,
+                    type=value.type,
+                    retries=value.retries,
+                    deadline=value.deadline,
+                    worker=value.worker,
+                    payload_msgpack=msgpack.pack(dict(value.payload or {})),
+                    bpmn_process_id=headers.bpmn_process_id,
+                    activity_id=headers.activity_id,
+                    workflow_instance_key=headers.workflow_instance_key,
+                    activity_instance_key=headers.activity_instance_key,
+                )
+        finally:
+            stream.close()
+            with self._stream_lock:
+                self._active_streams -= 1
+
     def close(self) -> None:
         self._server.stop(grace=1)
 
@@ -217,6 +279,20 @@ class GrpcGatewayClient:
 
     def health_check(self) -> "pb.HealthCheckResponse":
         return self.call("HealthCheck")
+
+    def activate_jobs(self, request: "pb.ActivateJobsRequest"):
+        """Server-streaming ActivateJobs: an iterator of ActivatedJob (the
+        polyglot worker surface; cancel the returned call to release the
+        gateway-held subscription)."""
+        rpc = self._calls.get("ActivateJobs")
+        if rpc is None:
+            rpc = self._channel.unary_stream(
+                f"/{_SERVICE}/ActivateJobs",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.ActivatedJob.FromString,
+            )
+            self._calls["ActivateJobs"] = rpc
+        return rpc(request)
 
     def close(self) -> None:
         self._channel.close()

@@ -184,7 +184,7 @@ def _dec_workflows(items: List[Any]):
         if d.get("st") == "YAML_WORKFLOW":
             model = read_yaml_workflow(data.decode("utf-8"))
         else:
-            model = read_model(data)
+            model = read_model(data, strict=False)  # already accepted at deploy
         matched = False
         for wf in transform_model(model):
             if wf.id != d.get("id"):

@@ -45,8 +45,45 @@ def _q(tag: str, ns: str = BPMN_NS) -> str:
     return f"{{{ns}}}{tag}"
 
 
-def read_model(source: Union[str, bytes, io.IOBase]) -> BpmnModel:
-    """Parse a BPMN XML document into a BpmnModel."""
+class UnsupportedBpmnElement(ValueError):
+    """A BPMN 2.0 construct outside the executable subset — deployment
+    rejects with the element id and a reason (reference
+    broker-core/.../workflow/model/validation/)."""
+
+
+# executable subset (what _read_scope builds)
+_SUPPORTED_TAGS = {
+    "startEvent", "endEvent", "serviceTask", "exclusiveGateway",
+    "parallelGateway", "intermediateCatchEvent", "receiveTask",
+    "boundaryEvent", "subProcess", "sequenceFlow",
+}
+
+# legal non-executable content, safely skipped — including element
+# SUB-structure the per-element readers consume via child.find() rather
+# than the scope loop (multiInstanceLoopCharacteristics, incoming/outgoing
+# references, event definitions)
+_IGNORABLE_TAGS = {
+    "extensionElements", "documentation", "ioSpecification", "laneSet",
+    "textAnnotation", "association", "group", "category", "dataObject",
+    "dataObjectReference", "dataStoreReference", "property",
+    "BPMNDiagram", "BPMNPlane", "BPMNShape", "BPMNEdge",
+    "multiInstanceLoopCharacteristics", "incoming", "outgoing",
+    "messageEventDefinition", "timerEventDefinition",
+    "conditionExpression",
+}
+
+
+def read_model(
+    source: Union[str, bytes, io.IOBase], strict: bool = True
+) -> BpmnModel:
+    """Parse a BPMN XML document into a BpmnModel.
+
+    ``strict`` (the deploy-time default) rejects elements outside the
+    executable subset with :class:`UnsupportedBpmnElement`. Recovery
+    paths (snapshot restore, workflow fetch) parse with ``strict=False``:
+    those resources were already accepted by SOME deploy-time validator,
+    and a version upgrade must never make a recorded deployment
+    unrecoverable."""
     if isinstance(source, (str, bytes)):
         root = ET.fromstring(source)
     else:
@@ -73,12 +110,13 @@ def read_model(source: Union[str, bytes, io.IOBase]) -> BpmnModel:
             executable=process_el.get("isExecutable", "true") == "true",
         )
         model.add(process)
-        _read_scope(model, process_el, process.id, messages_by_id)
+        _read_scope(model, process_el, process.id, messages_by_id, strict)
 
     return model
 
 
-def _read_scope(model: BpmnModel, scope_el, scope_id: str, messages_by_id) -> None:
+def _read_scope(model: BpmnModel, scope_el, scope_id: str, messages_by_id,
+                strict: bool = True) -> None:
     flows = []
     for child in scope_el:
         tag = child.tag.rsplit("}", 1)[-1]
@@ -132,7 +170,7 @@ def _read_scope(model: BpmnModel, scope_el, scope_id: str, messages_by_id) -> No
                 node.multi_instance = _read_multi_instance(mi_el)
             model.add(node)
             _read_io_mappings(child, node)
-            _read_scope(model, child, el_id, messages_by_id)
+            _read_scope(model, child, el_id, messages_by_id, strict)
             continue
         elif tag == "sequenceFlow":
             flow = SequenceFlow(
@@ -146,8 +184,19 @@ def _read_scope(model: BpmnModel, scope_el, scope_id: str, messages_by_id) -> No
                 flow.condition_expression = cond.text.strip()
             flows.append(flow)
             continue
+        elif tag in _IGNORABLE_TAGS or not strict:
+            continue  # non-executable content: docs, diagrams, extensions…
         else:
-            continue  # extensionElements, documentation, diagram interchange…
+            # reference broker-core workflow/model/validation: a resource
+            # the engine cannot execute REJECTS at deploy with the element
+            # id and a reason — silently dropping an element would run a
+            # different process than the one modeled
+            raise UnsupportedBpmnElement(
+                f"unsupported BPMN element <{tag}>"
+                + (f" (id={el_id!r})" if el_id else "")
+                + f" in scope {scope_id!r}; supported elements: "
+                + ", ".join(sorted(_SUPPORTED_TAGS))
+            )
         node.scope_id = scope_id
         if tag != "serviceTask":
             _read_io_mappings(child, node)

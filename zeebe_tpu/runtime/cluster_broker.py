@@ -192,6 +192,11 @@ class PartitionServer:
     def _uninstall_leader(self) -> None:
         self.is_leader = False
         self.engine = None
+        # topic pushers are LEADER-LOCAL services (reference: push
+        # processors are installed/removed with leadership); a pusher
+        # surviving a leadership flap raced the new leader's pusher and
+        # delivered records out of order (round-4 flake root cause)
+        self.topic_pushers.clear()
 
     # -- the processing loop (StreamProcessorController hot loop) ----------
     def _schedule_processing(self) -> None:
@@ -898,24 +903,34 @@ class ClusterBroker(Actor):
                 )
             ])
             if conn is not None:
-                def push(record, _conn=conn, _key=subscriber_key, _pid=partition_id):
+                epoch = int(msg.get("epoch", -1))
+
+                def push(record, _conn=conn, _key=subscriber_key,
+                         _pid=partition_id, _epoch=epoch):
                     return _conn.push(
                         msgpack.pack(
                             {
                                 "t": "pushed-record",
                                 "partition": _pid,
                                 "subscriber_key": _key,
+                                "epoch": _epoch,
                                 "frame": codec.encode_record(record),
                             }
                         )
                     )
 
+                logger.debug(
+                    "broker %s: opening topic pusher %d (%r) on partition "
+                    "%d at cursor %d", self.node_id, subscriber_key, name,
+                    partition_id, cursor,
+                )
                 server.topic_pushers[subscriber_key] = {
                     "name": name,
                     "cursor": cursor,
                     "capacity": int(msg.get("credits", 32)),
                     "unacked": [],
                     "push": push,
+                    "epoch": epoch,
                 }
                 conn.on_close(
                     lambda: self._drop_topic_subscription(partition_id, subscriber_key)
@@ -940,11 +955,29 @@ class ClusterBroker(Actor):
                 server.pump_topic_subscriptions()
         elif action == "close":
             self._drop_topic_subscription(partition_id, subscriber_key)
+        elif action == "check":
+            # subscription liveness probe: the client's monitor verifies
+            # its pusher survived leadership churn (pushers are
+            # leader-local and clear on uninstall — a same-address flap
+            # would otherwise deafen the subscriber silently)
+            pusher = server.topic_pushers.get(subscriber_key)
+            result.complete(msgpack.pack({
+                "t": "ok",
+                "known": pusher is not None,
+                "epoch": pusher.get("epoch", -1) if pusher else -1,
+            }))
+            return
         result.complete(msgpack.pack({"t": "ok"}))
 
     def _drop_topic_subscription(self, partition_id: int, subscriber_key: int) -> None:
         server = self.partitions.get(partition_id)
         if server is not None:
+            if subscriber_key in server.topic_pushers:
+                logger.debug(
+                    "broker %s: dropping topic pusher %d on partition %d "
+                    "(connection closed)", self.node_id, subscriber_key,
+                    partition_id,
+                )
             server.topic_pushers.pop(subscriber_key, None)
 
     # -- cluster self-assembly (reference bootstrap services) ---------------
@@ -1281,7 +1314,7 @@ class ClusterBroker(Actor):
                 if entry.get("resource_type") == "YAML_WORKFLOW":
                     model = read_yaml_workflow(data.decode("utf-8"))
                 else:
-                    model = read_model(data)
+                    model = read_model(data, strict=False)  # accepted at deploy
                 for wf in transform_model(model):
                     if wf.id != entry.get("id"):
                         continue
