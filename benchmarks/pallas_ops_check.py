@@ -108,6 +108,47 @@ def main():
     p4 = pops.masked_lane_accum(t1, slots, active, lvals)
     check("lane accum", x4, p4)  # addition commutes; duplicates compare too
 
+    # -- cross-backend snapshot interchange (VERDICT round-3 #7) ------------
+    # The failover path: a pallas-built table is snapshotted on the TPU
+    # leader and restored on a CPU-mesh follower, where the XLA fallback
+    # serves it. Bucket layout may differ between the builders, so the
+    # restored table must be FUNCTIONALLY correct under the XLA ops:
+    # every live key found with its value, absent keys not found, and
+    # further inserts/deletes through the XLA path must keep working.
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        # tunneled TPU plugins may not register an in-process cpu backend;
+        # the interchange leg then runs only where both backends exist
+        print("skipped: tpu->cpu interchange (no cpu backend in-process)")
+        print("ALL OK")
+        return
+    snap = {
+        "keys": np.asarray(t_p.keys),  # device_get == the snapshot bytes
+        "vals": np.asarray(t_p.vals),
+    }
+    with jax.default_device(cpu):
+        t_cpu = hashmap.HashTable(
+            jnp.asarray(snap["keys"]), jnp.asarray(snap["vals"])
+        )
+        f_c, s_c = hashmap.lookup(t_cpu, jnp.asarray(np.asarray(probe_keys)),
+                                  jnp.ones((B,), bool))
+        check("tpu->cpu restore found", np.asarray(f_x), np.asarray(f_c))
+        check("tpu->cpu restore vals",
+              np.where(np.asarray(f_x), np.asarray(s_x), -1),
+              np.where(np.asarray(f_c), np.asarray(s_c), -1))
+        # the restored table keeps serving through the XLA path
+        extra = jnp.asarray(np.arange(10 * T, 10 * T + 64, dtype=np.int64))
+        t_cpu2, ok_c = hashmap.insert(
+            t_cpu, extra, jnp.arange(64, dtype=jnp.int32),
+            jnp.ones((64,), bool),
+        )
+        check("tpu->cpu post-restore insert ok", np.asarray(ok_c),
+              np.ones((64,), bool))
+        f_c2, s_c2 = hashmap.lookup(t_cpu2, extra, jnp.ones((64,), bool))
+        check("tpu->cpu post-restore lookup", np.asarray(f_c2),
+              np.ones((64,), bool))
+
     print("ALL OK")
 
 

@@ -208,7 +208,11 @@ def _quiesce_executable(graph, state, queue, now, batch_size, synthetic_workers,
         (tuple(leaf.shape), str(leaf.dtype))
         for leaf in jax.tree.leaves((graph, state, queue, now))
     )
-    key = (shapes, batch_size, synthetic_workers, max_rounds)
+    # the treedef must be part of the key: graphs with optional tables
+    # absent (None) can have the same leaf list as graphs with a different
+    # structure, and an AOT executable rejects a mismatched pytree
+    treedef = jax.tree.structure((graph, state, queue, now))
+    key = (treedef, shapes, batch_size, synthetic_workers, max_rounds)
     compiled = _quiesce_cache.get(key)
     if compiled is None:
         lowered = _quiesce_device.lower(

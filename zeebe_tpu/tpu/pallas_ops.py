@@ -51,6 +51,15 @@ _CHUNK = 2048  # records per grid step; scalars per chunk must fit SMEM
 
 
 def _use_pallas() -> bool:
+    """Pallas table ops on TPU; ``ZB_PALLAS=0`` forces the XLA fallbacks
+    (useful for A/B benchmarking — the fast path differs per XLA build:
+    libtpu builds with the serial per-index scatter lowering need the
+    pallas passes, builds with the DMA-pipelined scatter/gather lowering
+    are faster through plain XLA)."""
+    import os
+
+    if os.environ.get("ZB_PALLAS", "").strip() in ("0", "false", "off"):
+        return False
     return jax.default_backend() == "tpu"
 
 
@@ -144,7 +153,7 @@ def masked_row_update(
         lane_mask = jnp.ones((1, 1), jnp.int32)  # placeholder operand
 
     def kernel(slots_ref, active_ref, vals_ref, mask_ref, tbl_ref, out_ref):
-        del tbl_ref
+        _init_out(out_ref, tbl_ref)
 
         def body(i, _):
             @functools.partial(_when, active_ref[i] != 0)
@@ -192,6 +201,21 @@ def _when(cond, fn):
     return pl.when(cond)(fn)
 
 
+def _init_out(out_ref, in_ref):
+    """Copy the aliased input block into the output block on grid step 0.
+
+    ``input_output_aliases`` donates the HBM buffer but does NOT guarantee
+    the output VMEM window starts with the input's contents (observed on
+    this jax/libtpu build: it reads back zeros). Every RMW kernel must
+    seed its output window explicitly; the window then persists across
+    grid steps (constant index_map + arbitrary semantics)."""
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        out_ref[...] = in_ref[...]
+
+
 def masked_row_max(
     table: jax.Array,  # [T, K] i32
     slots: jax.Array,  # [B] i32
@@ -210,7 +234,7 @@ def masked_row_max(
     c = _chunk(b)
 
     def kernel(slots_ref, active_ref, vals_ref, tbl_ref, out_ref):
-        del tbl_ref
+        _init_out(out_ref, tbl_ref)
 
         def body(i, _):
             @functools.partial(_when, active_ref[i] != 0)
@@ -249,7 +273,7 @@ def masked_row_max(
 
 def _lane_kernel(accumulate: bool):
     def kernel(slots_ref, active_ref, vals_ref, tbl_ref, out_ref):
-        del tbl_ref
+        _init_out(out_ref, tbl_ref)
         lane_iota = lax.broadcasted_iota(jnp.int32, (LANES,), 0)
 
         def body(i, _):
@@ -474,7 +498,9 @@ def insert(table: HashTable, keys: jax.Array, vals: jax.Array, valid: jax.Array)
     def kernel(h0_ref, lo_ref, hi_ref, vals_ref, valid_ref,
                tlo_in, thi_in, tv_in,
                tlo_ref, thi_ref, tv_ref, ok_ref):
-        del tlo_in, thi_in, tv_in
+        _init_out(tlo_ref, tlo_in)
+        _init_out(thi_ref, thi_in)
+        _init_out(tv_ref, tv_in)
         lane_iota = lax.broadcasted_iota(jnp.int32, (LANES,), 0)
 
         def body(i, _):
@@ -554,7 +580,8 @@ def delete(table: HashTable, keys: jax.Array, valid: jax.Array) -> HashTable:
 
     def kernel(h0_ref, lo_ref, hi_ref, valid_ref, tlo_in, thi_in,
                tlo_ref, thi_ref):
-        del tlo_in, thi_in
+        _init_out(tlo_ref, tlo_in)
+        _init_out(thi_ref, thi_in)
         lane_iota = lax.broadcasted_iota(jnp.int32, (LANES,), 0)
 
         def body(i, _):
