@@ -19,15 +19,31 @@ to rebuild state without re-executing side effects.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import os
 import re
 import shutil
+import time
 import zlib
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 _SNAPSHOT_DIR_RE = re.compile(r"^snapshot_(-?\d+)_(-?\d+)_(-?\d+)$")
 _STATE_FILE = "state.bin"
+_MANIFEST_FILE = "manifest.bin"
 _CHECKSUM_FILE = "checksum.crc32"
+_SEGMENTS_DIR = "segments"
+_HASH_HEX_RE = re.compile(r"^[0-9a-f]{32}$")
+# GC grace: segments younger than this are kept even when unreferenced —
+# they may belong to a checkpoint/install whose manifest has not committed
+# yet (the manifest dir rename is the commit point)
+_SEGMENT_GC_GRACE_SEC = 120.0
+
+MANIFEST_FORMAT = "zbtpu-snapshot-manifest-v1"
+
+
+def part_hash(data: bytes) -> str:
+    """Content address of an (uncompressed) snapshot part."""
+    return hashlib.blake2b(data, digest_size=16).hexdigest()
 
 
 @dataclasses.dataclass(frozen=True, order=True)
@@ -119,6 +135,221 @@ class SnapshotStorage:
         for meta in self.list():
             if meta < keep:
                 self.delete(meta)
+        self.gc_segments()
+
+    # -- incremental checkpoints: content-addressed segment store ----------
+    # A snapshot is a manifest of named parts, each stored once per content
+    # hash under segments/. Unchanged parts (fixed-capacity device tables,
+    # deployed workflow resources) are shared across checkpoints, so the
+    # per-checkpoint write cost tracks the CHANGED state, not total state
+    # size — the analogue of RocksDB checkpoints hard-linking unchanged SST
+    # files (logstreams/.../state/StateSnapshotController.java).
+
+    def _segments_root(self) -> str:
+        path = os.path.join(self.root, _SEGMENTS_DIR)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def _segment_path(self, h: str) -> str:
+        if not _HASH_HEX_RE.match(h):
+            raise ValueError(f"bad segment hash {h!r}")
+        return os.path.join(self._segments_root(), h + ".seg")
+
+    def has_segment(self, h: str) -> bool:
+        return os.path.exists(self._segment_path(h))
+
+    def read_segment(self, h: str) -> Optional[bytes]:
+        """Compressed segment bytes as stored (the replication wire unit)."""
+        try:
+            with open(self._segment_path(h), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def install_segment(
+        self, h: str, compressed: bytes, max_len: int
+    ) -> Optional[bytes]:
+        """Verify + persist a fetched segment; returns the decompressed
+        bytes (so the caller need not decompress again) or None on any
+        violation. The content address makes the transfer self-verifying:
+        the decompressed bytes must hash to ``h``."""
+        try:
+            d = zlib.decompressobj()
+            data = d.decompress(compressed, max_len + 1)
+            if d.unconsumed_tail or len(data) > max_len:
+                return None
+        except zlib.error:
+            return None
+        if part_hash(data) != h:
+            return None
+        self._write_segment(h, compressed)
+        return data
+
+    def _write_segment(self, h: str, compressed: bytes) -> None:
+        path = self._segment_path(h)
+        if os.path.exists(path):
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(compressed)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def write_parts(
+        self, metadata: SnapshotMetadata, parts: List[Tuple[str, bytes]]
+    ) -> Dict[str, int]:
+        """Commit a manifest snapshot; returns write-cost stats
+        (``new_bytes`` is the incremental cost — bytes whose content hash
+        was not already in the segment store)."""
+        stats = {"total_bytes": 0, "new_bytes": 0,
+                 "parts": len(parts), "new_segments": 0}
+        entries = []
+        for name, data in parts:
+            h = part_hash(data)
+            stats["total_bytes"] += len(data)
+            if not self.has_segment(h):
+                self._write_segment(h, zlib.compress(data, 1))
+                stats["new_bytes"] += len(data)
+                stats["new_segments"] += 1
+            entries.append({"n": name, "h": h, "l": len(data)})
+        self._commit_manifest(metadata, _pack_manifest(entries))
+        return stats
+
+    def _commit_manifest(self, metadata: SnapshotMetadata, manifest: bytes) -> None:
+        """Atomic manifest commit: fsync'd tmp dir, rename = commit point."""
+        tmp = os.path.join(self.root, metadata.dirname + ".tmp")
+        final = os.path.join(self.root, metadata.dirname)
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        with open(os.path.join(tmp, _MANIFEST_FILE), "wb") as f:
+            f.write(manifest)
+            f.flush()
+            os.fsync(f.fileno())
+        with open(os.path.join(tmp, _CHECKSUM_FILE), "w") as f:
+            f.write(str(zlib.crc32(manifest)))
+            f.flush()
+            os.fsync(f.fileno())
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+
+    def manifest(self, metadata: SnapshotMetadata) -> Optional[List[dict]]:
+        """Part list ``[{"n", "h", "l"}, ...]`` of a manifest snapshot, or
+        None (missing / corrupt / legacy single-blob snapshot)."""
+        path = os.path.join(self.root, metadata.dirname)
+        try:
+            with open(os.path.join(path, _MANIFEST_FILE), "rb") as f:
+                raw = f.read()
+            with open(os.path.join(path, _CHECKSUM_FILE)) as f:
+                expected = int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+        if zlib.crc32(raw) != expected:
+            return None
+        return _unpack_manifest(raw)
+
+    def install_manifest(
+        self, metadata: SnapshotMetadata, entries: List[dict]
+    ) -> bool:
+        """Follower side: commit a manifest whose segments are already
+        installed. Refuses if any referenced segment is missing."""
+        for e in entries:
+            if not self.has_segment(str(e["h"])):
+                return False
+        self._commit_manifest(metadata, _pack_manifest(entries))
+        return True
+
+    def read_parts(self, metadata: SnapshotMetadata) -> Optional[Dict[str, bytes]]:
+        """Named part payloads of a snapshot (legacy single-blob snapshots
+        come back as ``{"state": payload}``); None if missing/corrupt."""
+        path = os.path.join(self.root, metadata.dirname)
+        if os.path.exists(os.path.join(path, _STATE_FILE)):
+            payload = self.read(metadata)
+            return None if payload is None else {"state": payload}
+        entries = self.manifest(metadata)
+        if entries is None:
+            return None
+        out: Dict[str, bytes] = {}
+        for e in entries:
+            h = str(e["h"])
+            length = int(e["l"])
+            compressed = self.read_segment(h)
+            if compressed is None:
+                return None
+            try:
+                d = zlib.decompressobj()
+                data = d.decompress(compressed, length + 1)
+                if d.unconsumed_tail or len(data) != length:
+                    return None
+            except zlib.error:
+                return None
+            if part_hash(data) != h:
+                return None
+            out[str(e["n"])] = data
+        return out
+
+    def gc_segments(self) -> int:
+        """Delete segments referenced by no committed manifest (with a
+        grace period for segments of an install in progress). Returns the
+        number of files removed."""
+        seg_root = os.path.join(self.root, _SEGMENTS_DIR)
+        if not os.path.isdir(seg_root):
+            return 0
+        referenced = set()
+        for meta in self.list():
+            for e in self.manifest(meta) or []:
+                referenced.add(str(e["h"]))
+        removed = 0
+        cutoff = time.time() - _SEGMENT_GC_GRACE_SEC
+        for name in os.listdir(seg_root):
+            if name.endswith(".seg"):
+                if name[:-4] in referenced:
+                    continue
+            elif not name.endswith(".seg.tmp"):
+                continue  # .tmp = torn write from a crash: GC after grace
+            path = os.path.join(seg_root, name)
+            try:
+                if os.path.getmtime(path) > cutoff:
+                    continue
+                os.unlink(path)
+                removed += 1
+            except OSError:
+                continue
+        return removed
+
+
+def _pack_manifest(entries: List[dict]) -> bytes:
+    from zeebe_tpu.protocol import msgpack
+
+    return msgpack.pack({"fmt": MANIFEST_FORMAT, "parts": entries})
+
+
+def _unpack_manifest(raw: bytes) -> Optional[List[dict]]:
+    from zeebe_tpu.protocol import msgpack
+
+    try:
+        doc = msgpack.unpack(raw)
+    except Exception:
+        return None
+    if not isinstance(doc, dict) or doc.get("fmt") != MANIFEST_FORMAT:
+        return None
+    parts = doc.get("parts")
+    if not isinstance(parts, list):
+        return None
+    out = []
+    for e in parts:
+        if not isinstance(e, dict):
+            return None
+        try:
+            name, h, length = str(e["n"]), str(e["h"]), int(e["l"])
+        except (KeyError, TypeError, ValueError):
+            return None
+        if not _HASH_HEX_RE.match(h) or length < 0:
+            return None
+        out.append({"n": name, "h": h, "l": length})
+    return out
 
 
 class SnapshotController:
@@ -138,12 +369,15 @@ class SnapshotController:
 
     def __init__(self, storage: SnapshotStorage):
         self.storage = storage
+        # write-cost stats of the last take(): {"total_bytes", "new_bytes",
+        # "parts", "new_segments"} — new_bytes is the incremental cost
+        self.last_take_stats: Optional[Dict[str, int]] = None
 
     def take(self, state: Any, metadata: SnapshotMetadata) -> None:
         from zeebe_tpu.log import stateser
 
-        payload = stateser.encode_state(state)
-        self.storage.write(metadata, payload)
+        parts = stateser.encode_state_parts(state)
+        self.last_take_stats = self.storage.write_parts(metadata, parts)
         self.storage.purge_older_than(metadata)
 
     def recover(self, log_last_position: int):
@@ -159,11 +393,11 @@ class SnapshotController:
         for meta in self.storage.list():
             if meta.last_written_position > log_last_position:
                 continue  # log was truncated past this snapshot: stale
-            payload = self.storage.read(meta)
-            if payload is None:
+            parts = self.storage.read_parts(meta)
+            if parts is None:
                 continue
             try:
-                return stateser.decode_state(payload), meta
+                return stateser.decode_state_parts(parts), meta
             except stateser.SnapshotFormatError:
                 continue
         return None, None

@@ -416,6 +416,123 @@ def decode_state(payload: bytes) -> Any:
 # ---------------------------------------------------------------------------
 
 
+def encode_state_parts(state: Any) -> List[tuple]:
+    """Engine-state → named parts for content-addressed checkpointing.
+
+    The snapshot storage hashes each part and only writes segments it has
+    not seen in a previous checkpoint (the TPU-native analogue of RocksDB
+    checkpoints hard-linking unchanged SST files —
+    ``logstreams/.../state/StateSnapshotController.java``). The split is
+    chosen so the stable bulk dedupes:
+    - device state: one part per SoA table array (fixed-capacity tables
+      that did not change between checkpoints hash identically), plus the
+      embedded host-oracle state and a small root part;
+    - host state: deployed workflow resources (static after deployment)
+      split from the mutable remainder;
+    - anything else: a single legacy-encoded part.
+
+    Returns ``[(name, bytes), ...]``; decode with ``decode_state_parts``.
+    """
+    if isinstance(state, dict) and state.get("fmt") == FORMAT_DEVICE_V1:
+        parts = [
+            (
+                "_root",
+                msgpack.pack(
+                    {
+                        "fmt": FORMAT_DEVICE_V1,
+                        "meta": state.get("meta", {}),
+                        "arrays": sorted(state.get("arrays", {}).keys()),
+                    }
+                ),
+            )
+        ]
+        for name in sorted(state.get("arrays", {}).keys()):
+            parts.append(
+                ("a/" + name,
+                 msgpack.pack(pack_ndarray(np.asarray(state["arrays"][name]))))
+            )
+        if state.get("host") is not None:
+            parts.extend(
+                ("h/" + n, b) for n, b in _host_state_parts(state["host"])
+            )
+        return parts
+    if isinstance(state, dict) and isinstance(state.get("wf_keys"), KeyGenerator):
+        return [("_root", msgpack.pack({"fmt": FORMAT_HOST_V1}))] + [
+            ("h/" + n, b) for n, b in _host_state_parts(state)
+        ]
+    return [("state", encode_state(state))]
+
+
+def _host_state_parts(state: Dict[str, Any]) -> List[tuple]:
+    """Host engine state as (workflows, rest) parts: deployed resources are
+    immutable after deployment, so the (often large) workflow part dedupes
+    across every subsequent checkpoint."""
+    doc = msgpack.unpack(encode_host_state(state))
+    workflows = doc.pop("workflows", [])
+    return [
+        ("workflows", msgpack.pack({"workflows": workflows})),
+        ("rest", msgpack.pack(doc)),
+    ]
+
+
+def _host_state_from_parts(parts: Dict[str, bytes], prefix: str) -> Dict[str, Any]:
+    try:
+        doc = msgpack.unpack(parts[prefix + "rest"])
+        wf_doc = msgpack.unpack(parts[prefix + "workflows"])
+        doc["workflows"] = wf_doc.get("workflows", [])
+    except KeyError as e:
+        raise SnapshotFormatError(f"snapshot part missing: {e}") from None
+    except Exception as e:
+        raise SnapshotFormatError(f"malformed snapshot part: {e}") from None
+    if not isinstance(doc, dict) or doc.get("fmt") != FORMAT_HOST_V1:
+        raise SnapshotFormatError("malformed host snapshot parts")
+    return _decode_host_doc(doc)
+
+
+def decode_state_parts(parts: Dict[str, bytes]) -> Any:
+    """Reassemble ``encode_state_parts`` output (untrusted bytes)."""
+    if sum(len(b) for b in parts.values()) > MAX_SNAPSHOT_BYTES:
+        raise SnapshotFormatError("snapshot parts too large")
+    if set(parts) == {"state"}:
+        return decode_state(parts["state"])
+    try:
+        root = msgpack.unpack(parts["_root"])
+    except KeyError:
+        raise SnapshotFormatError("snapshot root part missing") from None
+    except Exception as e:
+        raise SnapshotFormatError(f"malformed snapshot root: {e}") from None
+    if not isinstance(root, dict):
+        raise SnapshotFormatError("malformed snapshot root")
+    fmt = root.get("fmt")
+    if fmt == FORMAT_HOST_V1:
+        return _host_state_from_parts(parts, "h/")
+    if fmt == FORMAT_DEVICE_V1:
+        arrays: Dict[str, np.ndarray] = {}
+        try:
+            names = [str(n) for n in root.get("arrays", [])]
+            for name in names:
+                arrays[name] = unpack_ndarray(msgpack.unpack(parts["a/" + name]))
+        except KeyError as e:
+            raise SnapshotFormatError(f"snapshot part missing: {e}") from None
+        except SnapshotFormatError:
+            raise
+        except Exception as e:
+            raise SnapshotFormatError(f"malformed snapshot part: {e}") from None
+        host = None
+        if any(n.startswith("h/") for n in parts):
+            host = _host_state_from_parts(parts, "h/")
+        meta = root.get("meta", {})
+        if not isinstance(meta, dict):
+            raise SnapshotFormatError("malformed snapshot meta")
+        return {
+            "fmt": FORMAT_DEVICE_V1,
+            "arrays": arrays,
+            "meta": meta,
+            "host": host,
+        }
+    raise SnapshotFormatError(f"unknown snapshot parts format {fmt!r}")
+
+
 def encode_device_state(state: Dict[str, Any]) -> bytes:
     """Device snapshot envelope: {'fmt', 'arrays': {name: ndarray},
     'meta': plain-data dict, 'host': host-engine snapshot dict or None}.

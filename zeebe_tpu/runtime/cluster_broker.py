@@ -52,6 +52,10 @@ from zeebe_tpu.transport import ClientTransport, RemoteAddress, ServerTransport
 logger = logging.getLogger(__name__)
 
 
+class _AppendFailed(Exception):
+    """Raft append failed (deposed mid-request); maps to NOT_LEADER."""
+
+
 class Topology:
     """Queryable cluster view (reference ``Topology`` aggregated by the
     topology manager from gossip custom events)."""
@@ -437,6 +441,9 @@ class ClusterBroker(Actor):
         self._snapshot_fetches: Dict[int, threading.Thread] = {}
         self.partitions: Dict[int, PartitionServer] = {}
         self._pending_responses: Dict[int, ActorFuture] = {}
+        # client-command dedup: cid → response future of the first append
+        # (bounded FIFO; see _handle_command)
+        self._cmd_dedup: Dict[str, ActorFuture] = {}
         self._next_request_id = 0
         self._push_listeners: Dict[int, Callable[[int, Record], None]] = {}
         self._request_lock = threading.Lock()
@@ -662,6 +669,10 @@ class ClusterBroker(Actor):
             return self._handle_list_snapshots(msg)
         if t == "fetch-snapshot-chunk":
             return self._handle_fetch_snapshot_chunk(msg)
+        if t == "fetch-snapshot-manifest":
+            return self._handle_fetch_snapshot_manifest(msg)
+        if t == "fetch-snapshot-segment":
+            return self._handle_fetch_snapshot_segment(msg)
         return None
 
     # -- snapshot replication (reference SnapshotReplicationService:55-128:
@@ -732,6 +743,72 @@ class ClusterBroker(Actor):
             }
         )
 
+    def _handle_fetch_snapshot_manifest(self, msg: dict) -> bytes:
+        """Incremental replication: the part list of a manifest snapshot;
+        the follower fetches only segments it does not already hold."""
+        from zeebe_tpu.log.snapshot import SnapshotMetadata
+
+        server = self.partitions.get(int(msg.get("partition", 0)))
+        if server is None:
+            return msgpack.pack({"t": "error", "code": "NO_PARTITION"})
+        meta = SnapshotMetadata(
+            last_processed_position=int(msg.get("processed", -1)),
+            last_written_position=int(msg.get("written", -1)),
+            term=int(msg.get("term", 0)),
+        )
+        entries = server.snapshots.storage.manifest(meta)
+        if entries is None:
+            # legacy single-blob snapshot (or gone): the follower falls
+            # back to the ranged chunk fetch
+            return msgpack.pack({"t": "error", "code": "NO_MANIFEST"})
+        return msgpack.pack({"t": "ok", "parts": entries})
+
+    def _handle_fetch_snapshot_segment(self, msg: dict) -> bytes:
+        from zeebe_tpu.log.snapshot import SnapshotMetadata
+
+        server = self.partitions.get(int(msg.get("partition", 0)))
+        if server is None:
+            return msgpack.pack({"t": "error", "code": "NO_PARTITION"})
+        # the metadata keys scope the request to a live snapshot: segments
+        # of purged snapshots may be GC'd mid-transfer, and the follower
+        # restarts the transfer from list-snapshots in that case
+        meta = SnapshotMetadata(
+            last_processed_position=int(msg.get("processed", -1)),
+            last_written_position=int(msg.get("written", -1)),
+            term=int(msg.get("term", 0)),
+        )
+        entries = server.snapshots.storage.manifest(meta)
+        if entries is None:
+            return msgpack.pack({"t": "error", "code": "NO_MANIFEST"})
+        h = str(msg.get("h", ""))
+        if not any(e["h"] == h for e in entries):
+            return msgpack.pack({"t": "error", "code": "NO_SEGMENT"})
+        # ranged reads come 1MB at a time: serve from the bounded transfer
+        # cache, not a full file re-read per chunk (quadratic IO on big
+        # device-table segments — same fix as the legacy chunk handler)
+        cache_key = (int(msg.get("partition", 0)), meta, h)
+        cached = self._snapshot_serve_cache.get(cache_key)
+        if cached is None:
+            data = server.snapshots.storage.read_segment(h)
+            if data is None:
+                return msgpack.pack({"t": "error", "code": "NO_SEGMENT"})
+            cached = (data, 0)
+            self._snapshot_serve_cache[cache_key] = cached
+            while len(self._snapshot_serve_cache) > 4:
+                self._snapshot_serve_cache.pop(
+                    next(iter(self._snapshot_serve_cache))
+                )
+        data = cached[0]
+        offset = int(msg.get("offset", 0))
+        length = min(max(int(msg.get("length", 1024 * 1024)), 0), 4 * 1024 * 1024)
+        return msgpack.pack(
+            {
+                "t": "ok",
+                "total": len(data),
+                "chunk": data[offset : offset + length],
+            }
+        )
+
     def _replicate_snapshots(self) -> None:
         """Follower side: poll each partition's leader for new snapshots and
         fetch them chunk-wise (installed per follower partition —
@@ -783,54 +860,8 @@ class ClusterBroker(Actor):
             key = (meta.last_processed_position, meta.last_written_position, meta.term)
             if key in have:
                 return
-            chunks = []
-            offset = 0
-            expect_total = None
-            expect_crc = None
-            while True:
-                body = {
-                    "t": "fetch-snapshot-chunk",
-                    "partition": pid,
-                    "processed": meta.last_processed_position,
-                    "written": meta.last_written_position,
-                    "term": meta.term,
-                    "offset": offset,
-                }
-                chunk_rsp = msgpack.unpack(
-                    self.client_transport.send_request(
-                        addr, msgpack.pack(body), timeout_ms=5000
-                    ).join(6)
-                )
-                if chunk_rsp.get("t") != "ok":
-                    return
-                total = int(chunk_rsp.get("total", 0))
-                # don't trust the remote size field blindly: bound what we
-                # buffer, and require it stable across chunks
-                if total < 0 or total > stateser.MAX_SNAPSHOT_BYTES:
-                    return
-                if expect_total is None:
-                    expect_total = total
-                    expect_crc = chunk_rsp.get("crc")
-                elif total != expect_total:
-                    return
-                chunk = bytes(chunk_rsp.get("chunk", b""))
-                chunks.append(chunk)
-                offset += len(chunk)
-                if offset > expect_total:
-                    return
-                if offset >= expect_total or not chunk:
-                    break
-            payload = b"".join(chunks)
-            # end-to-end integrity from the leader's serve cache, then a
-            # full decode check: a fetched snapshot must be parseable by
-            # the data-only codec before it can ever be offered to recovery
-            if expect_crc is not None and zlib.crc32(payload) != int(expect_crc):
+            if not self._fetch_snapshot_into_storage(pid, server, addr, meta):
                 return
-            try:
-                stateser.decode_state(payload)
-            except stateser.SnapshotFormatError:
-                return
-            server.snapshots.storage.write(meta, payload)
             # snapshot catch-up ONLY when the leader told us we are below
             # its compaction floor (the snapshot_needed probe): a merely
             # lagging follower must keep receiving ordinary replication —
@@ -854,6 +885,157 @@ class ClusterBroker(Actor):
                 "snapshot replication fetch from %s for partition %d "
                 "failed (next poll retries): %r", addr, pid, e,
             )
+
+    def _fetch_snapshot_into_storage(self, pid: int, server, addr, meta) -> bool:
+        """Transfer one snapshot from the leader into local storage.
+
+        Incremental path first: fetch the manifest, then ONLY the segments
+        this node does not already hold (unchanged tables from a prior
+        checkpoint never re-cross the wire). Legacy single-blob snapshots
+        fall back to the ranged chunk fetch."""
+        man_rsp = msgpack.unpack(
+            self.client_transport.send_request(
+                addr,
+                msgpack.pack({
+                    "t": "fetch-snapshot-manifest",
+                    "partition": pid,
+                    "processed": meta.last_processed_position,
+                    "written": meta.last_written_position,
+                    "term": meta.term,
+                }),
+                timeout_ms=3000,
+            ).join(4)
+        )
+        if man_rsp.get("t") == "ok":
+            return self._fetch_snapshot_parts(
+                pid, server, addr, meta, man_rsp.get("parts")
+            )
+        if man_rsp.get("code") == "NO_MANIFEST":
+            return self._fetch_snapshot_legacy(pid, server, addr, meta)
+        return False
+
+    def _fetch_snapshot_parts(self, pid, server, addr, meta, entries) -> bool:
+        from zeebe_tpu.log import snapshot as snapmod
+
+        storage = server.snapshots.storage
+        # validate the untrusted manifest before fetching anything
+        if not isinstance(entries, list) or len(entries) > 10_000:
+            return False
+        clean = []
+        total = 0
+        for e in entries:
+            try:
+                name, h, length = str(e["n"]), str(e["h"]), int(e["l"])
+            except (KeyError, TypeError, ValueError):
+                return False
+            if length < 0 or not snapmod._HASH_HEX_RE.match(h):
+                return False
+            total += length
+            if total > stateser.MAX_SNAPSHOT_BYTES:
+                return False
+            clean.append({"n": name, "h": h, "l": length})
+        parts: dict = {}
+        for e in clean:
+            h, length = e["h"], e["l"]
+            data = None
+            compressed = storage.read_segment(h) if storage.has_segment(h) else None
+            if compressed is None:
+                fetched = self._fetch_segment(pid, addr, meta, h)
+                if fetched is None:
+                    return False
+                data = storage.install_segment(h, fetched, max_len=length)
+                if data is None:
+                    return False
+            else:
+                # local segment from a prior transfer: decompress for the
+                # pre-install decode check (bounded; hash verified at
+                # write time)
+                try:
+                    d = zlib.decompressobj()
+                    data = d.decompress(compressed, length + 1)
+                    if d.unconsumed_tail or len(data) != length:
+                        return False
+                except zlib.error:
+                    return False
+            if len(data) != length:
+                return False
+            parts[e["n"]] = data
+        # the fetched snapshot must decode under the data-only codec before
+        # it can ever be offered to recovery
+        try:
+            stateser.decode_state_parts(parts)
+        except stateser.SnapshotFormatError:
+            return False
+        return storage.install_manifest(meta, clean)
+
+    def _fetch_chunked(self, addr, body_base: dict):
+        """Ranged fetch of one remote blob. Returns (payload, crc-or-None)
+        or None on any protocol violation; the remote size field is never
+        trusted blindly (bounded buffering, stable across chunks)."""
+        chunks = []
+        offset = 0
+        expect_total = None
+        expect_crc = None
+        while True:
+            rsp = msgpack.unpack(
+                self.client_transport.send_request(
+                    addr,
+                    msgpack.pack({**body_base, "offset": offset}),
+                    timeout_ms=5000,
+                ).join(6)
+            )
+            if rsp.get("t") != "ok":
+                return None
+            total = int(rsp.get("total", 0))
+            if total < 0 or total > stateser.MAX_SNAPSHOT_BYTES:
+                return None
+            if expect_total is None:
+                expect_total = total
+                expect_crc = rsp.get("crc")
+            elif total != expect_total:
+                return None
+            chunk = bytes(rsp.get("chunk", b""))
+            chunks.append(chunk)
+            offset += len(chunk)
+            if offset > expect_total:
+                return None
+            if offset >= expect_total or not chunk:
+                break
+        return b"".join(chunks), expect_crc
+
+    def _fetch_segment(self, pid, addr, meta, h) -> "bytes | None":
+        got = self._fetch_chunked(addr, {
+            "t": "fetch-snapshot-segment",
+            "partition": pid,
+            "processed": meta.last_processed_position,
+            "written": meta.last_written_position,
+            "term": meta.term,
+            "h": h,
+        })
+        return None if got is None else got[0]
+
+    def _fetch_snapshot_legacy(self, pid, server, addr, meta) -> bool:
+        got = self._fetch_chunked(addr, {
+            "t": "fetch-snapshot-chunk",
+            "partition": pid,
+            "processed": meta.last_processed_position,
+            "written": meta.last_written_position,
+            "term": meta.term,
+        })
+        if got is None:
+            return False
+        payload, expect_crc = got
+        # end-to-end integrity from the leader's serve cache, then a
+        # full decode check: a fetched snapshot must be parseable by
+        # the data-only codec before it can ever be offered to recovery
+        if expect_crc is not None and zlib.crc32(payload) != int(expect_crc):
+            return False
+        try:
+            stateser.decode_state(payload)
+        except stateser.SnapshotFormatError:
+            return False
+        server.snapshots.storage.write(meta, payload)
+        return True
 
     # -- topic subscriptions over the client API ----------------------------
     def _handle_topic_subscription(self, msg: dict, conn, result: ActorFuture) -> None:
@@ -1333,6 +1515,24 @@ class ClusterBroker(Actor):
         }
         return msgpack.pack({"t": "topology-rsp", "leaders": leaders})
 
+    @staticmethod
+    def _command_responder(result: ActorFuture):
+        def on_response(f: ActorFuture):
+            if isinstance(f._exception, _AppendFailed):
+                result.complete(
+                    msgpack.pack({"t": "error", "code": "NOT_LEADER", "leader": ""})
+                )
+            elif f._exception is not None:
+                result.complete(
+                    msgpack.pack({"t": "error", "code": "INTERNAL", "message": str(f._exception)})
+                )
+            else:
+                result.complete(
+                    msgpack.pack({"t": "command-rsp", "frame": codec.encode_record(f._value)})
+                )
+
+        return on_response
+
     def _handle_command(self, msg: dict, result: ActorFuture) -> None:
         partition_id = int(msg.get("partition", 0))
         server = self.partitions.get(partition_id)
@@ -1344,6 +1544,19 @@ class ClusterBroker(Actor):
                 )
             )
             return
+        # client retries re-send a command with the SAME cid after a lost
+        # or slow response (cluster_client.send_command): answer duplicates
+        # from the original append's response future instead of appending
+        # twice — a retried CREATE must not create two instances. (Scope:
+        # per-broker; a retry that lands on a NEW leader after failover is
+        # at-least-once, as in the reference.)
+        cid = str(msg.get("cid") or "")
+        if cid:
+            with self._request_lock:
+                existing = self._cmd_dedup.get(cid)
+            if existing is not None:
+                existing.on_complete(self._command_responder(result))
+                return
         try:
             record, _ = codec.decode_record(bytes(msg.get("frame", b"")))
         except ValueError:
@@ -1358,26 +1571,27 @@ class ClusterBroker(Actor):
 
         response_future = ActorFuture()
         self._pending_responses[request_id] = response_future
+        if cid:
+            with self._request_lock:
+                self._cmd_dedup[cid] = response_future
+                while len(self._cmd_dedup) > 4096:
+                    self._cmd_dedup.pop(next(iter(self._cmd_dedup)))
 
-        def on_response(f: ActorFuture):
-            if f._exception is not None:
-                result.complete(
-                    msgpack.pack({"t": "error", "code": "INTERNAL", "message": str(f._exception)})
-                )
-            else:
-                result.complete(
-                    msgpack.pack({"t": "command-rsp", "frame": codec.encode_record(f._value)})
-                )
-
-        response_future.on_complete(on_response)
+        response_future.on_complete(self._command_responder(result))
 
         append = server.raft.append([record])
 
         def on_append(f: ActorFuture):
             if f._exception is not None:
                 self._pending_responses.pop(request_id, None)
-                result.complete(
-                    msgpack.pack({"t": "error", "code": "NOT_LEADER", "leader": ""})
+                if cid:
+                    with self._request_lock:
+                        self._cmd_dedup.pop(cid, None)
+                # complete the SHARED future, not just this request's
+                # result: retries deduped onto it must also learn
+                # NOT_LEADER instead of hanging until their timeout
+                response_future.complete_exceptionally(
+                    _AppendFailed(str(f._exception))
                 )
 
         append.on_complete(on_append)
