@@ -13,8 +13,13 @@ RUN apt-get update && apt-get install -y --no-install-recommends \
 
 WORKDIR /opt/zeebe-tpu
 COPY zeebe_tpu/ zeebe_tpu/
+COPY native/ native/
 COPY dist/ dist/
 COPY gateway-protocol/ gateway-protocol/
+
+# build the native runtime layer at image build time (not first boot):
+# [data] nativeStorage = true must work out of the box in a container
+RUN make -C native
 
 RUN pip install --no-cache-dir jax flax optax grpcio protobuf numpy
 

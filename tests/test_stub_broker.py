@@ -60,8 +60,22 @@ class TestPythonClientAgainstStub:
             client.create_instance("order-process", {})
         assert "not today" in str(e.value)
 
-    def test_dropped_response_times_out(self, stub, client):
+    def test_dropped_response_is_retried(self, stub, client):
+        """One lost response is survivable: the per-attempt timeout is a
+        fraction of the overall budget, so the client retries and the
+        second attempt answers (reference: gateway request retries)."""
         stub.drop_next("command")
+        t0 = time.monotonic()
+        record = client.create_instance("order-process", {})
+        assert record.value.workflow_instance_key > 0
+        assert time.monotonic() - t0 >= 0.9  # waited out one attempt
+        assert len(stub.requests_of("command")) == 2
+
+    def test_all_responses_dropped_times_out(self, stub, client):
+        """A dead broker exhausts the overall budget and surfaces as a
+        timeout."""
+        for _ in range(8):
+            stub.drop_next("command")
         t0 = time.monotonic()
         with pytest.raises(TransportError):
             client.create_instance("order-process", {})

@@ -68,6 +68,7 @@ def main(argv=None) -> int:
     )
     print(
         f"zeebe-tpu broker {cfg.cluster.node_id}: engine={cfg.engine.type} "
+        f"storage={'native' if cfg.data.native_storage else 'python'} "
         f"client={broker.client_address.host}:{broker.client_address.port} "
         f"gossip={broker.gossip_address.host}:{broker.gossip_address.port} "
         f"data={data_dir}",

@@ -61,6 +61,11 @@ class ClusterClient:
         )
         # partition id → leader client address
         self._leaders: Dict[int, RemoteAddress] = {}
+        # command-id namespace for server-side retry dedup
+        import uuid
+
+        self._cid_prefix = uuid.uuid4().hex[:12]
+        self._cid_counter = 0
         self._rr = itertools.count()
         self._push_handlers: Dict[int, Callable[[int, Record], None]] = {}
         self._lock = threading.Lock()
@@ -121,24 +126,40 @@ class ClusterClient:
             ),
             value=value,
         )
+        # a stable command id across retries: the broker answers a
+        # duplicate (retry after a slow/lost response) from the original
+        # append instead of appending twice
+        with self._lock:
+            self._cid_counter += 1
+            cid = f"{self._cid_prefix}:{self._cid_counter}"
         request = msgpack.pack(
             {
                 "t": "command",
                 "partition": partition,
+                "cid": cid,
                 "frame": codec.encode_record(record),
             }
         )
+        # Overall budget vs per-attempt timeout: a single stalled attempt
+        # must not consume the whole budget, or the loop never actually
+        # retries after a timeout (the leader may be transiently slow —
+        # cold jit compile, snapshotting — or freshly deposed; the retry
+        # rediscovers topology). Reference: request retries in
+        # gateway/.../impl/clustering/ClientTopologyManager.
         deadline = time.monotonic() + self.request_timeout_ms / 1000.0
+        attempt_ms = max(1_000, self.request_timeout_ms // 4)
         last_error = "no leader known"
         while time.monotonic() < deadline:
             addr = self._leader_for(partition)
             if addr is None:
                 time.sleep(0.05)
                 continue
+            remaining_ms = max(100, int((deadline - time.monotonic()) * 1000))
+            timeout_ms = min(attempt_ms, remaining_ms)
             try:
                 payload = self.transport.send_request(
-                    addr, request, timeout_ms=self.request_timeout_ms
-                ).join(self.request_timeout_ms / 1000.0 + 1)
+                    addr, request, timeout_ms=timeout_ms
+                ).join(timeout_ms / 1000.0 + 1)
                 msg = msgpack.unpack(payload)
             except (TransportError, ValueError, TimeoutError) as e:
                 last_error = str(e)
@@ -408,8 +429,17 @@ class _JobSubscriptionBase:
         self._owed_lock = threading.Lock()
         self._closed = False
         client._push_handlers[self.subscriber_key] = self._on_record
-        for pid in partitions:
-            self._subscribe(pid)
+        try:
+            for pid in partitions:
+                self._subscribe(pid)
+        except Exception:
+            # a partial open must not leak the push handler or the
+            # already-opened partition subscriptions (their credits would
+            # pull jobs into a handler nobody consumes)
+            self._closed = True
+            self._teardown_subscriptions()
+            client._push_handlers.pop(self.subscriber_key, None)
+            raise
         # reference: the client's subscription manager reopens subscriptions
         # when a partition's leader changes (topology listener); without
         # this a failover strands the worker on the old leader
@@ -521,6 +551,9 @@ class _JobSubscriptionBase:
     def close(self) -> None:
         self._closed = True
         self.client._push_handlers.pop(self.subscriber_key, None)
+        self._teardown_subscriptions()
+
+    def _teardown_subscriptions(self) -> None:
         for pid, addr in list(self._subscribed_addr.items()):
             try:
                 self.client.transport.send_request(

@@ -204,12 +204,20 @@ class GrpcGateway:
                     "job streams; close one or raise max_workers",
                 )
             self._active_streams += 1
-        stream = self.client.open_job_stream(
-            req.type,
-            worker_name=req.worker or "grpc-worker",
-            credits=req.max_jobs or 32,
-            timeout_ms=req.timeout_ms or 300_000,
-        )
+        try:
+            stream = self.client.open_job_stream(
+                req.type,
+                worker_name=req.worker or "grpc-worker",
+                credits=req.max_jobs or 32,
+                timeout_ms=req.timeout_ms or 300_000,
+            )
+        except Exception:
+            # a failed subscribe (e.g. no reachable leader during failover)
+            # must release the stream slot, or repeated failures exhaust
+            # the gateway permanently
+            with self._stream_lock:
+                self._active_streams -= 1
+            raise
         try:
             while context.is_active():
                 item = stream.take(timeout=0.2)

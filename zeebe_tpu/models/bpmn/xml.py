@@ -110,7 +110,14 @@ def read_model(
             executable=process_el.get("isExecutable", "true") == "true",
         )
         model.add(process)
-        _read_scope(model, process_el, process.id, messages_by_id, strict)
+        # strict validation applies to EXECUTABLE processes only: a
+        # collaboration's documentation-only pool (isExecutable="false")
+        # never runs, so unsupported elements there must not reject the
+        # deployment (reference validators scope to executable processes)
+        _read_scope(
+            model, process_el, process.id, messages_by_id,
+            strict and process.executable,
+        )
 
     return model
 
