@@ -221,17 +221,13 @@ def run_device_config_c4(total_instances, wave, progress):
 
     def _rebuild(st):
         # hashmap.insert only claims EMPTY buckets; per-wave delete churn
-        # (instances, timers, subscriptions) leaves tombstones that must
-        # be compacted away or probes exhaust (same cadence as config 1)
+        # (timers, subscriptions) leaves tombstones that must be compacted
+        # away or probes exhaust. ei/job lookup state (index + fallback
+        # maps) re-derives through the shared helper.
         iota = lambda a: jnp.arange(a.shape[0], dtype=jnp.int32)  # noqa: E731
+        st = state_mod.rebuild_lookup_state(st)
         return _dc.replace(
             st,
-            ei_map=hashmap.rebuild_from(
-                st.ei_map.keys.shape[0], st.ei_key, iota(st.ei_key),
-                st.ei_state >= 0)[0],
-            job_map=hashmap.rebuild_from(
-                st.job_map.keys.shape[0], st.job_key, iota(st.job_key),
-                st.job_state >= 0)[0],
             timer_map=hashmap.rebuild_from(
                 st.timer_map.keys.shape[0], st.timer_key,
                 iota(st.timer_key), st.timer_key >= 0)[0],
@@ -474,24 +470,7 @@ def run_device_config(build_fn, label, total_instances, wave, progress,
     queue = drive.make_queue(4 * wave * max(2, graph.emit_width), num_vars)
     creates = stage_creates(meta, wave, num_vars, meta.interns)
     enqueue_jit = jax.jit(drive.enqueue, donate_argnums=(0,))
-    rebuild_jit = jax.jit(
-        lambda st: _dc.replace(
-            st,
-            ei_map=hashmap.rebuild_from(
-                st.ei_map.keys.shape[0],
-                st.ei_key,
-                jnp.arange(st.ei_key.shape[0], dtype=jnp.int32),
-                st.ei_state >= 0,
-            )[0],
-            job_map=hashmap.rebuild_from(
-                st.job_map.keys.shape[0],
-                st.job_key,
-                jnp.arange(st.job_key.shape[0], dtype=jnp.int32),
-                st.job_state >= 0,
-            )[0],
-        ),
-        donate_argnums=(0,),
-    )
+    rebuild_jit = jax.jit(state_mod.rebuild_lookup_state, donate_argnums=(0,))
 
     def run_wave(state, queue, sync=True):
         queue = enqueue_jit(queue, creates)

@@ -61,20 +61,7 @@ def main():
     queue = drive.make_queue(8 * wave, num_vars)
     creates = bench.stage_creates(meta, wave, num_vars, meta.interns)
     enqueue_jit = jax.jit(drive.enqueue, donate_argnums=(0,))
-    rebuild_jit = jax.jit(
-        lambda st: dataclasses.replace(
-            st,
-            ei_map=hashmap.rebuild_from(
-                st.ei_map.keys.shape[0], st.ei_key,
-                jnp.arange(st.ei_key.shape[0], dtype=jnp.int32),
-                st.ei_state >= 0)[0],
-            job_map=hashmap.rebuild_from(
-                st.job_map.keys.shape[0], st.job_key,
-                jnp.arange(st.job_key.shape[0], dtype=jnp.int32),
-                st.job_state >= 0)[0],
-        ),
-        donate_argnums=(0,),
-    )
+    rebuild_jit = jax.jit(state_mod.rebuild_lookup_state, donate_argnums=(0,))
 
     def run_wave(state, queue, sync=True):
         queue = enqueue_jit(queue, creates)

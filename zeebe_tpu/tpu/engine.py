@@ -130,6 +130,11 @@ class TpuPartitionEngine:
         self.state = state_mod.make_state(
             capacity=capacity, num_vars=num_vars, sub_capacity=sub_capacity
         )
+        # key watermark of the last rebuild_lookup_state run: the direct-
+        # mapped indexes are collision-free only within a window of index-
+        # capacity consecutive keys, so the serving path re-derives the
+        # fallback maps before the window can wrap (process_batch)
+        self._keys_at_rebuild = 0
         self._compiled_count = 0
         self._host_only_keys: set = set()
         self._device_keys_dirty = False
@@ -607,7 +612,12 @@ class TpuPartitionEngine:
                     new_state.join_map, jo_keys, jnp.ones(jo_keys.shape, bool)
                 ),
             )
-        self.state = new_state
+        # the host-side frees above bypass the kernel's free-slot ring —
+        # re-derive it (and the lookup structures) NOW, or near capacity
+        # the ring runs dry and inserts report spurious table overflow
+        # while the freed rows sit unused until the next cadence rebuild
+        self.state = state_mod.rebuild_lookup_state(new_state)
+        self._keys_at_rebuild = 0
 
     def _routes_to_host(self, record: Record) -> bool:
         """True when a device-value-type record belongs to a host-only
@@ -993,7 +1003,13 @@ class TpuPartitionEngine:
             sub_credits=jnp.zeros_like(st.sub_credits),
             sub_valid=jnp.zeros_like(st.sub_valid),
         )
+        # derive the lookup structures from the restored rows: an old
+        # snapshot has no index arrays, a cross-backend snapshot may carry
+        # a bucket layout the local builder would not produce, and the
+        # fallback maps must cover every restored live instance
+        st = state_mod.rebuild_lookup_state(st)
         self.state = st
+        self._keys_at_rebuild = 0
         self.capacity = st.capacity
         self.num_vars = st.num_vars
         self.last_processed_position = int(
@@ -1502,6 +1518,17 @@ class TpuPartitionEngine:
             return results
         batch = self._stage([records[i] for i in live])
         now = jnp.asarray(self.clock(), jnp.int64)
+        # re-derive the fallback maps before the key window can wrap past
+        # the direct-mapped index capacity (see rebuild_lookup_state).
+        # Conservative host-side bound — one record can allocate up to
+        # emit_width keys (parallel split / multi-instance fan-out), each
+        # advancing the counter by the stride (5) — so the serving path
+        # pays no device sync.
+        fanout = max(1, self.graph.emit_width if self.graph is not None else 1)
+        self._keys_at_rebuild += 5 * fanout * len(live)
+        if self._keys_at_rebuild > self.state.ei_index.shape[0] // 4:
+            self.state = state_mod.rebuild_lookup_state(self.state)
+            self._keys_at_rebuild = 0
         self.state, out, stats = kernel.step_jit(
             self.graph, self.state, batch, now,
             partition_id=jnp.asarray(self.partition_id, jnp.int32),

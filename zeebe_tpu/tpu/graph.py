@@ -119,8 +119,15 @@ class VarSpace:
         return len(self._cols)
 
 
+# elem_meta column layout (keep in sync with the stack in compile_graph)
+(EM_TYPE, EM_FIRST_OUT, EM_FLOW_TGT, EM_START_EV, EM_OUT_COUNT,
+ EM_DEFAULT_FLOW, EM_JOIN_NIN, EM_JOIN_POS, EM_JOB_TYPE, EM_JOB_RETRIES,
+ EM_OUT_BEHAVIOR, EM_MSG_NAME, EM_CORR_VAR, EM_BD_COUNT, EM_MI_CARD,
+ EM_IN_MAP_N, EM_IN_ROOT, EM_OUT_MAP_N, EM_OUT_ROOT) = range(19)
+
 _DATA = [
     "step_table", "elem_type", "first_out_flow", "flow_target", "start_event",
+    "elem_meta",
     "out_flows", "out_count", "cond_flows", "cond_prog", "default_flow",
     "join_nin", "join_pos", "job_type", "job_retries",
     "in_map_src", "in_map_dst", "in_map_n", "in_root",
@@ -145,6 +152,11 @@ class DeviceGraph:
     # all [W, E] i32 unless noted
     step_table: jax.Array  # [W, E, NUM_WI_INTENTS]
     elem_type: jax.Array
+    # the hot-path per-element scalars packed into ONE [W, E, EM_COLS]
+    # table, so phase B/C reads are a single [B, EM_COLS] row gather
+    # instead of a dozen [B] gathers (the per-gather cost is fixed-ish,
+    # dominated by per-index issue, not bytes)
+    elem_meta: jax.Array
     first_out_flow: jax.Array        # outgoing[0] element idx, -1 none
     flow_target: jax.Array           # sequence flow → target element idx
     start_event: jax.Array           # container → its start event idx
@@ -446,9 +458,20 @@ def compile_graph(
         # multi-instance fan-out rides the fork slots
         emit_width = max(emit_width, int(mi_cardinality.max()))
 
+    import numpy as _np
+
+    elem_meta = _np.stack(
+        [_np.asarray(a, _np.int32) for a in (
+            elem_type, first_out_flow, flow_target, start_event, out_count,
+            default_flow, join_nin, join_pos, job_type, job_retries,
+            out_behavior, msg_name, corr_var, bd_count, mi_cardinality,
+            in_map_n, in_root, out_map_n, out_root,
+        )], axis=-1,
+    )
     graph = DeviceGraph(
         step_table=jnp.asarray(step_table),
         elem_type=jnp.asarray(elem_type),
+        elem_meta=jnp.asarray(elem_meta),
         first_out_flow=jnp.asarray(first_out_flow),
         flow_target=jnp.asarray(flow_target),
         start_event=jnp.asarray(start_event),

@@ -40,6 +40,7 @@ from zeebe_tpu.protocol.intents import (
     WorkflowInstanceSubscriptionIntent as WS,
 )
 from zeebe_tpu.tpu import batch as rb
+from zeebe_tpu.tpu import graph as graph_mod
 from zeebe_tpu.tpu import hashmap
 from zeebe_tpu.tpu import pallas_ops as pops
 from zeebe_tpu.tpu.batch import RecordBatch
@@ -132,6 +133,26 @@ def _last_writer(slots, mask, size):
         jnp.where(mask, rank, -1), mode="drop"
     )
     return mask & (best[jnp.clip(tgt, 0, size)] == rank)
+
+
+def _indexed_lookup(index, key_col, fallback_map, keys, want, cap):
+    """key → (found, slot) via the direct-mapped index with hashmap
+    fallback; both paths verify against the table's own key column, so
+    stale index/map entries (deleted rows, reused slots) resolve to
+    not-found without any per-round index maintenance."""
+    icap = index.shape[0]
+    cand = index[(keys & (icap - 1)).astype(jnp.int32)]
+    cand_clip = jnp.clip(cand, 0, cap - 1)
+    hit = want & (cand >= 0) & (key_col[cand_clip] == keys)
+    miss = want & ~hit
+    # fallback probe for clobbered index entries and genuinely absent
+    # keys; with no misses the probe's while_loop exits after its first
+    # condition check (cheaper than a lax.cond, whose operand copies cost
+    # more than the empty loop — measured)
+    fb_found, fb_slot = pops.lookup(fallback_map, keys, miss)
+    fb_clip = jnp.clip(fb_slot, 0, cap - 1)
+    fb_ok = miss & fb_found & (key_col[fb_clip] == keys)
+    return hit | fb_ok, jnp.where(hit, cand_clip, fb_clip)
 
 
 def _scatter_pay(pay, slots, mask, b_pay, size):
@@ -264,6 +285,8 @@ def step_kernel(
     rt, vt_, it = batch.rtype, batch.vtype, batch.intent
     wf_c = jnp.clip(batch.wf, 0, graph.elem_type.shape[0] - 1)
     el_c = jnp.clip(batch.elem, 0, graph.elem_type.shape[1] - 1)
+    # hot-path per-element scalars: ONE [B, EM_COLS] row gather
+    emeta = graph.elem_meta[wf_c, el_c]
 
     # ---------------- A. lookups ----------------
     is_wi = valid & (vt_ == VT_WI)
@@ -287,22 +310,27 @@ def step_kernel(
     )
 
     # the three element-instance lookups (record key / scope key / job
-    # activity key) probe the same table — ONE batched probe loop over the
-    # concatenated keys costs the same gather volume but a third of the
-    # serialized loop iterations
-    ei3_found, ei3_slot = pops.lookup(
-        state.ei_map,
-        jnp.concatenate([batch.key, batch.scope_key, batch.aux_key]),
-        jnp.concatenate(
-            [wi_ev, wi_ev & (batch.scope_key >= 0),
-             job_ev | timer_cmd | wisub_corr]
-        ),
+    # activity key) resolve through the direct-mapped index: keys are
+    # engine-allocated and sequential, so index[key & (cap-1)] hits for
+    # everything created within the last 8N keys; a hit is verified
+    # against the row's own key column, and the rare miss (congruent-key
+    # clobber) falls back to the per-wave-rebuilt hashmap. No per-record
+    # probe loop on the hot path (reference: ElementInstanceIndex is a
+    # Long2ObjectHashMap — this is its O(1) vectorized analogue).
+    keys3 = jnp.concatenate([batch.key, batch.scope_key, batch.aux_key])
+    want3 = jnp.concatenate(
+        [wi_ev, wi_ev & (batch.scope_key >= 0),
+         job_ev | timer_cmd | wisub_corr]
+    )
+    ei3_found, ei3_slot = _indexed_lookup(
+        state.ei_index, state.ei_key, state.ei_map, keys3, want3, n_cap
     )
     ei_found, ei_slot = ei3_found[:b], ei3_slot[:b]
     sc_found, sc_slot = ei3_found[b : 2 * b], ei3_slot[b : 2 * b]
     aik_found, aik_slot = ei3_found[2 * b :], ei3_slot[2 * b :]
-    jb_found, jb_slot = pops.lookup(
-        state.job_map, batch.key, job_cmd & (batch.key >= 0)
+    jb_found, jb_slot = _indexed_lookup(
+        state.job_index, state.job_key, state.job_map,
+        batch.key, job_cmd & (batch.key >= 0), m_cap,
     )
     if graph.has_timers:
         tm_found, tm_slot = pops.lookup(
@@ -484,7 +512,7 @@ def step_kernel(
             wi_ev & ~m_created_ev & shall & guard
             & (batch.wf >= 0) & (batch.elem >= 0)
         )
-        bd_n = graph.bd_count[wf_c, el_c]
+        bd_n = emeta[:, graph_mod.EM_BD_COUNT]
         m_arm = lifecycle_ok & (it == int(WI.ELEMENT_ACTIVATED)) & (bd_n > 0)
         m_disarm_bd = lifecycle_ok & (
             (it == int(WI.ELEMENT_COMPLETING))
@@ -581,7 +609,7 @@ def step_kernel(
         # Accepted types mirror the oracle's isinstance(corr, (str, int)):
         # strings, ints, and bools (a Python bool IS an int); floats raise
         # the same IO_MAPPING incident the oracle does
-        cvar = graph.corr_var[wf_c, el_c]
+        cvar = emeta[:, graph_mod.EM_CORR_VAR]
         cvar_c = jnp.clip(cvar, 0, v - 1)
         corr_vt_ext = batch.v_vt[rows, cvar_c].astype(jnp.int32)
         corr_bits_ext = jnp.where(
@@ -635,7 +663,7 @@ def step_kernel(
     first_true = jnp.min(jnp.where(is_true, fidx, fan), axis=1)
     first_err = jnp.min(jnp.where(is_err, fidx, fan), axis=1)
     cond_errored = first_err < first_true
-    default_f = graph.default_flow[wf_c, el_c]
+    default_f = emeta[:, graph_mod.EM_DEFAULT_FLOW]
     taken_flow = jnp.where(
         first_true < fan,
         cflow[rows, jnp.clip(first_true, 0, fan - 1)],
@@ -683,7 +711,7 @@ def step_kernel(
         out_root = jnp.zeros((b,), bool)
         out_err = jnp.zeros((b,), bool)
         om_vt, om_num, om_sid = batch.v_vt, batch.v_num, batch.v_str
-    behavior = graph.out_behavior[wf_c, el_c]
+    behavior = emeta[:, graph_mod.EM_OUT_BEHAVIOR]
     B_MERGE, B_OVERWRITE, B_NONE = 0, 1, 2
     src_present = batch.v_vt != VT_ABSENT
 
@@ -707,7 +735,7 @@ def step_kernel(
 
     # parallel join: composite key (scope_key, gateway element). Compiled
     # out for deployed sets without a joining parallel gateway.
-    gw_elem = graph.flow_target[wf_c, el_c]
+    gw_elem = emeta[:, graph_mod.EM_FLOW_TGT]
     gw_clip = jnp.clip(gw_elem, 0, graph.elem_type.shape[1] - 1)
     if graph.has_parallel_joins:
         join_key = jnp.where(
@@ -739,7 +767,7 @@ def step_kernel(
         # re-lookup so every arrival sees its slot
         jn_found2, jn_slot2 = pops.lookup(jmap, join_key, m_pmerge)
         arr_slot = jnp.clip(jn_slot2, 0, j_cap - 1)
-        my_pos = graph.join_pos[wf_c, el_c]
+        my_pos = emeta[:, graph_mod.EM_JOIN_POS]
         arrival = m_pmerge & jn_found2
         aw = jnp.where(arrival, arr_slot, j_cap)
         # dynamic column one-hot; arrivals are monotonic so a row MAX
@@ -788,7 +816,7 @@ def step_kernel(
         mg_vt, mg_num, mg_sid = batch.v_vt, batch.v_num, batch.v_str
 
     # ---------------- D. key assignment ----------------
-    out_count = graph.out_count[wf_c, el_c]
+    out_count = emeta[:, graph_mod.EM_OUT_COUNT]
     single_key = (
         m_create | m_take | xs_ok | m_actgw | m_startst | m_trigend
         | m_trigstart | completer | m_tcreate | pub_ok | open_ok
@@ -798,7 +826,7 @@ def step_kernel(
         single_key, 1,
         jnp.where(
             m_psplit, out_count,
-            jnp.where(m_mi, graph.mi_cardinality[wf_c, el_c], 0),
+            jnp.where(m_mi, emeta[:, graph_mod.EM_MI_CARD], 0),
         ),
     )
     wf_base = state.next_wf_key + _KEY_STEP * _excl_cumsum(n_wf).astype(jnp.int64)
@@ -918,7 +946,7 @@ def step_kernel(
         key=key0, elem=0, instance_key=key0, scope_key=jnp.int64(-1),
     )
 
-    first_out = graph.first_out_flow[wf_c, el_c]
+    first_out = emeta[:, graph_mod.EM_FIRST_OUT]
     e0 = put(
         e0, m_take,
         valid=True, rtype=RT_EVENT, vtype=VT_WI,
@@ -969,7 +997,7 @@ def step_kernel(
         e0, m_createjob & ~has_bd,
         valid=True, rtype=RT_CMD, vtype=VT_JOB, intent=int(JI.CREATE),
         key=jnp.int64(-1), elem=batch.elem, aux_key=batch.key,
-        type_id=graph.job_type[wf_c, el_c], retries=graph.job_retries[wf_c, el_c],
+        type_id=emeta[:, graph_mod.EM_JOB_TYPE], retries=emeta[:, graph_mod.EM_JOB_RETRIES],
     )
     e0 = put(
         e0, inmap_ok,
@@ -993,7 +1021,7 @@ def step_kernel(
         key=jnp.int64(-1), elem=batch.elem, aux_key=batch.key,
         rej=jnp.where(inmap_err, rb.ERR_IO_MAPPING_IN, rb.ERR_IO_MAPPING_OUT),
     )
-    ftarget = graph.flow_target[wf_c, el_c]
+    ftarget = emeta[:, graph_mod.EM_FLOW_TGT]
     e0 = put(
         e0, m_actgw,
         valid=True, rtype=RT_EVENT, vtype=VT_WI,
@@ -1009,7 +1037,7 @@ def step_kernel(
         valid=True, rtype=RT_EVENT, vtype=VT_WI,
         intent=int(WI.END_EVENT_OCCURRED), key=key0, elem=ftarget,
     )
-    start_ev = graph.start_event[wf_c, el_c]
+    start_ev = emeta[:, graph_mod.EM_START_EV]
     e0 = put(
         e0, m_trigstart,
         valid=True, rtype=RT_EVENT, vtype=VT_WI,
@@ -1244,7 +1272,7 @@ def step_kernel(
             e0, sub_ok & ~has_bd,
             valid=True, rtype=RT_CMD, vtype=VT_MSUB, intent=int(MS.OPEN),
             key=jnp.int64(-1), elem=batch.elem,
-            type_id=graph.msg_name[wf_c, el_c],
+            type_id=emeta[:, graph_mod.EM_MSG_NAME],
             retries=corr_vt_ext, worker=corr_bits_ext,
             instance_key=batch.instance_key, aux_key=batch.key,
             wf=pid_col,
@@ -1478,8 +1506,8 @@ def step_kernel(
             step_slot, m_createjob & has_bd,
             valid=True, rtype=RT_CMD, vtype=VT_JOB, intent=int(JI.CREATE),
             key=jnp.int64(-1), elem=batch.elem, aux_key=batch.key,
-            type_id=graph.job_type[wf_c, el_c],
-            retries=graph.job_retries[wf_c, el_c],
+            type_id=emeta[:, graph_mod.EM_JOB_TYPE],
+            retries=emeta[:, graph_mod.EM_JOB_RETRIES],
         )
         step_slot = put(
             step_slot, outmap_ok & has_bd,
@@ -1500,7 +1528,7 @@ def step_kernel(
                 step_slot, sub_ok & has_bd,
                 valid=True, rtype=RT_CMD, vtype=VT_MSUB, intent=int(MS.OPEN),
                 key=jnp.int64(-1), elem=batch.elem,
-                type_id=graph.msg_name[wf_c, el_c],
+                type_id=emeta[:, graph_mod.EM_MSG_NAME],
                 retries=corr_vt_ext, worker=corr_bits_ext,
                 instance_key=batch.instance_key, aux_key=batch.key,
                 wf=pid_col,
@@ -1514,10 +1542,10 @@ def step_kernel(
             # TERMINATE_CATCH_EVENT: close the element's own subscription
             step_slot = put(
                 step_slot,
-                m_term_catch & (graph.msg_name[wf_c, el_c] > 0)
+                m_term_catch & (emeta[:, graph_mod.EM_MSG_NAME] > 0)
                 & corr_extractable,
                 valid=True, rtype=RT_CMD, vtype=VT_MSUB, intent=int(MS.CLOSE),
-                key=jnp.int64(-1), type_id=graph.msg_name[wf_c, el_c],
+                key=jnp.int64(-1), type_id=emeta[:, graph_mod.EM_MSG_NAME],
                 retries=corr_vt_ext, worker=corr_bits_ext,
                 instance_key=batch.instance_key, aux_key=batch.key,
                 wf=pid_col,
@@ -1645,7 +1673,7 @@ def step_kernel(
         # cardinality form): one body token per iteration, each carrying
         # loopCounter = i+1; the container completes when the last body
         # token is consumed (token counting, same as the parallel join)
-        mi_card = graph.mi_cardinality[wf_c, el_c]
+        mi_card = emeta[:, graph_mod.EM_MI_CARD]
         lv = graph.mi_loop_var
         for f in range(e_w):  # emit_width covers the max cardinality
             mask_f = m_mi & (f < mi_card)
@@ -1713,7 +1741,7 @@ def step_kernel(
         # the container holds one token per body iteration
         ei_i32_arr = _col_update(
             ei_i32_arr, ei_clip, m_mi, EI_TOKENS,
-            graph.mi_cardinality[wf_c, el_c],
+            emeta[:, graph_mod.EM_MI_CARD],
         )
 
     # i64 columns operate on the planes view until the end of the phase
@@ -1745,58 +1773,91 @@ def step_kernel(
         ei_i32_arr, sc_clip, consume_completer, EI_STATE,
         int(WI.ELEMENT_COMPLETING),
     )
-    # own-instance transitions
-    ei_i32_arr = _col_update(
-        ei_i32_arr, ei_clip, inmap_ok, EI_STATE, int(WI.ELEMENT_ACTIVATED)
-    )
-    ei_pay = _scatter_pay(
-        ei_pay, ei_clip, inmap_ok, pack_payload(in_vt, in_sid, in_num), n_cap
-    )
-    # job completed → instance completing
-    ei_i32_arr = _col_update(
-        ei_i32_arr, aik_clip, jev_completed, EI_STATE,
-        int(WI.ELEMENT_COMPLETING),
-    )
-    ei_pay = _scatter_pay(ei_pay, aik_clip, jev_completed, b_pay, n_cap)
-    ei_i64_pl = _col64_update(
-        ei_i64_pl, aik_clip, jev_completed, EIL_JOB_KEY, jnp.int64(-1)
-    )
-    ei_i64_pl = _col64_update(
-        ei_i64_pl, aik_clip, jev_created & aik_found, EIL_JOB_KEY, batch.key
-    )
-    # timer trigger → instance completing (catch events only; boundary
-    # triggers take the terminate/continue path below)
-    ei_i32_arr = _col_update(
-        ei_i32_arr, aik_clip, ttrig_catch, EI_STATE, int(WI.ELEMENT_COMPLETING)
-    )
-
+    # -- own-row transitions, ONE composed scatter per dtype family -------
+    # Every record is exactly one step kind (the guard predicates are
+    # mutually exclusive per record, and the no-concurrent-transition
+    # guards exclude two records transitioning the same instance row in
+    # one round), so the per-kind column writes compose into a single
+    # select-by-kind row scatter instead of one scatter per kind — the
+    # profiled cost is per-op, and this section was ~9 ops.
     if graph.has_boundaries:
-        # interrupting boundary trigger: host → TERMINATING with the
-        # pending boundary element recorded (the oracle's _pending_boundary)
         bd_int_any = ttrig_bd_int | corr_bd_int
-        ei_i32_arr = _cols_update(
-            ei_i32_arr, aik_clip, bd_int_any,
-            (EI_STATE, EI_PENDING_BD),
-            (int(WI.ELEMENT_TERMINATING),
-             jnp.where(ttrig_bd_int, trig_elem, corr_bd_elem)),
-        )
-        # message-boundary interruption stores the MESSAGE payload as the
-        # pending continuation payload (timer boundaries continue with the
-        # instance payload, already in ei_pay)
-        ei_pay = _scatter_pay(ei_pay, aik_clip, corr_bd_int, b_pay, n_cap)
-        # TERMINATING step processed → TERMINATED written, state advances
         term_all = m_term_job | m_term_catch | m_term_elem
-        ei_i32_arr = _col_update(
-            ei_i32_arr, ei_clip, term_all, EI_STATE, int(WI.ELEMENT_TERMINATED)
-        )
-
-    # removals (final states written this round)
+    else:
+        bd_int_any = jnp.zeros((b,), bool)
+        term_all = jnp.zeros((b,), bool)
     ei_remove = outmap_ok | m_complete_proc | m_bd_continue
-    ei_i32_arr = _col_update(ei_i32_arr, ei_clip, ei_remove, EI_STATE, -1)
-    ei_i64_pl = _col64_update(
-        ei_i64_pl, ei_clip, ei_remove, EIL_KEY, jnp.int64(-1)
+
+    own_is_aik = jev_completed | ttrig_catch | bd_int_any
+    own_slot = jnp.where(own_is_aik, aik_clip, ei_clip)
+    completing = jev_completed | ttrig_catch
+    own_state_m = inmap_ok | completing | bd_int_any | term_all | ei_remove
+    own_state_v = jnp.where(
+        ei_remove, jnp.int32(-1),                      # removal wins last
+        jnp.where(
+            term_all, jnp.int32(int(WI.ELEMENT_TERMINATED)),
+            jnp.where(
+                bd_int_any, jnp.int32(int(WI.ELEMENT_TERMINATING)),
+                jnp.where(
+                    completing, jnp.int32(int(WI.ELEMENT_COMPLETING)),
+                    jnp.int32(int(WI.ELEMENT_ACTIVATED)),
+                ),
+            ),
+        ),
     )
-    ei_map = pops.delete(state.ei_map, batch.key, ei_remove)
+    own_vals = jnp.zeros((b, ei_i32_arr.shape[1]), jnp.int32)
+    own_mask = jnp.zeros((b, ei_i32_arr.shape[1]), bool)
+    own_vals = own_vals.at[:, EI_STATE].set(own_state_v)
+    own_mask = own_mask.at[:, EI_STATE].set(own_state_m)
+    if graph.has_boundaries:
+        # pending boundary element recorded with the TERMINATING write
+        own_vals = own_vals.at[:, EI_PENDING_BD].set(
+            jnp.where(ttrig_bd_int, trig_elem, corr_bd_elem)
+        )
+        own_mask = own_mask.at[:, EI_PENDING_BD].set(bd_int_any)
+    own_active = own_state_m
+    ei_i32_arr = pops.masked_row_update(
+        ei_i32_arr, own_slot, own_active, own_vals, own_mask
+    )
+
+    # own-row payloads: input mapping writes the mapped document, job
+    # completion / message-boundary interruption write the record payload
+    own_pay_m = inmap_ok | jev_completed | (corr_bd_int if graph.has_boundaries
+                                            else jnp.zeros((b,), bool))
+    inmap_pay = pack_payload(in_vt, in_sid, in_num)
+    own_pay = jnp.where(inmap_ok[:, None], inmap_pay, b_pay)
+    ei_pay = _scatter_pay(ei_pay, own_slot, own_pay_m, own_pay, n_cap)
+
+    # own-row i64 columns (job-key attach/detach, removal key clear)
+    jobkey_m = jev_completed | (jev_created & aik_found)
+    jobkey_v = jnp.where(jev_completed, jnp.int64(-1), batch.key)
+    ei64_slot = jnp.where(jobkey_m, aik_clip, ei_clip)
+    v2 = pops.vec64_to_planes(jobkey_v)
+    neg2 = pops.vec64_to_planes(jnp.full((b,), -1, jnp.int64))
+    ei64_vals = jnp.zeros((b, ei_i64_pl.shape[1]), jnp.int32)
+    ei64_mask = jnp.zeros((b, ei_i64_pl.shape[1]), bool)
+    ei64_vals = ei64_vals.at[:, 2 * EIL_JOB_KEY].set(v2[:, 0])
+    ei64_vals = ei64_vals.at[:, 2 * EIL_JOB_KEY + 1].set(v2[:, 1])
+    ei64_mask = ei64_mask.at[:, 2 * EIL_JOB_KEY].set(jobkey_m)
+    ei64_mask = ei64_mask.at[:, 2 * EIL_JOB_KEY + 1].set(jobkey_m)
+    ei64_vals = jnp.where(
+        (ei_remove & ~jobkey_m)[:, None],
+        jnp.zeros_like(ei64_vals).at[:, 2 * EIL_KEY].set(neg2[:, 0])
+        .at[:, 2 * EIL_KEY + 1].set(neg2[:, 1]),
+        ei64_vals,
+    )
+    ei64_mask = jnp.where(
+        (ei_remove & ~jobkey_m)[:, None],
+        jnp.zeros_like(ei64_mask).at[:, 2 * EIL_KEY].set(True)
+        .at[:, 2 * EIL_KEY + 1].set(True),
+        ei64_mask,
+    )
+    ei_i64_pl = pops.masked_row_update(
+        ei_i64_pl, ei64_slot, jobkey_m | ei_remove, ei64_vals, ei64_mask
+    )
+    # no map delete: the removed row's key column is cleared above, and
+    # every lookup verifies against it — stale index/map entries are inert
+    ei_map = state.ei_map
 
     # inserts: CREATE command roots + START_STATEFUL children (+ replayed
     # CREATED events whose instance is missing)
@@ -1808,10 +1869,29 @@ def step_kernel(
     ins_elem = jnp.where(ins_root, 0, jnp.where(ins_child, ftarget, batch.elem))
     ins_parent = jnp.where(ins_child, sc_slot, -1)
     ins_ikey = jnp.where(ins_root, key0, batch.instance_key)
-    free = _first_true_indices(state.ei_state < 0, b)
+    # free-slot ring pop (replaces the full-table free scan): slots freed
+    # this round enter at push and are never re-allocated in the same
+    # round (matches the old scan, which read round-start state)
     ins_rank = _excl_cumsum(ins.astype(jnp.int32))
-    ins_slot = free[jnp.clip(ins_rank, 0, b - 1)]
-    ei_overflow = jnp.any(ins & (ins_slot >= n_cap))
+    ei_pop_idx = state.free_ei_pop + ins_rank.astype(jnp.int64)
+    ei_ring_ok = ei_pop_idx < state.free_ei_push
+    ins_slot = jnp.where(
+        ins & ei_ring_ok,
+        state.free_ei[(ei_pop_idx % n_cap).astype(jnp.int32)],
+        n_cap,
+    ).astype(jnp.int32)
+    ei_overflow = jnp.any(ins & ~ei_ring_ok)
+    free_ei_pop_new = state.free_ei_pop + jnp.sum(ins, dtype=jnp.int64)
+    # dedup pushes per slot: two removal records for the same row in one
+    # batch (e.g. a client-retried command) must free the slot ONCE, or
+    # the ring later hands the row to two inserts
+    ei_push_m = _last_writer(ei_clip, ei_remove, n_cap)
+    ei_rm_rank = _excl_cumsum(ei_push_m.astype(jnp.int32))
+    ei_push_idx = state.free_ei_push + ei_rm_rank.astype(jnp.int64)
+    free_ei_arr = state.free_ei.at[
+        jnp.where(ei_push_m, (ei_push_idx % n_cap).astype(jnp.int32), n_cap)
+    ].set(ei_clip, mode="drop")
+    free_ei_push_new = state.free_ei_push + jnp.sum(ei_push_m, dtype=jnp.int64)
     # one row pass per dtype group (the point of the packed layout)
     ei_i32_rows = jnp.stack(
         [ins_elem,
@@ -1827,15 +1907,24 @@ def step_kernel(
         ei_i64_pl, ins_slot, ins, pops.i64_to_planes(ei_i64_rows)
     )
     ei_pay = pops.masked_row_update(ei_pay, ins_slot, ins, b_pay)
-    ei_map, ei_ins_ok = pops.insert(ei_map, ins_key, ins_slot, ins)
+    ei_icap = state.ei_index.shape[0]
+    ei_index_arr = state.ei_index.at[
+        jnp.where(ins, ins_key & (ei_icap - 1), ei_icap).astype(jnp.int32)
+    ].set(ins_slot, mode="drop")
     ei_i64_arr = pops.planes_to_i64(ei_i64_pl)
 
     # ---------------- job table ----------------
     job_ins = m_jcreate
-    jfree = _first_true_indices(state.job_state < 0, b)
     j_rank = _excl_cumsum(job_ins.astype(jnp.int32))
-    j_slot = jfree[jnp.clip(j_rank, 0, b - 1)]
-    job_overflow = jnp.any(job_ins & (j_slot >= m_cap))
+    job_pop_idx = state.free_job_pop + j_rank.astype(jnp.int64)
+    job_ring_ok = job_pop_idx < state.free_job_push
+    j_slot = jnp.where(
+        job_ins & job_ring_ok,
+        state.free_job[(job_pop_idx % m_cap).astype(jnp.int32)],
+        m_cap,
+    ).astype(jnp.int32)
+    job_overflow = jnp.any(job_ins & ~job_ring_ok)
+    free_job_pop_new = state.free_job_pop + jnp.sum(job_ins, dtype=jnp.int64)
     job_i32_rows = jnp.stack(
         [jnp.full((b,), int(JI.CREATED), jnp.int32),
          batch.elem, batch.wf, batch.type_id, batch.retries,
@@ -1853,42 +1942,78 @@ def step_kernel(
         job_i64_pl, j_slot, job_ins, pops.i64_to_planes(job_i64_rows)
     )
     job_pay_arr = pops.masked_row_update(state.job_pay, j_slot, job_ins, b_pay)
-    job_map, job_ins_ok = pops.insert(state.job_map, job_base, j_slot, job_ins)
+    job_icap = state.job_index.shape[0]
+    job_index_arr = state.job_index.at[
+        jnp.where(job_ins, job_base & (job_icap - 1), job_icap).astype(jnp.int32)
+    ].set(j_slot, mode="drop")
+    job_map = state.job_map
 
-    # transitions: multi-column updates share one pass per dtype group
-    job_i32_arr = _cols_update(
-        job_i32_arr, jb_clip, jact_ok,
-        (JB_STATE, JB_WORKER, JB_RETRIES),
-        (int(JI.ACTIVATED), batch.worker, batch.retries),
-    )
-    job_i64_pl = _col64_update(
-        job_i64_pl, jb_clip, jact_ok, JBL_DEADLINE, batch.deadline
-    )
-    job_pay_arr = pops.masked_row_update(job_pay_arr, jb_clip, jact_ok, b_pay)
-
-    job_i32_arr = _cols_update(
-        job_i32_arr, jb_clip, jfail_ok,
-        (JB_STATE, JB_RETRIES),
-        (int(JI.FAILED), batch.retries),
-    )
-    job_pay_arr = pops.masked_row_update(
-        job_pay_arr, jb_clip, jfail_ok,
-        pack_payload(fail_vt, fail_sid, fail_num),
-    )
-
-    job_i32_arr = _col_update(
-        job_i32_arr, jb_clip, jtime_ok, JB_STATE, int(JI.TIMED_OUT)
-    )
-    job_i32_arr = _col_update(
-        job_i32_arr, jb_clip, jret_ok, JB_RETRIES, batch.retries
-    )
+    # transitions: every record is one job step kind and all kinds target
+    # jb_clip, so the per-kind column writes compose into ONE row scatter
+    # per dtype family (select-by-kind values)
     job_rm = jcomp_ok | jcan_ok
-    job_i32_arr = _col_update(job_i32_arr, jb_clip, job_rm, JB_STATE, -1)
-    job_i64_pl = _col64_update(
-        job_i64_pl, jb_clip, job_rm, JBL_KEY, jnp.int64(-1)
+    jstate_m = jact_ok | jfail_ok | jtime_ok | job_rm
+    jstate_v = jnp.where(
+        job_rm, jnp.int32(-1),
+        jnp.where(
+            jtime_ok, jnp.int32(int(JI.TIMED_OUT)),
+            jnp.where(
+                jfail_ok, jnp.int32(int(JI.FAILED)),
+                jnp.int32(int(JI.ACTIVATED)),
+            ),
+        ),
     )
-    job_map = pops.delete(job_map, batch.key, job_rm)
+    jretries_m = jact_ok | jfail_ok | jret_ok
+    jb_vals = jnp.zeros((b, job_i32_arr.shape[1]), jnp.int32)
+    jb_mask = jnp.zeros((b, job_i32_arr.shape[1]), bool)
+    jb_vals = jb_vals.at[:, JB_STATE].set(jstate_v)
+    jb_mask = jb_mask.at[:, JB_STATE].set(jstate_m)
+    jb_vals = jb_vals.at[:, JB_RETRIES].set(batch.retries)
+    jb_mask = jb_mask.at[:, JB_RETRIES].set(jretries_m)
+    jb_vals = jb_vals.at[:, JB_WORKER].set(batch.worker)
+    jb_mask = jb_mask.at[:, JB_WORKER].set(jact_ok)
+    job_i32_arr = pops.masked_row_update(
+        job_i32_arr, jb_clip, jstate_m | jret_ok, jb_vals, jb_mask
+    )
+
+    jd2 = pops.vec64_to_planes(batch.deadline)
+    jneg2 = pops.vec64_to_planes(jnp.full((b,), -1, jnp.int64))
+    j64_vals = jnp.zeros((b, job_i64_pl.shape[1]), jnp.int32)
+    j64_mask = jnp.zeros((b, job_i64_pl.shape[1]), bool)
+    j64_vals = j64_vals.at[:, 2 * JBL_DEADLINE].set(jd2[:, 0])
+    j64_vals = j64_vals.at[:, 2 * JBL_DEADLINE + 1].set(jd2[:, 1])
+    j64_mask = j64_mask.at[:, 2 * JBL_DEADLINE].set(jact_ok)
+    j64_mask = j64_mask.at[:, 2 * JBL_DEADLINE + 1].set(jact_ok)
+    j64_vals = jnp.where(
+        job_rm[:, None],
+        jnp.zeros_like(j64_vals).at[:, 2 * JBL_KEY].set(jneg2[:, 0])
+        .at[:, 2 * JBL_KEY + 1].set(jneg2[:, 1]),
+        j64_vals,
+    )
+    j64_mask = jnp.where(
+        job_rm[:, None],
+        jnp.zeros_like(j64_mask).at[:, 2 * JBL_KEY].set(True)
+        .at[:, 2 * JBL_KEY + 1].set(True),
+        j64_mask,
+    )
+    job_i64_pl = pops.masked_row_update(
+        job_i64_pl, jb_clip, jact_ok | job_rm, j64_vals, j64_mask
+    )
+
+    jpay_m = jact_ok | jfail_ok
+    jpay = jnp.where(
+        jfail_ok[:, None], pack_payload(fail_vt, fail_sid, fail_num), b_pay
+    )
+    job_pay_arr = pops.masked_row_update(job_pay_arr, jb_clip, jpay_m, jpay)
     job_i64_arr = pops.planes_to_i64(job_i64_pl)
+    # dedup per slot (see the ei ring push)
+    job_push_m = _last_writer(jb_clip, job_rm, m_cap)
+    job_rm_rank = _excl_cumsum(job_push_m.astype(jnp.int32))
+    job_push_idx = state.free_job_push + job_rm_rank.astype(jnp.int64)
+    free_job_arr = state.free_job.at[
+        jnp.where(job_push_m, (job_push_idx % m_cap).astype(jnp.int32), m_cap)
+    ].set(jb_clip, mode="drop")
+    free_job_push_new = state.free_job_push + jnp.sum(job_push_m, dtype=jnp.int64)
 
     # ---------------- join cleanup ----------------
     if graph.has_parallel_joins:
@@ -2111,9 +2236,13 @@ def step_kernel(
 
     new_state = EngineState(
         ei_i32=ei_i32_arr, ei_i64=ei_i64_arr,
-        ei_pay=ei_pay, ei_map=ei_map,
+        ei_pay=ei_pay, ei_map=ei_map, ei_index=ei_index_arr,
+        free_ei=free_ei_arr, free_ei_pop=free_ei_pop_new,
+        free_ei_push=free_ei_push_new,
         job_i32=job_i32_arr, job_i64=job_i64_arr,
-        job_pay=job_pay_arr, job_map=job_map,
+        job_pay=job_pay_arr, job_map=job_map, job_index=job_index_arr,
+        free_job=free_job_arr, free_job_pop=free_job_pop_new,
+        free_job_push=free_job_push_new,
         join_key=join_key_arr, join_nin=join_nin_arr, join_arrived=arrived,
         join_pay=join_pay, join_pos_stamp=stamp, join_map=join_map,
         timer_key=timer_key_arr, timer_due=timer_due_arr,
@@ -2143,7 +2272,6 @@ def step_kernel(
         "overflow": (
             ei_overflow | job_overflow | join_overflow | timer_overflow
             | message_overflow
-            | ~jnp.all(ei_ins_ok == ins) | ~jnp.all(job_ins_ok == job_ins)
         ),
     }
     return new_state, out, stats

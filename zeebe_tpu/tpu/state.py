@@ -69,8 +69,10 @@ def corr_composite(name_id, corr_vt, corr_bits):
     )
 
 _STATE_FIELDS = [
-    "ei_i32", "ei_i64", "ei_pay", "ei_map",
-    "job_i32", "job_i64", "job_pay", "job_map",
+    "ei_i32", "ei_i64", "ei_pay", "ei_map", "ei_index",
+    "free_ei", "free_ei_pop", "free_ei_push",
+    "job_i32", "job_i64", "job_pay", "job_map", "job_index",
+    "free_job", "free_job_pop", "free_job_push",
     "join_key", "join_nin", "join_arrived", "join_pay",
     "join_pos_stamp", "join_map",
     "timer_key", "timer_due", "timer_aik", "timer_instance_key", "timer_elem",
@@ -127,7 +129,23 @@ class EngineState:
     ei_i32: jax.Array          # [N, 6] i32
     ei_i64: jax.Array          # [N, 3] i64
     ei_pay: jax.Array          # [N, 3V] i32 packed payload (vt | sid | f32 bits)
-    ei_map: hashmap.HashTable  # key → slot
+    ei_map: hashmap.HashTable  # key → slot (FALLBACK; see ei_index)
+    # Direct-mapped key → slot accelerator: keys are allocated
+    # sequentially by this engine, so ``index[key & (cap-1)]`` is
+    # collision-free within any window of ``cap`` consecutive keys. A hit
+    # is verified against the row's own key column; misses (an old live
+    # instance whose congruent-mod-cap successor overwrote the entry)
+    # fall back to the hashmap probe, which is rebuilt from live rows at
+    # wave boundaries rather than maintained per round — the per-round
+    # probe/insert/delete machinery was the largest profiled cost class.
+    ei_index: jax.Array        # [8N] i32 slot, -1 empty
+    # free-slot ring (replaces the per-round full-table free scan): pop
+    # cursor hands out ring[(pop+rank) % N], frees append at push; both
+    # cursors are monotonic i64, free count = push - pop. Rebuilt with the
+    # lookup state (host-side frees — demotions — re-enter the ring then).
+    free_ei: jax.Array         # [N] i32 ring of free slots
+    free_ei_pop: jax.Array     # i64 scalar
+    free_ei_push: jax.Array    # i64 scalar
 
     # jobs [M], packed: job_i32 cols = (state[-1 free], elem, wf, type,
     # retries, worker); job_i64 cols = (key[-1 free], instanceKey, aik,
@@ -135,7 +153,11 @@ class EngineState:
     job_i32: jax.Array         # [M, 6] i32
     job_i64: jax.Array         # [M, 4] i64
     job_pay: jax.Array         # [M, 3V] i32 packed payload
-    job_map: hashmap.HashTable
+    job_map: hashmap.HashTable  # fallback (see ei_index)
+    job_index: jax.Array       # [8M] i32 slot, -1 empty
+    free_job: jax.Array        # [M] i32
+    free_job_pop: jax.Array    # i64
+    free_job_push: jax.Array   # i64
 
     # parallel joins [J]
     join_key: jax.Array        # i64 composite (scope_key<<8 | gateway), -1 free
@@ -266,11 +288,19 @@ def make_state(
         ei_i64=jnp.full((n, 3), -1, i64),
         ei_pay=jnp.zeros((n, 3 * v), i32),
         ei_map=hashmap.make(_pow2(8 * n)),
+        ei_index=jnp.full((_pow2(8 * n),), -1, i32),
+        free_ei=jnp.arange(n, dtype=i32),
+        free_ei_pop=jnp.zeros((), i64),
+        free_ei_push=jnp.asarray(n, i64),
         # job_i32: state=-1, elem/wf/type/retries/worker=0
         job_i32=jnp.tile(jnp.array([[-1, 0, 0, 0, 0, 0]], i32), (m, 1)),
         job_i64=jnp.full((m, 4), -1, i64),
         job_pay=jnp.zeros((m, 3 * v), i32),
         job_map=hashmap.make(_pow2(8 * m)),
+        job_index=jnp.full((_pow2(8 * m),), -1, i32),
+        free_job=jnp.arange(m, dtype=i32),
+        free_job_pop=jnp.zeros((), i64),
+        free_job_push=jnp.asarray(m, i64),
         join_key=jnp.full((j,), -1, i64),
         join_nin=jnp.zeros((j,), i32),
         join_arrived=jnp.zeros((j, max_join_in), bool),
@@ -304,3 +334,70 @@ def make_state(
         next_wf_key=jnp.array(keyspace.WF_OFFSET, i64),
         next_job_key=jnp.array(keyspace.JOB_OFFSET, i64),
     )
+
+
+def rebuild_lookup_state(state: EngineState) -> EngineState:
+    """Recompute the key→slot indexes and fallback hashmaps from live
+    table rows.
+
+    Run at wave boundaries (drive entry), at snapshot restore, and on the
+    engine's key-advance cadence — NOT per round: in-round lookups resolve
+    through the direct-mapped index (rows created this wave are always
+    index-hits, the index is collision-free within a window of 8N
+    consecutive keys), and stale map/index entries are harmless because
+    every lookup verifies the row's own key column. The invariant this
+    maintains: the fallback map covers every instance live at the last
+    rebuild."""
+    import dataclasses as _dc
+
+    import jax.numpy as _jnp
+
+    n = state.ei_i32.shape[0]
+    m = state.job_i32.shape[0]
+    icap = state.ei_index.shape[0]
+    jcap = state.job_index.shape[0]
+    ei_live = state.ei_state >= 0
+    job_live = state.job_state >= 0
+    ei_idx = (
+        _jnp.full((icap,), -1, _jnp.int32)
+        .at[_jnp.where(ei_live, state.ei_key & (icap - 1), icap).astype(_jnp.int32)]
+        .set(_jnp.arange(n, dtype=_jnp.int32), mode="drop")
+    )
+    job_idx = (
+        _jnp.full((jcap,), -1, _jnp.int32)
+        .at[_jnp.where(job_live, state.job_key & (jcap - 1), jcap).astype(_jnp.int32)]
+        .set(_jnp.arange(m, dtype=_jnp.int32), mode="drop")
+    )
+    ei_map, _ = hashmap.rebuild_from(
+        state.ei_map.keys.shape[0], state.ei_key,
+        _jnp.arange(n, dtype=_jnp.int32), ei_live,
+    )
+    job_map, _ = hashmap.rebuild_from(
+        state.job_map.keys.shape[0], state.job_key,
+        _jnp.arange(m, dtype=_jnp.int32), job_live,
+    )
+    ei_free_mask = ~ei_live
+    job_free_mask = ~job_live
+    ei_rank = _jnp.cumsum(ei_free_mask.astype(_jnp.int32)) - ei_free_mask
+    job_rank = _jnp.cumsum(job_free_mask.astype(_jnp.int32)) - job_free_mask
+    free_ei = (
+        _jnp.full((n,), n, _jnp.int32)
+        .at[_jnp.where(ei_free_mask, ei_rank, n)]
+        .set(_jnp.arange(n, dtype=_jnp.int32), mode="drop")
+    )
+    free_job = (
+        _jnp.full((m,), m, _jnp.int32)
+        .at[_jnp.where(job_free_mask, job_rank, m)]
+        .set(_jnp.arange(m, dtype=_jnp.int32), mode="drop")
+    )
+    return _dc.replace(
+        state, ei_index=ei_idx, job_index=job_idx,
+        ei_map=ei_map, job_map=job_map,
+        free_ei=free_ei,
+        free_ei_pop=_jnp.zeros((), _jnp.int64),
+        free_ei_push=_jnp.sum(ei_free_mask, dtype=_jnp.int64),
+        free_job=free_job,
+        free_job_pop=_jnp.zeros((), _jnp.int64),
+        free_job_push=_jnp.sum(job_free_mask, dtype=_jnp.int64),
+    )
+
