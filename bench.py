@@ -220,24 +220,9 @@ def run_device_config_c4(total_instances, wave, progress):
     from zeebe_tpu.tpu import hashmap
 
     def _rebuild(st):
-        # hashmap.insert only claims EMPTY buckets; per-wave delete churn
-        # (timers, subscriptions) leaves tombstones that must be compacted
-        # away or probes exhaust. ei/job lookup state (index + fallback
-        # maps) re-derives through the shared helper.
-        iota = lambda a: jnp.arange(a.shape[0], dtype=jnp.int32)  # noqa: E731
-        st = state_mod.rebuild_lookup_state(st)
-        return _dc.replace(
-            st,
-            timer_map=hashmap.rebuild_from(
-                st.timer_map.keys.shape[0], st.timer_key,
-                iota(st.timer_key), st.timer_key >= 0)[0],
-            msub_map=hashmap.rebuild_from(
-                st.msub_map.keys.shape[0], st.msub_ckey,
-                iota(st.msub_ckey), st.msub_ckey >= 0)[0],
-            msg_map=hashmap.rebuild_from(
-                st.msg_map.keys.shape[0], st.msg_ckey,
-                iota(st.msg_ckey), st.msg_key >= 0)[0],
-        )
+        # full lookup-state re-derivation (indexes, fallback maps, free
+        # rings, and tombstone compaction of the in-round-maintained maps)
+        return state_mod.rebuild_lookup_state(st)
 
     rebuild_jit = jax.jit(_rebuild, donate_argnums=(0,))
 
@@ -597,7 +582,10 @@ def main():
                 )
         except OSError:
             flags = platform.machine()
-        fp = hashlib.sha256(str(flags).encode()).hexdigest()[:12]
+        import jaxlib
+
+        tag = f"{flags}|jax={jax.__version__}|jaxlib={jaxlib.__version__}"
+        fp = hashlib.sha256(tag.encode()).hexdigest()[:12]
         cache_dir = os.path.join(
             os.path.dirname(os.path.abspath(__file__)),
             ".jax_cache",

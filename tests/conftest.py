@@ -34,6 +34,14 @@ import platform
 
 
 def _machine_fingerprint() -> str:
+    # the fingerprint must cover the COMPILER, not just the CPU: XLA:CPU
+    # AOT entries written by a different jax/jaxlib build carry target
+    # configs the current loader only warns about ("machine feature
+    # +prefer-no-scatter is not supported ... could lead to SIGILL") and
+    # executing them can kill broker threads mid-test — observed as the
+    # round-4 "leader connection refused" flake class
+    import jaxlib
+
     try:
         with open("/proc/cpuinfo") as f:
             flags = next(
@@ -41,7 +49,8 @@ def _machine_fingerprint() -> str:
             )
     except OSError:
         flags = platform.machine()
-    return hashlib.sha256(str(flags).encode()).hexdigest()[:12]
+    tag = f"{flags}|jax={jax.__version__}|jaxlib={jaxlib.__version__}"
+    return hashlib.sha256(tag.encode()).hexdigest()[:12]
 
 
 jax.config.update(

@@ -444,6 +444,8 @@ class ClusterBroker(Actor):
         # client-command dedup: cid → response future of the first append
         # (bounded FIFO; see _handle_command)
         self._cmd_dedup: Dict[str, ActorFuture] = {}
+        # partition id → in-flight device due-probe (see _tick_engines)
+        self._due_probes: Dict[int, object] = {}
         self._next_request_id = 0
         self._push_listeners: Dict[int, Callable[[int, Record], None]] = {}
         self._request_lock = threading.Lock()
@@ -646,7 +648,12 @@ class ClusterBroker(Actor):
             self.actor.run(lambda: self._handle_command(msg, result))
             return result
         if t == "topology":
-            return self.actor.call(self._handle_topology_request)
+            # answered inline on the transport thread: topology state has
+            # its own lock, and the broker actor can be busy for the whole
+            # duration of a cold device-kernel compile — every 2s-timeout
+            # topology probe would fail, and clients see "no leader known"
+            # while the leader is merely warming up
+            return self._handle_topology_request()
         if t == "job-subscription":
             result = ActorFuture()
             self.actor.run(lambda: self._handle_job_subscription(msg, conn, result))
@@ -1509,9 +1516,15 @@ class ClusterBroker(Actor):
                 continue
 
     def _handle_topology_request(self) -> bytes:
+        with self.topology._lock:
+            entries = dict(self.topology.partition_leaders)
         leaders = {
-            str(pid): {"node": entry[0], "addr": entry[1], "term": entry[2]}
-            for pid, entry in self.topology.partition_leaders.items()
+            str(pid): {
+                "node": entry[0],
+                "addr": entry[1],
+                "term": entry[3] if len(entry) > 3 else entry[2],
+            }
+            for pid, entry in entries.items()
         }
         return msgpack.pack({"t": "topology-rsp", "leaders": leaders})
 
@@ -1795,14 +1808,34 @@ class ClusterBroker(Actor):
 
     def _tick_engines(self) -> None:
         """Timer/TTL sweeps on leader partitions (reference periodic actor
-        jobs: JobTimeOutStreamProcessor, MessageTimeToLiveChecker)."""
+        jobs: JobTimeOutStreamProcessor, MessageTimeToLiveChecker).
+
+        The full sweep transfers whole table columns device→host; over a
+        tunneled TPU every sync costs ~150ms+, and at the 100ms tick rate
+        the blocking sweep starves the broker actor (observed: client
+        requests timing out while the actor sat in np.asarray). Engines
+        exposing an async due-probe are polled WITHOUT blocking: the tick
+        only pays the full sweep when a ready probe says something is due."""
         for server in self.partitions.values():
             if not server.is_leader or server.engine is None:
                 continue
+            engine = server.engine
+            probe_fn = getattr(engine, "deadlines_due_probe", None)
+            if probe_fn is not None:
+                pending = self._due_probes.get(server.partition_id)
+                if pending is None:
+                    self._due_probes[server.partition_id] = probe_fn()
+                    continue
+                if not pending.is_ready():
+                    continue  # still in flight; poll again next tick
+                due = bool(pending)
+                self._due_probes[server.partition_id] = probe_fn()
+                if not due:
+                    continue
             commands = (
-                server.engine.check_job_deadlines()
-                + server.engine.check_timer_deadlines()
-                + server.engine.check_message_ttls()
+                engine.check_job_deadlines()
+                + engine.check_timer_deadlines()
+                + engine.check_message_ttls()
             )
             if commands:
                 server.raft.append(commands)

@@ -39,7 +39,7 @@ def engine_factory_from_config(
                 from zeebe_tpu.tpu import pallas_ops
 
                 pallas_ops.selfcheck()
-            return TpuPartitionEngine(
+            engine = TpuPartitionEngine(
                 partition_id,
                 broker.cfg.cluster.partitions,
                 repository=broker.repository,
@@ -48,6 +48,15 @@ def engine_factory_from_config(
                 num_vars=num_vars,
                 sub_capacity=sub_capacity,
             )
+            import jax as _jax
+
+            if _jax.default_backend() == "tpu":
+                # pay the kernel compiles at install time, not on the
+                # first served batch (which blocks the broker actor and
+                # times out every client request) — off-TPU compiles are
+                # fast and tests deploy immediately, so skip there
+                engine.warm()
+            return engine
 
         return factory
     raise ValueError(

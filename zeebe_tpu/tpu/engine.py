@@ -791,6 +791,26 @@ class TpuPartitionEngine:
         )
 
     # -- deadline scans (broker tick) --------------------------------------
+    def deadlines_due_probe(self):
+        """Device bool scalar: is ANY device-side job/timer/message
+        deadline due now? The broker launches this and polls
+        ``is_ready()`` without blocking — the full column sweeps below
+        each cost a device→host sync (~150ms+ over a tunneled chip) and
+        would starve the broker actor at the tick rate. Host-oracle
+        deadlines are NOT covered: the broker sweeps those (cheap dict
+        scans) every tick via ``host_deadline_commands``."""
+        now = jnp.asarray(self.clock(), jnp.int64)
+        return _due_probe_jit(self.state, now)
+
+    def host_deadline_commands(self) -> List[Record]:
+        """The embedded oracle's due commands only (same per-family key
+        order the merged sweeps produce when the device side is empty)."""
+        return (
+            sorted(self._host.check_job_deadlines(), key=lambda r: r.key)
+            + sorted(self._host.check_timer_deadlines(), key=lambda r: r.key)
+            + sorted(self._host.check_message_ttls(), key=lambda r: r.key)
+        )
+
     def check_job_deadlines(self) -> List[Record]:
         now = self.clock()
         s = self.state
@@ -1291,9 +1311,18 @@ class TpuPartitionEngine:
         return out
 
     # -- host record → batch row -------------------------------------------
-    def _stage(self, records: List[Record]) -> RecordBatch:
+    _TPU_BATCH = 512  # one canonical staged shape on TPU (= drain chunk)
+
+    def _stage(self, records: List[Record], pad_to: int = 0) -> RecordBatch:
         n = len(records)
-        size = _pow2(n)
+        # on TPU every batch pads to ONE canonical shape: invalid rows are
+        # SIMD-masked and near-free, while each distinct pow2 bucket would
+        # be its own multi-minute cold compile through the remote-compile
+        # tunnel, serialized on the broker actor. CPU (tests) keeps tight
+        # pow2 buckets — small batches there are latency-bound.
+        if jax.default_backend() == "tpu":
+            pad_to = max(pad_to, self._TPU_BATCH)
+        size = max(_pow2(n), pad_to)
         v = self.num_vars
         cols: Dict[str, np.ndarray] = {
             "valid": np.zeros(size, bool),
@@ -1324,6 +1353,28 @@ class TpuPartitionEngine:
         for i, record in enumerate(records):
             self._stage_row(cols, i, record)
         return RecordBatch(**{k: jnp.asarray(a) for k, a in cols.items()})
+
+    def warm(self, sizes=(512,)) -> None:
+        """Pre-compile the step program for the hot batch shapes BEFORE the
+        partition serves: a cold kernel compile on the first drained batch
+        otherwise blocks the broker actor for the whole compile (minutes
+        over a remote-compile tunnel), and every client request meanwhile
+        times out. The empty deployed set compiles to the same padded
+        graph shapes as small real deployments, so these cache entries
+        serve production traffic."""
+        if self.graph is None:
+            self._recompile()
+        if self.graph is None:
+            return
+        now = jnp.asarray(self.clock(), jnp.int64)
+        for n in sizes:
+            batch = self._stage([], pad_to=n)
+            # zero valid rows: a semantic no-op step that only compiles
+            self.state, _out, _stats = kernel.step_jit(
+                self.graph, self.state, batch, now,
+                partition_id=jnp.asarray(self.partition_id, jnp.int32),
+            )
+        jax.block_until_ready(self.state.ei_i32)
 
     def _stage_row(self, cols, i, record: Record) -> None:
         md = record.metadata

@@ -114,7 +114,11 @@ def insert(table: HashTable, keys: jax.Array, vals: jax.Array, valid: jax.Array)
     def body(carry):
         i, tkeys, tvals, pending, probe = carry
         idx = (h0 + probe) & (table_size - 1)
-        free = tkeys[idx] == EMPTY
+        # claim EMPTY *or* TOMBSTONE buckets (standard open addressing):
+        # delete-heavy tables (parallel joins insert+delete per instance)
+        # otherwise fill with tombstones until no bucket is claimable and
+        # inserts silently fail mid-workload
+        free = tkeys[idx] < 0
         attempt = pending & free
         # deterministic bucket claim: lowest batch rank wins
         order = jnp.where(attempt, rank, _BIG)

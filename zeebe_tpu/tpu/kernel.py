@@ -141,7 +141,13 @@ def _indexed_lookup(index, key_col, fallback_map, keys, want, cap):
     stale index/map entries (deleted rows, reused slots) resolve to
     not-found without any per-round index maintenance."""
     icap = index.shape[0]
-    cand = index[(keys & (icap - 1)).astype(jnp.int32)]
+    # keys are stride-5 (keyspace: one residue class per entity family),
+    # so indexing on key // 5 packs them densely — the collision-free
+    # window is icap * 5 consecutive keys, not icap (a parallel-split /
+    # multi-instance wave can allocate hundreds of thousands of keys;
+    # indexing on the raw key wrapped the window within ONE wave and
+    # silently dropped ~4% of fork-join completions at bench scale)
+    cand = index[((keys // 5) & (icap - 1)).astype(jnp.int32)]
     cand_clip = jnp.clip(cand, 0, cap - 1)
     hit = want & (cand >= 0) & (key_col[cand_clip] == keys)
     miss = want & ~hit
@@ -363,8 +369,14 @@ def step_kernel(
     jb_clip = jnp.clip(jb_slot, 0, m_cap - 1)
     tm_clip = jnp.clip(tm_slot, 0, t_cap - 1)
 
-    inst_state = jnp.where(ei_found, state.ei_state[ei_clip], -1)
-    scope_state = jnp.where(sc_found, state.ei_state[sc_clip], -1)
+    # ONE row gather per slot vector feeds every phase-B column read —
+    # a [B, 6] row gather costs the same as a [B] column gather (the cost
+    # is per-index issue, not bytes), and phases read 2-3 columns per role
+    ei_rows = state.ei_i32[ei_clip]
+    sc_rows = state.ei_i32[sc_clip]
+    aik_rows = state.ei_i32[aik_clip]
+    inst_state = jnp.where(ei_found, ei_rows[:, EI_STATE], -1)
+    scope_state = jnp.where(sc_found, sc_rows[:, EI_STATE], -1)
 
     # ---------------- B. routing + guards ----------------
     m_create = wi_cmd & (it == int(WI.CREATE)) & (batch.wf >= 0)
@@ -387,7 +399,7 @@ def step_kernel(
     # ELEMENT_TERMINATED with a pending boundary processes while the scope
     # stays ACTIVATED (the token moves to the boundary event)
     pending_bd = jnp.where(
-        ei_found, state.ei_i32[ei_clip, EI_PENDING_BD], -1
+        ei_found, ei_rows[:, EI_PENDING_BD], -1
     )
     guard = jnp.where(
         g_own,
@@ -488,7 +500,7 @@ def step_kernel(
     tcan_ok = timer_cmd & (it == int(TI.CANCEL)) & tm_found
     # timer trigger resumes the catch event when still active
     ttrig_inst = ttrig_ok & aik_found & (
-        jnp.where(aik_found, state.ei_state[aik_clip], -1) == int(WI.ELEMENT_ACTIVATED)
+        jnp.where(aik_found, aik_rows[:, EI_STATE], -1) == int(WI.ELEMENT_ACTIVATED)
     )
     # boundary-event triggers: the timer's handler element is a BOUNDARY
     # event attached to the instance's element (oracle _boundary_for +
@@ -566,15 +578,15 @@ def step_kernel(
         )
         del_ok = msg_del & mmsg_found & (state.msg_key[mmsg_clip] == batch.key)
         corr_live = wisub_corr & aik_found & (
-            jnp.where(aik_found, state.ei_state[aik_clip], -1)
+            jnp.where(aik_found, aik_rows[:, EI_STATE], -1)
             == int(WI.ELEMENT_ACTIVATED)
         )
         corr_rej = wisub_corr & ~corr_live
         # boundary-message correlate: the message name matches one of the
         # instance element's attached boundary events (oracle
         # _process_wi_subscription -> _boundary_for by message name)
-        ci_elem = jnp.where(aik_found, state.ei_elem[aik_clip], 0)
-        ci_wf = jnp.where(aik_found, state.ei_wf[aik_clip], 0)
+        ci_elem = jnp.where(aik_found, aik_rows[:, EI_ELEM], 0)
+        ci_wf = jnp.where(aik_found, aik_rows[:, EI_WF], 0)
         ci_elem_c = jnp.clip(ci_elem, 0, graph.elem_type.shape[1] - 1)
         ci_wf_c = jnp.clip(ci_wf, 0, graph.elem_type.shape[0] - 1)
         if graph.has_boundaries:
@@ -932,7 +944,7 @@ def step_kernel(
     scope_parent_key = jnp.where(
         scope_parent >= 0, state.ei_key[jnp.clip(scope_parent, 0, n_cap - 1)], -1
     )
-    scope_elem = jnp.where(sc_found, state.ei_elem[sc_clip], -1)
+    scope_elem = jnp.where(sc_found, sc_rows[:, EI_ELEM], -1)
 
     e0 = put(
         e0, m_create,
@@ -969,7 +981,7 @@ def step_kernel(
         # oracle never copies iteration payloads into an MI scope)
         sc_elem_c = jnp.clip(scope_elem, 0, graph.elem_type.shape[1] - 1)
         sc_wf_c = jnp.clip(
-            jnp.where(sc_found, state.ei_wf[sc_clip], 0),
+            jnp.where(sc_found, sc_rows[:, EI_WF], 0),
             0, graph.elem_type.shape[0] - 1,
         )
         mi_completer = (
@@ -1179,9 +1191,9 @@ def step_kernel(
         state.ei_pay[aik_clip]
     )
     wi_of_inst_vt = wi_of_inst_vt.astype(jnp.int8)
-    inst_elem = state.ei_elem[aik_clip]
-    inst_wf = state.ei_wf[aik_clip]
-    inst_scope_slot = state.ei_scope_slot[aik_clip]
+    inst_elem = aik_rows[:, EI_ELEM]
+    inst_wf = aik_rows[:, EI_WF]
+    inst_scope_slot = aik_rows[:, EI_SCOPE]
     inst_scope_key = jnp.where(
         inst_scope_slot >= 0,
         state.ei_key[jnp.clip(inst_scope_slot, 0, n_cap - 1)],
@@ -1754,11 +1766,11 @@ def step_kernel(
     b_pay = pack_payload(batch.v_vt, batch.v_str, batch.v_num)
     if graph.has_multi_instance:
         scope_elem_c = jnp.clip(
-            jnp.where(sc_found, state.ei_elem[sc_clip], 0),
+            jnp.where(sc_found, sc_rows[:, EI_ELEM], 0),
             0, graph.elem_type.shape[1] - 1,
         )
         scope_wf_c = jnp.clip(
-            jnp.where(sc_found, state.ei_wf[sc_clip], 0),
+            jnp.where(sc_found, sc_rows[:, EI_WF], 0),
             0, graph.elem_type.shape[0] - 1,
         )
         mi_scope = graph.mi_cardinality[scope_wf_c, scope_elem_c] > 0
@@ -1909,7 +1921,7 @@ def step_kernel(
     ei_pay = pops.masked_row_update(ei_pay, ins_slot, ins, b_pay)
     ei_icap = state.ei_index.shape[0]
     ei_index_arr = state.ei_index.at[
-        jnp.where(ins, ins_key & (ei_icap - 1), ei_icap).astype(jnp.int32)
+        jnp.where(ins, (ins_key // 5) & (ei_icap - 1), ei_icap).astype(jnp.int32)
     ].set(ins_slot, mode="drop")
     ei_i64_arr = pops.planes_to_i64(ei_i64_pl)
 
@@ -1944,7 +1956,7 @@ def step_kernel(
     job_pay_arr = pops.masked_row_update(state.job_pay, j_slot, job_ins, b_pay)
     job_icap = state.job_index.shape[0]
     job_index_arr = state.job_index.at[
-        jnp.where(job_ins, job_base & (job_icap - 1), job_icap).astype(jnp.int32)
+        jnp.where(job_ins, (job_base // 5) & (job_icap - 1), job_icap).astype(jnp.int32)
     ].set(j_slot, mode="drop")
     job_map = state.job_map
 

@@ -520,7 +520,10 @@ def insert(table: HashTable, keys: jax.Array, vals: jax.Array, valid: jax.Array)
                 sel = lane_iota == lane
                 blo = jnp.max(jnp.where(sel, tlo_ref[r, :], jnp.int32(-(2**31))))
                 bhi = jnp.max(jnp.where(sel, thi_ref[r, :], jnp.int32(-(2**31))))
-                free = (blo == -1) & (bhi == -1)
+                # claim EMPTY (-1) or TOMBSTONE (-2) buckets, mirroring
+                # the XLA insert (delete-heavy tables fill with
+                # tombstones otherwise): hi plane is -1 for both
+                free = ((blo == -1) | (blo == -2)) & (bhi == -1)
                 return (
                     j + 1,
                     jnp.where(free, idx, target),

@@ -131,8 +131,9 @@ class EngineState:
     ei_pay: jax.Array          # [N, 3V] i32 packed payload (vt | sid | f32 bits)
     ei_map: hashmap.HashTable  # key → slot (FALLBACK; see ei_index)
     # Direct-mapped key → slot accelerator: keys are allocated
-    # sequentially by this engine, so ``index[key & (cap-1)]`` is
-    # collision-free within any window of ``cap`` consecutive keys. A hit
+    # sequentially with stride 5 by this engine (keyspace residue
+    # classes), so ``index[(key // 5) & (cap-1)]`` is collision-free
+    # within any window of ``5 * cap`` consecutive keys. A hit
     # is verified against the row's own key column; misses (an old live
     # instance whose congruent-mod-cap successor overwrote the entry)
     # fall back to the hashmap probe, which is rebuilt from live rows at
@@ -360,12 +361,12 @@ def rebuild_lookup_state(state: EngineState) -> EngineState:
     job_live = state.job_state >= 0
     ei_idx = (
         _jnp.full((icap,), -1, _jnp.int32)
-        .at[_jnp.where(ei_live, state.ei_key & (icap - 1), icap).astype(_jnp.int32)]
+        .at[_jnp.where(ei_live, (state.ei_key // 5) & (icap - 1), icap).astype(_jnp.int32)]
         .set(_jnp.arange(n, dtype=_jnp.int32), mode="drop")
     )
     job_idx = (
         _jnp.full((jcap,), -1, _jnp.int32)
-        .at[_jnp.where(job_live, state.job_key & (jcap - 1), jcap).astype(_jnp.int32)]
+        .at[_jnp.where(job_live, (state.job_key // 5) & (jcap - 1), jcap).astype(_jnp.int32)]
         .set(_jnp.arange(m, dtype=_jnp.int32), mode="drop")
     )
     ei_map, _ = hashmap.rebuild_from(
@@ -390,9 +391,32 @@ def rebuild_lookup_state(state: EngineState) -> EngineState:
         .at[_jnp.where(job_free_mask, job_rank, m)]
         .set(_jnp.arange(m, dtype=_jnp.int32), mode="drop")
     )
+    # the remaining maps are maintained in-round (tombstone churn);
+    # rebuilding them here compacts the churn away on the same cadence
+    def _iota(a):
+        return _jnp.arange(a.shape[0], dtype=_jnp.int32)
+
+    join_map, _ = hashmap.rebuild_from(
+        state.join_map.keys.shape[0], state.join_key,
+        _iota(state.join_key), state.join_key >= 0,
+    )
+    timer_map, _ = hashmap.rebuild_from(
+        state.timer_map.keys.shape[0], state.timer_key,
+        _iota(state.timer_key), state.timer_key >= 0,
+    )
+    msub_map, _ = hashmap.rebuild_from(
+        state.msub_map.keys.shape[0], state.msub_ckey,
+        _iota(state.msub_ckey), state.msub_ckey >= 0,
+    )
+    msg_map, _ = hashmap.rebuild_from(
+        state.msg_map.keys.shape[0], state.msg_ckey,
+        _iota(state.msg_ckey), state.msg_key >= 0,
+    )
     return _dc.replace(
         state, ei_index=ei_idx, job_index=job_idx,
         ei_map=ei_map, job_map=job_map,
+        join_map=join_map, timer_map=timer_map,
+        msub_map=msub_map, msg_map=msg_map,
         free_ei=free_ei,
         free_ei_pop=_jnp.zeros((), _jnp.int64),
         free_ei_push=_jnp.sum(ei_free_mask, dtype=_jnp.int64),
