@@ -648,79 +648,120 @@ def main():
         }
 
     configs = [c1]
+
+    def emit():
+        """ONE complete JSON line with everything measured so far. Called
+        after config 1 and again after EVERY side config (each line is a
+        full, parseable record — the last one wins), so a crash, hang, or
+        driver timeout in a late config can never zero the round
+        (round-3: tunnel outage; round-4: NameError at config 6 → rc=124,
+        parsed:null — two rounds with no recorded perf number)."""
+        tps = c1["transitions_per_sec"]
+        print(
+            json.dumps(
+                {
+                    "metric": "bpmn_token_transitions_per_sec",
+                    "value": tps,
+                    "unit": "transitions/sec",
+                    "vs_baseline": round(tps / 10e6, 4),
+                    "detail": {
+                        "backend": backend,
+                        "device_status": device_status,
+                        **({"device_error": device_error} if device_error else {}),
+                        "instances": c1.get("instances"),
+                        "records": c1.get("records"),
+                        "elapsed_sec": c1.get("elapsed_sec"),
+                        "wave": c1.get("wave"),
+                        "transitions_per_instance": c1.get(
+                            "transitions_per_instance"
+                        ),
+                        "configs": configs,
+                    },
+                }
+            ),
+            flush=True,
+        )
+
+    emit()  # the headline stands even if everything after this dies
+
+    # our own deadline, under the driver's: SIGTERM (what `timeout` sends)
+    # and a soft time budget both cut the side-config matrix short and
+    # leave the already-emitted lines as the result
+    import signal
+
+    class _BenchTimeout(Exception):
+        pass
+
+    def _on_term(signum, frame):
+        raise _BenchTimeout(f"signal {signum}")
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+        signal.signal(signal.SIGINT, _on_term)
+    except (ValueError, OSError):
+        pass  # non-main thread / restricted env: budget check still applies
+    budget_sec = float(os.environ.get("BENCH_TIME_BUDGET", "1500"))
+    start_time = time.monotonic()
+
+    def over_budget():
+        return time.monotonic() - start_time > budget_sec
+
     if os.environ.get("BENCH_CONFIGS", "all") != "headline":
         side_total = max(total_instances // 4, wave * 2)
-        try:
-            configs.append(
-                run_device_config(
-                    build_graph_xor, "2-xor-split-merge", side_total, wave, _progress
-                )
-            )
-        except Exception as e:  # noqa: BLE001 - report, keep the matrix going
-            configs.append({"config": "2-xor-split-merge", "error": str(e)[:200]})
-        try:
-            configs.append(
-                run_device_config(
-                    build_graph_forkjoin, "3-parallel-fork-join", side_total, wave,
+        side_configs = [
+            (
+                "2-xor-split-merge",
+                lambda: run_device_config(
+                    build_graph_xor, "2-xor-split-merge", side_total, wave,
                     _progress,
-                )
-            )
-        except Exception as e:  # noqa: BLE001
-            configs.append({"config": "3-parallel-fork-join", "error": str(e)[:200]})
-        # configs 4-5 run on the DEVICE kernel since round 4 (message
-        # correlation, boundary events, and cardinality multi-instance
-        # compile to the device graph)
-        try:
-            configs.append(
-                run_device_config_c4(
+                ),
+            ),
+            (
+                "3-parallel-fork-join",
+                lambda: run_device_config(
+                    build_graph_forkjoin, "3-parallel-fork-join", side_total,
+                    wave, _progress,
+                ),
+            ),
+            # configs 4-5 run on the DEVICE kernel since round 4 (message
+            # correlation, boundary events, and cardinality multi-instance
+            # compile to the device graph)
+            (
+                "4-message-timer-boundary",
+                lambda: run_device_config_c4(
                     side_total, wave if accel else wave // 2, _progress
-                )
-            )
-        except Exception as e:  # noqa: BLE001
-            configs.append({"config": "4-message-timer-boundary", "error": str(e)[:200]})
-        try:
-            configs.append(
-                run_device_config(
+                ),
+            ),
+            (
+                "5-multi-instance-subprocess",
+                lambda: run_device_config(
                     build_graph_c5, "5-multi-instance-subprocess",
                     side_total, wave, _progress, cap_factor=16,
-                )
-            )
-        except Exception as e:  # noqa: BLE001
-            configs.append({"config": "5-multi-instance-subprocess", "error": str(e)[:200]})
-        # the full serving path (client → log → commit → device engine →
-        # responses) — quantifies host overhead around the kernel number
-        try:
-            configs.append(
-                run_serving_path(
-                    n_instances=4096 if accel else 256,
-                    engine="tpu",
-                )
-            )
-        except Exception as e:  # noqa: BLE001
-            configs.append({"config": "serving-path-1-service-task", "error": str(e)[:200]})
-
-    tps = c1["transitions_per_sec"]
-    print(
-        json.dumps(
-            {
-                "metric": "bpmn_token_transitions_per_sec",
-                "value": tps,
-                "unit": "transitions/sec",
-                "vs_baseline": round(tps / 10e6, 4),
-                "detail": {
-                    "backend": backend,
-                    "device_status": device_status,
-                    **({"device_error": device_error} if device_error else {}),
-                    "instances": c1.get("instances"),
-                    "records": c1.get("records"),
-                    "elapsed_sec": c1.get("elapsed_sec"),
-                    "wave": c1.get("wave"),
-                    "transitions_per_instance": c1.get("transitions_per_instance"),
-                    "configs": configs,
-                },
-            }
-        )
-    )
+                ),
+            ),
+            # the full serving path (client → log → commit → device engine
+            # → responses) — quantifies host overhead around the kernel
+            (
+                "serving-path-1-service-task",
+                lambda: run_serving_path(
+                    n_instances=4096 if accel else 256, engine="tpu"
+                ),
+            ),
+        ]
+        for name, run in side_configs:
+            if over_budget():
+                configs.append({"config": name, "skipped": "time budget"})
+                emit()
+                continue
+            try:
+                configs.append(run())
+            except _BenchTimeout as e:
+                configs.append({"config": name, "error": f"timeout: {e}"})
+                emit()
+                break
+            except Exception as e:  # noqa: BLE001 - report, keep the matrix going
+                configs.append({"config": name, "error": str(e)[:200]})
+            emit()
 
 
 if __name__ == "__main__":

@@ -369,3 +369,71 @@ class TestServiceContainer:
         s.work_until_done()
         assert ("stop", "a") in log and ("stop", "b") in log
         assert log.index(("stop", "b")) < log.index(("stop", "a"))
+
+
+class TestActorFailureEscalation:
+    """Actor job exceptions are counted and surfaced, never silently
+    swallowed (reference: ActorTask failure handling escalates through the
+    actor lifecycle). Round-4 lesson: a NameError in the broker tick
+    survived 468 green tests because _drain only printed the traceback."""
+
+    def test_failures_are_counted_and_listeners_fire(self):
+        from zeebe_tpu.runtime.actors import Actor, ControlledActorScheduler
+
+        s = ControlledActorScheduler().start()
+        seen = []
+        s.on_actor_failure(lambda actor, exc: seen.append((actor.name, type(exc))))
+        a = Actor("bad-actor")
+        s.submit_actor(a)
+        s.work_until_done()
+
+        def boom():
+            raise NameError("_undefined_symbol")
+
+        for _ in range(3):
+            a.actor.run(boom)
+        s.work_until_done()
+        assert s.actor_failures == 3
+        assert a._failure_count == 3
+        assert [t for _, t in seen] == [NameError] * 3
+        assert all(name == "bad-actor" for name, _ in seen)
+        assert len(s.last_failures) == 3
+        assert "_undefined_symbol" in s.last_failures[-1][1]
+
+    def test_threaded_drain_counts_failures(self):
+        import time as _time
+
+        from zeebe_tpu.runtime.actors import Actor, ActorScheduler
+
+        s = ActorScheduler(cpu_threads=1, io_threads=0).start()
+        try:
+            a = Actor("bad-threaded")
+            s.submit_actor(a).join(5)
+            a.actor.run(lambda: (_ for _ in ()).throw(RuntimeError("x")))
+            deadline = _time.monotonic() + 5
+            while s.actor_failures < 1 and _time.monotonic() < deadline:
+                _time.sleep(0.01)
+            assert s.actor_failures == 1
+        finally:
+            s.stop()
+
+    def test_cluster_broker_health_flips_on_repeated_failures(self, tmp_path):
+        from zeebe_tpu.runtime.cluster_broker import ClusterBroker
+        from zeebe_tpu.runtime.config import BrokerCfg
+
+        cfg = BrokerCfg()
+        cfg.network.client_port = 0
+        cfg.network.management_port = 0
+        cfg.network.subscription_port = 0
+        cfg.metrics.enabled = False
+        broker = ClusterBroker(cfg, str(tmp_path / "b0"))
+        try:
+            assert broker.healthy()
+            bad = object.__new__(type("X", (), {}))
+            bad.name = "broken-tick"
+            for _ in range(3):
+                broker._on_actor_failure(bad, NameError("_due_probe_jit"))
+            assert not broker.healthy()
+            assert broker.metrics_actor_failures.value == 3
+        finally:
+            broker.close()

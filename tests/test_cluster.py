@@ -777,3 +777,133 @@ class TestTpuClusterServing:
                 client2.close()
         finally:
             cluster.close()
+
+
+class TestTpuClusterDeadlines:
+    """Round-4 regression (deadline sweeps dead on clustered TPU
+    partitions): the broker tick must fire job timeouts, timer events and
+    host-oracle deadlines on a TPU-backed partition — the async device
+    probe (``tpu/engine.deadlines_due_probe``) gates the expensive device
+    column sweeps, while host-oracle deadlines are swept unconditionally
+    every tick. Reference periodic jobs: ``JobTimeOutStreamProcessor``,
+    ``MessageTimeToLiveChecker`` (broker-core job/message processors)."""
+
+    def _cluster(self, tmp_path):
+        return ClusterUnderTest(tmp_path, n_brokers=3, partitions=1, engine="tpu")
+
+    def test_device_timer_fires_through_the_tick(self, tmp_path):
+        cluster = self._cluster(tmp_path)
+        try:
+            cluster.await_leaders()
+            from zeebe_tpu.tpu import TpuPartitionEngine
+
+            assert isinstance(
+                cluster.leader_of(0).partitions[0].engine, TpuPartitionEngine
+            )
+            client = cluster.client()
+            try:
+                model = (
+                    Bpmn.create_process("timer-flow")
+                    .start_event()
+                    .timer_catch_event("wait", duration_ms=700)
+                    .service_task("after", type="timer-done")
+                    .end_event("end")
+                    .done()
+                )
+                # device-eligible: the timer lives in the DEVICE timer
+                # table; its TRIGGER only fires if the probe-gated sweep runs
+                from zeebe_tpu.models.transform import transform_model
+                from zeebe_tpu.tpu.graph import check_device_compatible
+
+                wf = transform_model(model)[0]
+                assert check_device_compatible(wf) is None
+
+                client.deploy_model(model)
+                done = []
+                worker = client.open_job_worker(
+                    "timer-done", lambda pid, rec: done.append(rec.key) or {}
+                )
+                client.create_instance("timer-flow", {})
+                assert wait_until(lambda: len(done) == 1, timeout=30), done
+                worker.close()
+            finally:
+                client.close()
+        finally:
+            cluster.close()
+
+    def test_device_job_timeout_reactivates_through_the_tick(self, tmp_path):
+        from zeebe_tpu.gateway.cluster_client import RemoteJobWorker
+
+        class NoCompleteWorker(RemoteJobWorker):
+            """Takes pushes but never completes/fails: the job can only
+            come back via a server-side TIME_OUT sweep."""
+
+            def _on_record(self, partition, record, epoch=-1):
+                self.handled.append(record)
+                self._return_credit(partition)
+
+        cluster = self._cluster(tmp_path)
+        try:
+            cluster.await_leaders()
+            client = cluster.client()
+            try:
+                client.deploy_model(order_process())
+                worker = NoCompleteWorker(
+                    client, "payment-service", handler=None,
+                    worker_name="sloth", credits=4, timeout_ms=800,
+                    partitions=[0],
+                )
+                client.create_instance("order-process", {"orderId": 1})
+                # 1st push = activation; 2nd push of the SAME job key can
+                # only happen after the tick swept its deadline (TIME_OUT)
+                assert wait_until(
+                    lambda: len(worker.handled) >= 2, timeout=30
+                ), [r.key for r in worker.handled]
+                keys = {r.key for r in worker.handled}
+                assert len(keys) == 1, keys
+                worker.close()
+            finally:
+                client.close()
+        finally:
+            cluster.close()
+
+    def test_host_demoted_timer_fires_every_tick_unconditionally(self, tmp_path):
+        """Host-oracle deadlines (device-INELIGIBLE workflows inside a TPU
+        partition) must fire even when no device-side deadline is ever due
+        — the round-4 bug gated them behind the device probe."""
+        cluster = self._cluster(tmp_path)
+        try:
+            cluster.await_leaders()
+            client = cluster.client()
+            try:
+                builder = (
+                    Bpmn.create_process("host-timer-flow")
+                    .start_event()
+                    .timer_catch_event("wait", duration_ms=700)
+                    .service_task("after", type="host-timer-done")
+                )
+                sub = builder.sub_process(
+                    "each", multi_instance={"input_collection": "$.items",
+                                            "input_element": "item"}
+                )
+                sub.start_event("s").end_event("e")
+                model = sub.embedded_done().end_event("end").done()
+
+                from zeebe_tpu.models.transform import transform_model
+                from zeebe_tpu.tpu.graph import check_device_compatible
+
+                wf = transform_model(model)[0]
+                assert check_device_compatible(wf) is not None  # host-demoted
+
+                client.deploy_model(model)
+                done = []
+                worker = client.open_job_worker(
+                    "host-timer-done", lambda pid, rec: done.append(rec.key) or {}
+                )
+                client.create_instance("host-timer-flow", {"items": []})
+                assert wait_until(lambda: len(done) == 1, timeout=30), done
+                worker.close()
+            finally:
+                client.close()
+        finally:
+            cluster.close()

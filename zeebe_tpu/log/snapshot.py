@@ -80,10 +80,35 @@ class SnapshotStorage:
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
-        # sweep torn temp dirs from a crash mid-write
+        # sweep torn temp dirs from a crash mid-write; recover ".old"
+        # set-aside dirs from a crash mid-swap (see _swap_in): if the
+        # replacement never landed, the set-aside IS the committed snapshot
         for name in os.listdir(root):
+            path = os.path.join(root, name)
             if name.endswith(".tmp"):
-                shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+                shutil.rmtree(path, ignore_errors=True)
+            elif name.endswith(".old"):
+                final = path[: -len(".old")]
+                if os.path.exists(final):
+                    shutil.rmtree(path, ignore_errors=True)
+                else:
+                    os.rename(path, final)
+
+    def _swap_in(self, tmp: str, final: str) -> None:
+        """Commit ``tmp`` over ``final`` without ever unlinking a committed
+        snapshot before its replacement is durable: move the old dir aside,
+        rename the new one in, THEN delete the set-aside — a crash at any
+        point leaves either the old or the new snapshot on disk
+        (round-4 advisor finding on _commit_manifest)."""
+        if os.path.exists(final):
+            aside = final + ".old"
+            if os.path.exists(aside):
+                shutil.rmtree(aside)
+            os.rename(final, aside)
+            os.rename(tmp, final)
+            shutil.rmtree(aside, ignore_errors=True)
+        else:
+            os.rename(tmp, final)  # the commit point
 
     def list(self) -> List[SnapshotMetadata]:
         """Committed snapshots, newest (highest positions) first."""
@@ -109,9 +134,7 @@ class SnapshotStorage:
             f.write(str(zlib.crc32(payload)))
             f.flush()
             os.fsync(f.fileno())
-        if os.path.exists(final):
-            shutil.rmtree(final)
-        os.rename(tmp, final)  # the commit point
+        self._swap_in(tmp, final)
 
     def read(self, metadata: SnapshotMetadata) -> Optional[bytes]:
         """Payload, or None if missing/corrupt (checksum mismatch)."""
@@ -231,9 +254,7 @@ class SnapshotStorage:
             f.write(str(zlib.crc32(manifest)))
             f.flush()
             os.fsync(f.fileno())
-        if os.path.exists(final):
-            shutil.rmtree(final)
-        os.rename(tmp, final)
+        self._swap_in(tmp, final)
 
     def manifest(self, metadata: SnapshotMetadata) -> Optional[List[dict]]:
         """Part list ``[{"n", "h", "l"}, ...]`` of a manifest snapshot, or

@@ -176,6 +176,7 @@ class Actor:
         self._mailbox: deque = deque()
         self._running = False  # a worker is draining this actor's mailbox
         self._closed = False
+        self._failure_count = 0  # jobs that raised (see ActorScheduler._drain)
         self._mailbox_lock = threading.Lock()
 
     def on_actor_started(self) -> None:  # noqa: B027 - optional hook
@@ -207,6 +208,48 @@ class ActorScheduler:
         self._io_threads = io_threads
         self._started = False
         self._stopping = False
+        # failure escalation (reference ActorTask.java:38-48 — actor job
+        # failures are counted and surfaced, never silently swallowed):
+        # total count + a bounded ring of (actor_name, traceback) pairs,
+        # plus listeners (broker health wires in here). Round-4 lesson: a
+        # bare print turned a NameError in the broker tick into two
+        # silent zero-perf rounds.
+        self.actor_failures = 0
+        self.last_failures: deque = deque(maxlen=32)
+        self._failure_lock = threading.Lock()
+        self._failure_listeners: List[Callable[[Actor, BaseException], None]] = []
+
+    def on_actor_failure(
+        self, listener: Callable[[Actor, BaseException], None]
+    ) -> None:
+        """Register a listener called (from the failing worker thread) on
+        every actor-job exception."""
+        self._failure_listeners.append(listener)
+
+    def remove_actor_failure_listener(
+        self, listener: Callable[[Actor, BaseException], None]
+    ) -> None:
+        try:
+            self._failure_listeners.remove(listener)
+        except ValueError:
+            pass
+
+    def _record_failure(self, actor: Actor, exc: BaseException) -> None:
+        """Escalate one actor-job exception: traceback to stderr, counters
+        + bounded failure ring (thread-safe — worker threads race here),
+        then listener fan-out (a listener must never kill the worker)."""
+        import traceback
+
+        traceback.print_exc()
+        with self._failure_lock:
+            self.actor_failures += 1
+            actor._failure_count += 1
+            self.last_failures.append((actor.name, traceback.format_exc()))
+        for listener in list(self._failure_listeners):
+            try:
+                listener(actor, exc)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ActorScheduler":
@@ -318,10 +361,8 @@ class ActorScheduler:
                 fn = actor._mailbox.popleft()
             try:
                 fn()
-            except Exception:  # noqa: BLE001
-                import traceback
-
-                traceback.print_exc()
+            except Exception as exc:  # noqa: BLE001
+                self._record_failure(actor, exc)
         # still work left: requeue for fairness
         queue = self._io_runq if getattr(actor, "_io_bound", False) else self._runq
         with self._cv:
@@ -399,10 +440,8 @@ class ControlledActorScheduler(ActorScheduler):
                     fn = actor._mailbox.popleft()
                 try:
                     fn()
-                except Exception:  # noqa: BLE001
-                    import traceback
-
-                    traceback.print_exc()
+                except Exception as exc:  # noqa: BLE001
+                    self._record_failure(actor, exc)
                 ran += 1
                 if ran > max_jobs:
                     raise RuntimeError("controlled scheduler did not quiesce")

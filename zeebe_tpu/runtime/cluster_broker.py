@@ -408,6 +408,19 @@ class ClusterBroker(Actor):
         self.metrics_events_processed = self.metrics.counter(
             "stream_processor_events_processed", "Committed records processed"
         )
+        # actor failures are escalated, never silently swallowed (reference
+        # ActorTask failure handling; round-4 lesson — a NameError in the
+        # broker tick survived 468 green tests): every failure counts into
+        # metrics, repeated failures flip broker health.
+        self.metrics_actor_failures = self.metrics.counter(
+            "actor_failures", "Actor jobs that raised an exception"
+        )
+        self._unhealthy_reason: Optional[str] = None
+        # only watch a scheduler this broker owns: on a SHARED scheduler
+        # another broker's failures must not flip this broker's health
+        # (and close() must not leave a bound-method listener behind)
+        if self._own_scheduler:
+            self.scheduler.on_actor_failure(self._on_actor_failure)
         self.metrics_http = None
         if cfg.metrics.enabled:
             self.metrics_writer = MetricsFileWriter(
@@ -593,8 +606,29 @@ class ClusterBroker(Actor):
             clock=self.clock,
         )
 
+    def _on_actor_failure(self, actor, exc: BaseException) -> None:
+        """Scheduler failure listener: every swallowed actor exception is
+        counted; 3+ during a broker's lifetime flip health to unhealthy
+        (reference: actor failure escalates through ActorTask and fails
+        the component's health check)."""
+        if self._closing:
+            return  # shutdown races (sockets closing under actors) don't
+            # indict a live broker's health
+        self.metrics_actor_failures.inc()
+        if self.metrics_actor_failures.value >= 3 and self._unhealthy_reason is None:
+            self._unhealthy_reason = f"repeated actor failures (last: {actor.name}: {exc!r})"
+            logger.error(
+                "broker %s marked UNHEALTHY: %s", self.node_id, self._unhealthy_reason
+            )
+
+    def healthy(self) -> bool:
+        """False once repeated actor failures were observed; surfaced so
+        harnesses/tests fail loudly instead of running on a broken tick."""
+        return self._unhealthy_reason is None
+
     def close(self) -> None:
         self._closing = True
+        self.scheduler.remove_actor_failure_listener(self._on_actor_failure)
         if self.metrics_http is not None:
             self.metrics_http.close()
         for server in self.partitions.values():
@@ -1810,32 +1844,39 @@ class ClusterBroker(Actor):
         """Timer/TTL sweeps on leader partitions (reference periodic actor
         jobs: JobTimeOutStreamProcessor, MessageTimeToLiveChecker).
 
-        The full sweep transfers whole table columns device→host; over a
-        tunneled TPU every sync costs ~150ms+, and at the 100ms tick rate
-        the blocking sweep starves the broker actor (observed: client
+        The full device sweep transfers whole table columns device→host;
+        over a tunneled TPU every sync costs ~150ms+, and at the 100ms tick
+        rate the blocking sweep starves the broker actor (observed: client
         requests timing out while the actor sat in np.asarray). Engines
         exposing an async due-probe are polled WITHOUT blocking: the tick
-        only pays the full sweep when a ready probe says something is due."""
+        only pays the device sweep when a ready probe says something is
+        due. Host-oracle deadlines (demoted/host-only workflows inside a
+        TPU engine) are cheap dict scans and are swept UNCONDITIONALLY
+        every tick — never gated by the device probe (round-4 regression:
+        gating them meant host timers only fired if an unrelated device
+        deadline happened to be due)."""
         for server in self.partitions.values():
             if not server.is_leader or server.engine is None:
                 continue
             engine = server.engine
+            commands: List[Record] = []
             probe_fn = getattr(engine, "deadlines_due_probe", None)
             if probe_fn is not None:
+                commands += engine.host_deadline_commands()
                 pending = self._due_probes.get(server.partition_id)
+                due = False
                 if pending is None:
                     self._due_probes[server.partition_id] = probe_fn()
-                    continue
-                if not pending.is_ready():
-                    continue  # still in flight; poll again next tick
-                due = bool(pending)
-                self._due_probes[server.partition_id] = probe_fn()
-                if not due:
-                    continue
-            commands = (
-                engine.check_job_deadlines()
-                + engine.check_timer_deadlines()
-                + engine.check_message_ttls()
-            )
+                elif pending.is_ready():
+                    due = bool(pending)
+                    self._due_probes[server.partition_id] = probe_fn()
+                if due:
+                    commands += engine.device_deadline_commands()
+            else:
+                commands += (
+                    engine.check_job_deadlines()
+                    + engine.check_timer_deadlines()
+                    + engine.check_message_ttls()
+                )
             if commands:
                 server.raft.append(commands)

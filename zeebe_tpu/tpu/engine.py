@@ -77,6 +77,24 @@ _MESSAGE_VALUE_TYPES = {
 _ERR_NO_RETRIES = 105  # kernel's JOB_NO_RETRIES incident code
 
 
+@jax.jit
+def _due_probe_jit(state: "state_mod.EngineState", now: jax.Array) -> jax.Array:
+    """Bool scalar: is ANY device-side deadline due at ``now``? One fused
+    reduction over the job-deadline, timer-due and message-TTL columns —
+    launched asynchronously by the broker tick and polled with
+    ``is_ready()`` so the tick never blocks on a device→host sync. The
+    per-family predicates mirror the host sweeps below exactly
+    (check_job_deadlines / check_timer_deadlines / check_message_ttls)."""
+    job_due = jnp.any(
+        (state.job_state == int(JI.ACTIVATED))
+        & (state.job_deadline >= 0)
+        & (state.job_deadline <= now)
+    )
+    timer_due = jnp.any((state.timer_key >= 0) & (state.timer_due <= now))
+    msg_due = jnp.any((state.msg_key >= 0) & (state.msg_deadline <= now))
+    return job_due | timer_due | msg_due
+
+
 def _host_unpack_payload(pay: np.ndarray):
     """Host-side view of one packed payload row ([3V] i32 — see
     state.pack_payload): returns (vt, num, sid) for columns_to_payload."""
@@ -804,14 +822,38 @@ class TpuPartitionEngine:
 
     def host_deadline_commands(self) -> List[Record]:
         """The embedded oracle's due commands only (same per-family key
-        order the merged sweeps produce when the device side is empty)."""
+        order the merged sweeps produce when the device side is empty).
+        The broker tick calls this UNCONDITIONALLY every tick — host
+        sweeps are cheap dict scans — and pairs it with
+        ``device_deadline_commands`` gated by the async probe."""
         return (
             sorted(self._host.check_job_deadlines(), key=lambda r: r.key)
             + sorted(self._host.check_timer_deadlines(), key=lambda r: r.key)
             + sorted(self._host.check_message_ttls(), key=lambda r: r.key)
         )
 
+    def device_deadline_commands(self) -> List[Record]:
+        """Device-side due commands only (jobs, timers, message TTLs — each
+        family key-sorted, same per-family order as host_deadline_commands).
+        Callers that already swept the host oracle this tick use this to
+        avoid double-emitting host commands (which would append duplicate
+        TIME_OUT/TRIGGER/DELETE commands and surface as rejections)."""
+        return (
+            self._device_job_deadlines()
+            + self._device_timer_deadlines()
+            + self._device_message_ttls()
+        )
+
     def check_job_deadlines(self) -> List[Record]:
+        # jobs of host-only/demoted workflows live in the embedded oracle;
+        # merge key-sorted so mixed device+host populations emit the same
+        # global order the pure oracle would (log order IS the contract)
+        return sorted(
+            self._device_job_deadlines() + self._host.check_job_deadlines(),
+            key=lambda r: r.key,
+        )
+
+    def _device_job_deadlines(self) -> List[Record]:
         now = self.clock()
         s = self.state
         keys = np.asarray(s.job_key)
@@ -831,12 +873,18 @@ class TpuPartitionEngine:
                     value=self._job_value_from_slot(int(slot)),
                 )
             )
-        # jobs of host-only/demoted workflows live in the embedded oracle;
-        # merge key-sorted so mixed device+host populations emit the same
-        # global order the pure oracle would (log order IS the contract)
-        return sorted(out + self._host.check_job_deadlines(), key=lambda r: r.key)
+        return out
 
     def check_timer_deadlines(self) -> List[Record]:
+        # timers of host-only/demoted workflows (incl. boundary-event
+        # timers) live in the embedded oracle and must be swept too;
+        # key-sorted merge = the pure oracle's global order
+        return sorted(
+            self._device_timer_deadlines() + self._host.check_timer_deadlines(),
+            key=lambda r: r.key,
+        )
+
+    def _device_timer_deadlines(self) -> List[Record]:
         now = self.clock()
         s = self.state
         keys = np.asarray(s.timer_key)
@@ -866,14 +914,15 @@ class TpuPartitionEngine:
                     ),
                 )
             )
-        # timers of host-only/demoted workflows (incl. boundary-event
-        # timers) live in the embedded oracle and must be swept too;
-        # key-sorted merge = the pure oracle's global order
-        return sorted(
-            out + self._host.check_timer_deadlines(), key=lambda r: r.key
-        )
+        return out
 
     def check_message_ttls(self) -> List[Record]:
+        return sorted(
+            self._device_message_ttls() + self._host.check_message_ttls(),
+            key=lambda r: r.key,
+        )
+
+    def _device_message_ttls(self) -> List[Record]:
         from zeebe_tpu.protocol.intents import MessageIntent as MI
         from zeebe_tpu.protocol.records import MessageRecord
 
@@ -911,9 +960,7 @@ class TpuPartitionEngine:
                     ),
                 )
             )
-        return sorted(
-            out + self._host.check_message_ttls(), key=lambda r: r.key
-        )
+        return out
 
     def compaction_floor(self) -> int:
         """See PartitionEngine.compaction_floor — incident state lives on
