@@ -692,8 +692,17 @@ def main():
     class _BenchTimeout(Exception):
         pass
 
+    # the handler only RAISES while a config is measuring; anywhere else
+    # (mid-emit print, budget check, except handler) it just sets the flag
+    # — an interrupted emit would leave a truncated, unparseable last line,
+    # the exact failure mode this machinery exists to prevent
+    _in_config = [False]
+    _term_seen = [False]
+
     def _on_term(signum, frame):
-        raise _BenchTimeout(f"signal {signum}")
+        _term_seen[0] = True
+        if _in_config[0]:
+            raise _BenchTimeout(f"signal {signum}")
 
     try:
         signal.signal(signal.SIGTERM, _on_term)
@@ -749,18 +758,19 @@ def main():
             ),
         ]
         for name, run in side_configs:
-            if over_budget():
+            if over_budget() or _term_seen[0]:
                 configs.append({"config": name, "skipped": "time budget"})
                 emit()
                 continue
             try:
+                _in_config[0] = True
                 configs.append(run())
             except _BenchTimeout as e:
                 configs.append({"config": name, "error": f"timeout: {e}"})
-                emit()
-                break
             except Exception as e:  # noqa: BLE001 - report, keep the matrix going
                 configs.append({"config": name, "error": str(e)[:200]})
+            finally:
+                _in_config[0] = False
             emit()
 
 

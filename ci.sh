@@ -1,0 +1,28 @@
+#!/bin/sh
+# CI gate (reference: Jenkinsfile stages 'verify' + 'test', build-tools
+# checkstyle, githooks-plugin): refuses a dirty exit. Run before every
+# end-of-round snapshot — and from .githooks/pre-commit for the fast lint.
+#
+#   ./ci.sh          lint + full test suite + pallas parity check
+#   ./ci.sh fast     lint only (pre-commit speed)
+set -e
+cd "$(dirname "$0")"
+
+echo "== nameslint (undefined-global gate; catches the round-4 bug class) =="
+python tools/nameslint.py
+
+echo "== compileall (syntax gate) =="
+python -m compileall -q zeebe_tpu tests benchmarks tools bench.py __graft_entry__.py
+
+if [ "$1" = "fast" ]; then
+  echo "CI GATE (fast) GREEN"
+  exit 0
+fi
+
+echo "== full test suite =="
+python -m pytest tests/ -x -q
+
+echo "== pallas ops parity =="
+JAX_PLATFORMS=cpu python benchmarks/pallas_ops_check.py
+
+echo "CI GATE GREEN"
