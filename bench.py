@@ -759,18 +759,26 @@ def main():
         ]
         for name, run in side_configs:
             if over_budget() or _term_seen[0]:
-                configs.append({"config": name, "skipped": "time budget"})
+                configs.append({
+                    "config": name,
+                    "skipped": "signal" if _term_seen[0] else "time budget",
+                })
                 emit()
                 continue
+            # the raise-window is ONLY the run() call: the flag drops in
+            # the inner finally before any bookkeeping/emit runs, so a
+            # second signal during those can't raise uncaught
             try:
                 _in_config[0] = True
-                configs.append(run())
+                try:
+                    result = run()
+                finally:
+                    _in_config[0] = False
+                configs.append(result)
             except _BenchTimeout as e:
                 configs.append({"config": name, "error": f"timeout: {e}"})
             except Exception as e:  # noqa: BLE001 - report, keep the matrix going
                 configs.append({"config": name, "error": str(e)[:200]})
-            finally:
-                _in_config[0] = False
             emit()
 
 

@@ -155,6 +155,10 @@ class TpuPartitionEngine:
         self._keys_at_rebuild = 0
         self._compiled_count = 0
         self._host_only_keys: set = set()
+        # device-residency observability (fuzzers/tests assert the routing
+        # split instead of trusting eligibility rules not to drift)
+        self.device_records_processed = 0
+        self.host_records_processed = 0
         self._device_keys_dirty = False
         # message store side (see _recompile): True = device tables serve
         # this partition's MESSAGE-partition role
@@ -1176,6 +1180,7 @@ class TpuPartitionEngine:
             )
             for i, res in zip(pending, results):
                 per_record[i] = res
+            self.device_records_processed += len(pending)
             pending.clear()
             self._device_keys_dirty = True
 
@@ -1247,6 +1252,7 @@ class TpuPartitionEngine:
                             )
                     self._demote_instance(owner)
                 deployed_before = len(self.repository.by_key)
+                self.host_records_processed += 1
                 try:
                     per_record[i] = self._host.process(record)
                 except Exception as e:  # noqa: BLE001 - poison isolation,
