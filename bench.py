@@ -18,6 +18,8 @@ vs_baseline is against the 10M transitions/sec north-star target
 
 import dataclasses
 import json
+import os
+import sys
 import time
 
 import numpy as np
@@ -307,7 +309,8 @@ def _config5_model():
     return sub.embedded_done().end_event("done").done()
 
 
-def run_serving_path(n_instances=2048, engine="tpu", threads=8):
+def run_serving_path(n_instances=2048, engine="tpu", threads=8,
+                     duration_sec=None):
     """The PRODUCT path, not the kernel: client → TCP → log append →
     commit → partition engine → worker push → job complete → responses
     (reference hot loop spans ClientApiMessageHandler.java:90-165 →
@@ -372,24 +375,45 @@ def run_serving_path(n_instances=2048, engine="tpu", threads=8):
 
             # timed window excludes the warm-up instance and its records:
             # snapshot the log position and completed count at t0 and report
-            # deltas only
+            # deltas only. TIME-BOXED: over a tunneled TPU every commit
+            # round-trip costs ~150ms+, so a fixed instance count can
+            # outlast any sane budget — the pumps stop at the deadline and
+            # the config reports whatever throughput the window sustained
+            # (never an exception; round-4's serving config died with
+            # 'request timed out' in a pump thread and reported nothing)
             warm_done = len(done)
             records_at_t0 = int(broker.partitions[0].log.next_position)
+            duration = duration_sec or (90 if engine == "tpu" else 30)
+            stop = _threading.Event()
+            errors: list = []
+            created = [0] * threads
             t0 = _time.perf_counter()
 
             def pump(k):
                 for _ in range(n_instances // threads):
-                    client.create_instance("serve-bench", payload={"k": k})
+                    if stop.is_set():
+                        return
+                    try:
+                        client.create_instance("serve-bench", payload={"k": k})
+                        created[k] += 1
+                    except Exception as e:  # noqa: BLE001 - report, don't crash
+                        errors.append(str(e)[:120])
+                        return
 
             ts = [
-                _threading.Thread(target=pump, args=(k,)) for k in range(threads)
+                _threading.Thread(target=pump, args=(k,), daemon=True)
+                for k in range(threads)
             ]
             for t in ts:
                 t.start()
+            stopper = _threading.Timer(duration, stop.set)
+            stopper.daemon = True
+            stopper.start()
             for t in ts:
-                t.join()
-            total = (n_instances // threads) * threads
-            t_done = _time.time() + 300
+                t.join(duration + 120)
+            stopper.cancel()
+            total = sum(created)
+            t_done = _time.time() + min(120, duration)
             while _time.time() < t_done and len(done) - warm_done < total:
                 _time.sleep(0.05)
             elapsed = _time.perf_counter() - t0
@@ -402,8 +426,10 @@ def run_serving_path(n_instances=2048, engine="tpu", threads=8):
                 "completed_jobs": len(done) - warm_done,
                 "records": records,
                 "elapsed_sec": round(elapsed, 3),
-                "transitions_per_sec": round(records / elapsed, 1),
-                "instances_per_sec": round(total / elapsed, 1),
+                "transitions_per_sec": round(records / max(elapsed, 1e-9), 1),
+                "instances_per_sec": round(total / max(elapsed, 1e-9), 1),
+                **({"errors": len(errors), "first_error": errors[0]}
+                   if errors else {}),
             }
         finally:
             client.close()
@@ -743,9 +769,13 @@ def main():
             ),
             (
                 "5-multi-instance-subprocess",
+                # wave capped: the MI graph (emit_width = cardinality
+                # fan-out) at wave 2^14 x cap_factor 16 overwhelms the
+                # remote TPU compile helper (HTTP 500, rounds 4 and 5);
+                # 2^12 compiles and runs at full throughput on-chip
                 lambda: run_device_config(
                     build_graph_c5, "5-multi-instance-subprocess",
-                    side_total, wave, _progress, cap_factor=16,
+                    side_total, min(wave, 1 << 12), _progress, cap_factor=16,
                 ),
             ),
             # the full serving path (client → log → commit → device engine
@@ -753,7 +783,8 @@ def main():
             (
                 "serving-path-1-service-task",
                 lambda: run_serving_path(
-                    n_instances=4096 if accel else 256, engine="tpu"
+                    n_instances=4096 if accel else 1024, engine="tpu",
+                    threads=32,
                 ),
             ),
         ]
@@ -784,3 +815,10 @@ def main():
 
 if __name__ == "__main__":
     main()
+    # hard-exit: interpreter teardown with live native transport/tunnel
+    # threads can abort (observed: 'FATAL: exception not rethrown' →
+    # SIGABRT rc=134 AFTER the final JSON line was already printed).
+    # Everything is emitted and flushed by now; skip destructors.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)

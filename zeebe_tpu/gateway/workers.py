@@ -64,10 +64,13 @@ class JobWorker:
         if self.auto_complete and not context.finished:
             context.complete(result if isinstance(result, dict) else None)
         # replenish one credit on the partition that consumed it (reference
-        # JobSubscriber credit replenishment via control message)
-        self.broker.partitions[partition_id].engine.increase_job_credits(
-            self.subscriber_key, 1
-        )
+        # JobSubscriber credit replenishment via control message), then
+        # assign backlog jobs that were waiting for a credit
+        engine = self.broker.partitions[partition_id].engine
+        engine.increase_job_credits(self.subscriber_key, 1)
+        backlog = engine.backlog_activations()
+        if backlog:
+            self.broker.partitions[partition_id].log.append(backlog)
 
     def close(self) -> None:
         for partition in self.broker.partitions:

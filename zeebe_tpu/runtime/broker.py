@@ -390,6 +390,14 @@ class Broker:
                 partition.log.append([command])
             for command in partition.engine.check_message_ttls():
                 partition.log.append([command])
+            # jobs stranded by credit droughts (see backlog_activations);
+            # the device engine's tick covers its device table here too —
+            # the in-process broker has no async probe loop
+            backlog = partition.engine.backlog_activations()
+            if hasattr(partition.engine, "device_backlog_activations"):
+                backlog = backlog + partition.engine.device_backlog_activations()
+            for command in backlog:
+                partition.log.append([command])
 
     def records(self, partition_id: int = 0) -> List[Record]:
         """All committed records of a partition (test/debug; reference

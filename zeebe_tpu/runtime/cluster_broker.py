@@ -1700,6 +1700,12 @@ class ClusterBroker(Actor):
             server.engine.increase_job_credits(
                 int(msg["subscriber_key"]), int(msg.get("credits", 1))
             )
+            # returned credits must revisit the backlog (jobs that became
+            # activatable while every subscription was dry) — host side
+            # immediately; device side via the tick's PROBE_JOB_BACKLOG
+            backlog = server.engine.backlog_activations()
+            if backlog:
+                server.raft.append(backlog)
         elif action == "remove":
             self._drop_job_subscription(partition_id, int(msg["subscriber_key"]))
         result.complete(msgpack.pack({"t": "ok"}))
@@ -1855,6 +1861,8 @@ class ClusterBroker(Actor):
         every tick — never gated by the device probe (round-4 regression:
         gating them meant host timers only fired if an unrelated device
         deadline happened to be due)."""
+        from zeebe_tpu.tpu.engine import PROBE_DEADLINES, PROBE_JOB_BACKLOG
+
         for server in self.partitions.values():
             if not server.is_leader or server.engine is None:
                 continue
@@ -1863,20 +1871,24 @@ class ClusterBroker(Actor):
             probe_fn = getattr(engine, "deadlines_due_probe", None)
             if probe_fn is not None:
                 commands += engine.host_deadline_commands()
+                commands += engine.backlog_activations()
                 pending = self._due_probes.get(server.partition_id)
-                due = False
+                mask = 0
                 if pending is None:
                     self._due_probes[server.partition_id] = probe_fn()
                 elif pending.is_ready():
-                    due = bool(pending)
+                    mask = int(pending)
                     self._due_probes[server.partition_id] = probe_fn()
-                if due:
+                if mask & PROBE_DEADLINES:
                     commands += engine.device_deadline_commands()
+                if mask & PROBE_JOB_BACKLOG:
+                    commands += engine.device_backlog_activations()
             else:
                 commands += (
                     engine.check_job_deadlines()
                     + engine.check_timer_deadlines()
                     + engine.check_message_ttls()
+                    + engine.backlog_activations()
                 )
             if commands:
                 server.raft.append(commands)

@@ -77,14 +77,21 @@ _MESSAGE_VALUE_TYPES = {
 _ERR_NO_RETRIES = 105  # kernel's JOB_NO_RETRIES incident code
 
 
+PROBE_DEADLINES = 1  # bit0: some job/timer/message deadline is due
+PROBE_JOB_BACKLOG = 2  # bit1: assignable jobs exist AND credits are free
+
+
 @jax.jit
 def _due_probe_jit(state: "state_mod.EngineState", now: jax.Array) -> jax.Array:
-    """Bool scalar: is ANY device-side deadline due at ``now``? One fused
-    reduction over the job-deadline, timer-due and message-TTL columns —
-    launched asynchronously by the broker tick and polled with
-    ``is_ready()`` so the tick never blocks on a device→host sync. The
-    per-family predicates mirror the host sweeps below exactly
-    (check_job_deadlines / check_timer_deadlines / check_message_ttls)."""
+    """i32 bitmask scalar (PROBE_*): is ANY device-side deadline due at
+    ``now``, and is there job backlog a free credit could assign? One
+    fused reduction over the relevant columns — launched asynchronously
+    by the broker tick and polled with ``is_ready()`` so the tick never
+    blocks on a device→host sync. The deadline predicates mirror the
+    host sweeps below exactly (check_job_deadlines /
+    check_timer_deadlines / check_message_ttls); the backlog predicate
+    over-approximates (no type matching — a false positive costs one
+    wasted host scan, a false negative would strand jobs)."""
     job_due = jnp.any(
         (state.job_state == int(JI.ACTIVATED))
         & (state.job_deadline >= 0)
@@ -92,7 +99,19 @@ def _due_probe_jit(state: "state_mod.EngineState", now: jax.Array) -> jax.Array:
     )
     timer_due = jnp.any((state.timer_key >= 0) & (state.timer_due <= now))
     msg_due = jnp.any((state.msg_key >= 0) & (state.msg_deadline <= now))
-    return job_due | timer_due | msg_due
+    assignable = jnp.any(
+        (
+            (state.job_state == int(JI.CREATED))
+            | (state.job_state == int(JI.TIMED_OUT))
+            | (state.job_state == int(JI.FAILED))
+        )
+        & (state.job_i32[:, state_mod.JB_RETRIES] > 0)
+    )
+    credits_free = jnp.any(state.sub_valid & (state.sub_credits > 0))
+    return (
+        (job_due | timer_due | msg_due).astype(jnp.int32) * PROBE_DEADLINES
+        + (assignable & credits_free).astype(jnp.int32) * PROBE_JOB_BACKLOG
+    )
 
 
 def _host_unpack_payload(pay: np.ndarray):
@@ -814,15 +833,90 @@ class TpuPartitionEngine:
 
     # -- deadline scans (broker tick) --------------------------------------
     def deadlines_due_probe(self):
-        """Device bool scalar: is ANY device-side job/timer/message
-        deadline due now? The broker launches this and polls
-        ``is_ready()`` without blocking — the full column sweeps below
-        each cost a device→host sync (~150ms+ over a tunneled chip) and
-        would starve the broker actor at the tick rate. Host-oracle
-        deadlines are NOT covered: the broker sweeps those (cheap dict
-        scans) every tick via ``host_deadline_commands``."""
+        """Device i32 bitmask scalar (PROBE_DEADLINES | PROBE_JOB_BACKLOG):
+        is any device-side job/timer/message deadline due now, and is
+        there unassigned job backlog a free credit could serve? The
+        broker launches this and polls ``is_ready()`` without blocking —
+        the full column sweeps below each cost a device→host sync
+        (~150ms+ over a tunneled chip) and would starve the broker actor
+        at the tick rate. Host-oracle deadlines are NOT covered: the
+        broker sweeps those (cheap dict scans) every tick via
+        ``host_deadline_commands``."""
         now = jnp.asarray(self.clock(), jnp.int64)
         return _due_probe_jit(self.state, now)
+
+    def backlog_activations(self) -> List[Record]:
+        """Host-oracle side only (cheap dict scans — call freely). The
+        DEVICE job backlog is served by ``device_backlog_activations``,
+        gated behind the async probe's PROBE_JOB_BACKLOG bit so the tick
+        only pays the device→host pull when something is assignable."""
+        return self._host.backlog_activations()
+
+    def device_backlog_activations(self) -> List[Record]:
+        """ACTIVATE commands for device-table jobs that became activatable
+        while every subscription was out of credits (same stranding class
+        as the host engine's backlog_activations; the kernel only assigns
+        jobs when it processes a job event with credits available).
+        Credits are consumed up front, exactly like add_job_subscription's
+        backlog scan — the kernel returns them on ACTIVATE rejection."""
+        s = self.state
+        valid = np.asarray(s.sub_valid)
+        if not valid.any():
+            return []
+        sub_keys = np.asarray(s.sub_key)
+        sub_types = np.asarray(s.sub_type)
+        sub_credits = np.asarray(s.sub_credits).copy()
+        sub_timeouts = np.asarray(s.sub_timeout)
+        sub_workers = np.asarray(s.sub_worker)
+        if not (sub_credits[valid] > 0).any():
+            return []
+        activatable = {int(JI.CREATED), int(JI.TIMED_OUT), int(JI.FAILED)}
+        job_i32 = np.asarray(s.job_i32)
+        job_keys = np.asarray(s.job_key)
+        candidates = [
+            (int(job_keys[slot]), slot)
+            for slot in np.nonzero(
+                (job_i32[:, state_mod.JB_STATE] != -1)
+                & (job_i32[:, state_mod.JB_RETRIES] > 0)
+            )[0]
+            if int(job_i32[slot, state_mod.JB_STATE]) in activatable
+        ]
+        out: List[Record] = []
+        now = self.clock()
+        rr = 0
+        sub_slots = [int(i) for i in np.nonzero(valid)[0]]
+        for key, slot in sorted(candidates):
+            type_id = int(job_i32[slot, state_mod.JB_TYPE])
+            target = None
+            for j in range(len(sub_slots)):
+                cand = sub_slots[(rr + j) % len(sub_slots)]
+                if sub_credits[cand] > 0 and int(sub_types[cand]) == type_id:
+                    target = cand
+                    rr = (rr + j + 1) % len(sub_slots)
+                    break
+            if target is None:
+                continue  # no credits for this type; try other jobs' types
+            sub_credits[target] -= 1
+            activated = self._job_value_from_slot(int(slot))
+            activated.deadline = now + int(sub_timeouts[target])
+            activated.worker = self.interns.string(int(sub_workers[target])) or ""
+            out.append(
+                Record(
+                    key=key,
+                    value=activated,
+                    metadata=RecordMetadata(
+                        record_type=RecordType.COMMAND,
+                        value_type=ValueType.JOB,
+                        intent=int(JI.ACTIVATE),
+                        request_stream_id=int(sub_keys[target]),
+                    ),
+                )
+            )
+        if out:
+            self.state = dataclasses.replace(
+                s, sub_credits=jnp.asarray(sub_credits)
+            )
+        return out
 
     def host_deadline_commands(self) -> List[Record]:
         """The embedded oracle's due commands only (same per-family key
