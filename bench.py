@@ -626,6 +626,17 @@ def main():
     accel = backend not in ("cpu",)
 
     if backend == "tpu":
+        # per-build pallas/XLA dispatch BEFORE anything compiles the step
+        # program: the microbench (or its per-build disk cache) decides
+        # which path each op family takes on this libtpu build
+        _progress("autotune: per-family pallas/XLA A/B...")
+        from zeebe_tpu.tpu import autotune
+
+        autotune.ensure_autotuned(progress=_progress)
+        _progress(
+            f"autotune dispatch ({autotune.dispatch_source()}): "
+            f"{autotune.get_decisions_json()}"
+        )
         # the pallas table ops carry the round on TPU; their functional
         # parity gate runs first so a divergence fails the bench LOUDLY —
         # but still with a parseable JSON record, not a bare traceback

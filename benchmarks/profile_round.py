@@ -21,12 +21,62 @@ from collections import defaultdict
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def op_census(wave_pow: int = 10) -> dict:
+    """Static gather/scatter/pallas census of ONE lowered step program on
+    the current backend — the ops/record number the mega-pass collapses.
+    Runs anywhere (CPU too: the fallback chain shows the unfused count, a
+    TPU lowering shows the fused pallas passes as tpu_custom_call)."""
+    import dataclasses as _dc
+    import re
+
+    import jax
+    import jax.numpy as jnp
+
+    from zeebe_tpu.tpu import batch as rb, kernel, state as state_mod
+    import bench
+
+    wave = 1 << wave_pow
+    graph, meta = bench.build_graph()
+    num_vars = max(graph.num_vars, 8)
+    graph = _dc.replace(graph, num_vars=num_vars)
+    state = state_mod.make_state(
+        capacity=2 * wave, num_vars=num_vars, job_capacity=2 * wave,
+        sub_capacity=8,
+    )
+    batch = rb.empty(wave, num_vars)
+    lowered = jax.jit(
+        kernel.step_kernel, static_argnames=("synthetic_workers",)
+    ).lower(
+        graph, state, batch, jnp.asarray(0, jnp.int64),
+        synthetic_workers=True,
+    )
+    text = lowered.as_text()
+    counts = {
+        "gather": len(re.findall(r"\bgather\b", text)),
+        "scatter": len(re.findall(r"\bscatter\b", text)),
+        "pallas_passes": len(re.findall(r"tpu_custom_call", text)),
+        "while_loops": len(re.findall(r"\bwhile\b", text)),
+    }
+    counts["gather_scatter_total"] = counts["gather"] + counts["scatter"]
+    return counts
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--wave", type=int, default=14)
     ap.add_argument("--waves", type=int, default=3)
     ap.add_argument("--trace-dir", default="/tmp/zbtpu-trace")
+    ap.add_argument(
+        "--census", action="store_true",
+        help="static gather/scatter/pallas op census of one lowered step "
+        "program (no device run; works on CPU)",
+    )
     args = ap.parse_args()
+
+    if args.census:
+        from zeebe_tpu import tpu as _tpu2  # noqa: F401  (enables x64)
+        print(json.dumps(op_census(min(args.wave, 10))))
+        return
 
     from zeebe_tpu import tpu as _tpu  # noqa: F401
     import jax

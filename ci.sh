@@ -22,7 +22,10 @@ fi
 echo "== full test suite =="
 python -m pytest tests/ -x -q
 
-echo "== pallas ops parity =="
-JAX_PLATFORMS=cpu python benchmarks/pallas_ops_check.py
+echo "== pallas ops + mega-pass parity (skips without a TPU) =="
+python benchmarks/pallas_ops_check.py
+
+echo "== autotune dispatch self-check (skips without a TPU) =="
+python -m zeebe_tpu.tpu.autotune
 
 echo "CI GATE GREEN"

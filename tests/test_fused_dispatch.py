@@ -1,0 +1,118 @@
+"""Fused phase-E commit + per-family dispatch (zeebe_tpu/tpu/pallas_ops,
+zeebe_tpu/tpu/autotune).
+
+CPU pins the semantics: off-TPU every family resolves to the XLA
+fallbacks, so the fused commit must equal the unfused op chain exactly —
+the same contract that makes the parity fuzzer meaningful for the TPU
+path. The on-chip pallas-vs-XLA leg lives in
+benchmarks/pallas_ops_check.py (check_fused_commit).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from zeebe_tpu import tpu as _tpu  # noqa: F401  (enables x64)
+from zeebe_tpu.tpu import autotune, pallas_ops as pops
+
+
+def _rng_ops(rng, T, B, K):
+    tbl = jnp.asarray(rng.integers(0, 100, (T, K)), jnp.int32)
+    ring = jnp.asarray(rng.integers(0, T, (T,)), jnp.int32)
+    slots = jnp.asarray(rng.integers(0, T, (B,)), jnp.int32)
+    active = jnp.asarray(rng.random(B) < 0.7)
+    vals = jnp.asarray(rng.integers(0, 1000, (B, K)), jnp.int32)
+    mask = jnp.asarray(rng.random((B, K)) < 0.4)
+    lvals = jnp.asarray(rng.integers(0, 9, (B,)), jnp.int32)
+    return tbl, ring, slots, active, vals, mask, lvals
+
+
+class TestFusedCommitFallback:
+    def test_matches_unfused_op_chain(self):
+        """fused_table_commit == applying each op in order through the
+        standalone ops (which ARE the old kernel chain)."""
+        rng = np.random.default_rng(3)
+        T, B, K = 512, 128, 8
+        tbl, ring, slots, active, vals, mask, lvals = _rng_ops(rng, T, B, K)
+        ops = [
+            pops.TableOp(0, "add", slots, active, vals, mask),
+            pops.TableOp(0, "set", slots, active, vals, mask),
+            pops.TableOp(0, "max", slots, active, vals),
+            pops.TableOp(0, "set", slots, active, vals),  # blind row
+            pops.TableOp(1, "set", slots, active, lvals),
+            pops.TableOp(1, "add", slots, active, lvals),
+        ]
+        got = pops.fused_table_commit([tbl, ring], ops)
+
+        ref_tbl = pops.masked_row_add(tbl, slots, active, vals, mask)
+        ref_tbl = pops.masked_row_update(ref_tbl, slots, active, vals, mask)
+        ref_tbl = pops.masked_row_max(ref_tbl, slots, active, vals)
+        ref_tbl = pops.masked_row_update(ref_tbl, slots, active, vals)
+        ref_ring = pops.masked_lane_update(ring, slots, active, lvals)
+        ref_ring = pops.masked_lane_accum(ref_ring, slots, active, lvals)
+        assert (np.asarray(got[0]) == np.asarray(ref_tbl)).all()
+        assert (np.asarray(got[1]) == np.asarray(ref_ring)).all()
+
+    def test_row_add_matches_scatter_add(self):
+        rng = np.random.default_rng(5)
+        T, B, K = 256, 64, 6
+        tbl, _, slots, active, vals, mask, _ = _rng_ops(rng, T, B, K)
+        got = pops.masked_row_add(tbl, slots, active, vals, mask)
+        ref = tbl.at[jnp.where(active, slots, T)].add(
+            jnp.where(mask, vals, 0), mode="drop"
+        )
+        assert (np.asarray(got) == np.asarray(ref)).all()
+
+    def test_empty_ops_is_identity(self):
+        tbl = jnp.ones((8, 4), jnp.int32)
+        assert pops.fused_table_commit([tbl], [])[0] is tbl
+
+
+class TestDispatch:
+    def test_env_override_wins(self, monkeypatch):
+        monkeypatch.setenv("ZB_PALLAS", "0")
+        pops.set_dispatch({f: True for f in pops.FAMILIES})
+        try:
+            assert not pops.use_pallas("row_update")
+            assert not pops.use_pallas("fused")
+        finally:
+            pops.set_dispatch({})
+
+    def test_forced_context_wins_over_env(self, monkeypatch):
+        monkeypatch.setenv("ZB_PALLAS", "1")
+        with pops.forced("xla"):
+            assert not pops.use_pallas("row_update")
+        # off-TPU even forced("pallas") stays on the XLA fallbacks
+        with pops.forced("pallas"):
+            import jax
+
+            expected = jax.default_backend() == "tpu"
+            assert pops.use_pallas("row_update") == expected
+
+    def test_autotune_noop_off_tpu(self, monkeypatch):
+        import jax
+
+        if jax.default_backend() == "tpu":
+            pytest.skip("CPU-only behavior")
+        monkeypatch.delenv("ZB_PALLAS", raising=False)
+        decisions = autotune.ensure_autotuned(force=True)
+        assert decisions == {}
+        assert autotune.dispatch_source() == "off-tpu"
+
+    def test_decisions_table_consulted(self, monkeypatch):
+        """Per-family decisions drive use_pallas when no override is set
+        (only observable on TPU; off-TPU everything is False)."""
+        import jax
+
+        monkeypatch.delenv("ZB_PALLAS", raising=False)
+        pops.set_dispatch({"row_update": False, "lookup": True})
+        try:
+            if jax.default_backend() == "tpu":
+                assert not pops.use_pallas("row_update")
+                assert pops.use_pallas("lookup")
+                assert pops.use_pallas("insert")  # default stays pallas
+            else:
+                assert not pops.use_pallas("lookup")
+        finally:
+            pops.set_dispatch({})

@@ -59,6 +59,34 @@ def test_recovery_after_reopen(tmp_log_dir):
     assert reopened.append([wi_record(key=99)]) == 5
 
 
+def test_append_after_close_reopens_current_segment(tmp_log_dir):
+    """Regression (BENCH_r05 tail): an append arriving after close() —
+    broker shutdown racing a late drain — crashed with ``AttributeError:
+    'NoneType' object has no attribute 'seek'``. The storage must reopen
+    the current segment and keep the address sequence intact."""
+    storage = SegmentedLogStorage(tmp_log_dir)
+    a0 = storage.append(b"block-0")
+    storage.close()
+    a1 = storage.append(b"block-1")  # must reopen, not crash
+    assert storage.segment_of(a1) == storage.segment_of(a0)
+    assert storage.offset_of(a1) == storage.offset_of(a0) + len(b"block-0")
+    assert storage.read(a0, 7) == b"block-0"
+    assert storage.read(a1, 7) == b"block-1"
+    # close/reset interplay: reset on a closed storage must not crash
+    storage.close()
+    storage.reset()
+    assert storage.append(b"fresh") > 0
+
+
+def test_log_append_after_storage_close(tmp_log_dir):
+    log = LogStream(SegmentedLogStorage(tmp_log_dir))
+    log.append([wi_record(key=1)])
+    log.storage.close()
+    # the stream keeps accepting appends after its storage was closed
+    assert log.append([wi_record(key=2)]) == 1
+    assert [r.key for r in log.reader(0)] == [1, 2]
+
+
 def test_segment_rolling(tmp_log_dir):
     log = LogStream(SegmentedLogStorage(tmp_log_dir, segment_size=1024))
     for i in range(50):

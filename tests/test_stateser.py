@@ -49,6 +49,63 @@ def traffic_broker(tmp_path):
     b.close()
 
 
+class TestAwaitingJobsRoundTrip:
+    def test_snapshot_encode_decode_restore_round_trip(self):
+        """A leader restored from a snapshot must keep the _awaiting_jobs
+        drought backlog: dropping it strands every job that became
+        activatable while all matching subscriptions were out of credits
+        (backlog_activations never revisits them)."""
+        from zeebe_tpu.engine.interpreter import (
+            JobState,
+            JobSubscription,
+        )
+        from zeebe_tpu.protocol.intents import JobIntent
+        from zeebe_tpu.protocol.records import JobRecord
+
+        engine = PartitionEngine(repository=WorkflowRepository())
+        engine.jobs[77] = JobState(
+            state=int(JobIntent.CREATED),
+            record=JobRecord(type="work", retries=3),
+            deadline=-1,
+        )
+        engine.jobs[78] = JobState(
+            state=int(JobIntent.CREATED),
+            record=JobRecord(type="work", retries=3),
+            deadline=-1,
+        )
+        # drought: both jobs queued awaiting credits, insertion-ordered
+        engine._awaiting_jobs = {"work": {77: None, 78: None}}
+
+        payload = stateser.encode_host_state(engine.snapshot_state())
+        restored = PartitionEngine(repository=WorkflowRepository())
+        restored.restore_state(stateser.decode_host_state(payload))
+        assert restored._awaiting_jobs == {"work": {77: None, 78: None}}
+        assert list(restored._awaiting_jobs["work"]) == [77, 78]
+
+        # behavioral: a credit arriving after restore drains the backlog
+        # (register directly so the subscribe-time job-table scan does not
+        # shadow the awaiting-jobs path under test)
+        restored.job_subscriptions.append(
+            JobSubscription(
+                subscriber_key=5, job_type="work", worker="w",
+                timeout=1000, credits=1,
+            )
+        )
+        out = restored.backlog_activations()
+        assert [r.key for r in out] == [77]
+
+    def test_old_snapshot_without_awaiting_jobs_restores(self):
+        """Pre-round-6 snapshots carry no awaiting_jobs field; decode must
+        default it instead of failing the restore."""
+        engine = PartitionEngine(repository=WorkflowRepository())
+        doc = msgpack.unpack(
+            stateser.encode_host_state(engine.snapshot_state())
+        )
+        del doc["awaiting_jobs"]
+        state = stateser.decode_host_state(msgpack.pack(doc))
+        assert state["awaiting_jobs"] == {}
+
+
 class TestHostStateRoundTrip:
     def test_round_trip_preserves_replay_equivalence(self, traffic_broker):
         engine = traffic_broker.partitions[0].engine

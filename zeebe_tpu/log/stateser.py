@@ -249,6 +249,15 @@ def encode_host_state(state: Dict[str, Any]) -> bytes:
             k: [bid, dict(payload)]
             for k, (bid, payload) in state.get("pending_boundary", {}).items()
         },
+        # jobs that became activatable during a credit drought (the
+        # engine's _awaiting_jobs backlog index, Dict[type, ordered key
+        # set]); dropping it strands drought-backlogged jobs on a
+        # snapshot-restored leader — backlog_activations would never
+        # revisit them
+        "awaiting_jobs": {
+            job_type: list(keys)
+            for job_type, keys in state.get("awaiting_jobs", {}).items()
+        },
         "topic_sub_acks": dict(state["topic_sub_acks"]),
         "topics": {k: dict(v) for k, v in state["topics"].items()},
         "next_partition_id": state["next_partition_id"],
@@ -345,6 +354,13 @@ def _decode_host_doc(doc: dict) -> Dict[str, Any]:
             "pending_boundary": {
                 int(k): (str(v[0]), dict(v[1]))
                 for k, v in doc.get("pending_boundary", {}).items()
+            },
+            # ordered key set per type (insertion-ordered dict of key ->
+            # None, matching the engine's in-memory form); absent in
+            # pre-round-6 snapshots
+            "awaiting_jobs": {
+                str(job_type): {int(k): None for k in keys}
+                for job_type, keys in doc.get("awaiting_jobs", {}).items()
             },
             "topic_sub_acks": {
                 str(k): int(v) for k, v in doc["topic_sub_acks"].items()

@@ -111,9 +111,21 @@ class SegmentedLogStorage:
             self._current_file.close()
             self._current_file = None
 
+    def _ensure_open(self) -> None:
+        """Reopen the current segment after ``close()``. An append can
+        legally arrive after the storage was closed (broker shutdown races
+        a late drain; seen as ``AttributeError: 'NoneType' ... 'seek'`` in
+        the BENCH_r05 tail) — reopening is cheap and keeps the address
+        sequence intact."""
+        if self._current_file is None:
+            self._current_file = open(self._segment_path(self._current_id), "r+b")
+            self._current_file.seek(0, os.SEEK_END)
+            self._current_size = self._current_file.tell()
+
     # -- append / read -----------------------------------------------------
     def append(self, block: bytes) -> int:
         """Append a block; returns its address."""
+        self._ensure_open()
         if self._current_size + len(block) > self.segment_size and self._current_size > SEGMENT_HEADER_SIZE:
             self._roll_segment(self._current_id + 1)
         address = self.address(self._current_id, self._current_size)
@@ -147,7 +159,7 @@ class SegmentedLogStorage:
     def read(self, address: int, length: int) -> bytes:
         segment_id = self.segment_of(address)
         offset = self.offset_of(address)
-        if segment_id == self._current_id:
+        if segment_id == self._current_id and self._current_file is not None:
             self._current_file.flush()
         with open(self._segment_path(segment_id), "rb") as f:
             f.seek(offset)
@@ -176,7 +188,8 @@ class SegmentedLogStorage:
     def reset(self) -> None:
         """Delete ALL segments and roll a fresh one (snapshot fast-forward:
         the installed snapshot supersedes everything on disk)."""
-        self._current_file.close()
+        if self._current_file is not None:
+            self._current_file.close()
         self._current_file = None
         for sid in list(self._segments):
             try:
@@ -187,6 +200,7 @@ class SegmentedLogStorage:
         self._roll_segment(0)
 
     def truncate(self, address: int) -> None:
+        self._ensure_open()
         segment_id = self.segment_of(address)
         offset = self.offset_of(address)
         for sid in [s for s in self._segments if s > segment_id]:

@@ -390,12 +390,27 @@ class Broker:
                 partition.log.append([command])
             for command in partition.engine.check_message_ttls():
                 partition.log.append([command])
-            # jobs stranded by credit droughts (see backlog_activations);
-            # the device engine's tick covers its device table here too —
-            # the in-process broker has no async probe loop
+            # jobs stranded by credit droughts (see backlog_activations).
+            # The DEVICE job backlog is gated behind the same cheap fused
+            # probe the cluster broker uses (PROBE_JOB_BACKLOG): the
+            # unconditional device_backlog_activations() here pulled the
+            # whole job table device→host every tick (~150 ms on a
+            # tunneled chip) even when nothing was assignable. Unlike the
+            # cluster broker's launch-and-poll pattern, the probe here is
+            # read SYNCHRONOUSLY (one fused scalar): this embedded broker
+            # is the oracle-parity surface — a one-tick-deferred probe
+            # would assign backlog a tick later than the host oracle and
+            # break the DualRig log comparisons tick-for-tick
             backlog = partition.engine.backlog_activations()
+            probe = getattr(partition.engine, "deadlines_due_probe", None)
             if hasattr(partition.engine, "device_backlog_activations"):
-                backlog = backlog + partition.engine.device_backlog_activations()
+                from zeebe_tpu.tpu.engine import PROBE_JOB_BACKLOG
+
+                mask = int(probe()) if probe is not None else PROBE_JOB_BACKLOG
+                if mask & PROBE_JOB_BACKLOG:
+                    backlog = backlog + (
+                        partition.engine.device_backlog_activations()
+                    )
             for command in backlog:
                 partition.log.append([command])
 

@@ -33,11 +33,16 @@ def engine_factory_from_config(
             from zeebe_tpu.tpu import TpuPartitionEngine
 
             if getattr(cfg.engine, "pallas_selfcheck", True):
-                # on-chip parity smoke before the first engine serves: a
-                # broken Mosaic lowering must refuse to serve, not corrupt
-                # partition state (round-3 advisor). Memoized; no-op off-TPU.
-                from zeebe_tpu.tpu import pallas_ops
+                # autotune FIRST so the selfcheck validates the dispatch
+                # the partition will actually serve with (per-build
+                # pallas/XLA winners; cache-hit after the first boot on a
+                # given build), then the on-chip parity smoke: a broken
+                # Mosaic lowering must refuse to serve, not corrupt
+                # partition state (round-3 advisor). Memoized; no-op
+                # off-TPU.
+                from zeebe_tpu.tpu import autotune, pallas_ops
 
+                autotune.ensure_autotuned()
                 pallas_ops.selfcheck()
             engine = TpuPartitionEngine(
                 partition_id,

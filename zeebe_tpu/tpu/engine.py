@@ -89,9 +89,13 @@ def _due_probe_jit(state: "state_mod.EngineState", now: jax.Array) -> jax.Array:
     by the broker tick and polled with ``is_ready()`` so the tick never
     blocks on a device→host sync. The deadline predicates mirror the
     host sweeps below exactly (check_job_deadlines /
-    check_timer_deadlines / check_message_ttls); the backlog predicate
-    over-approximates (no type matching — a false positive costs one
-    wasted host scan, a false negative would strand jobs)."""
+    check_timer_deadlines / check_message_ttls). The backlog predicate
+    TYPE-MATCHES jobs against credited subscriptions: the earlier
+    over-approximation (any assignable job AND any credited sub) kept
+    the bit set whenever one orphan job of an unserved type coexisted
+    with any credited subscription, paying a ~150 ms device→host
+    backlog pull every tick for nothing. [M, S] broadcast over the small
+    subscription table — still one fused reduction, no host round trip."""
     job_due = jnp.any(
         (state.job_state == int(JI.ACTIVATED))
         & (state.job_deadline >= 0)
@@ -99,18 +103,20 @@ def _due_probe_jit(state: "state_mod.EngineState", now: jax.Array) -> jax.Array:
     )
     timer_due = jnp.any((state.timer_key >= 0) & (state.timer_due <= now))
     msg_due = jnp.any((state.msg_key >= 0) & (state.msg_deadline <= now))
-    assignable = jnp.any(
-        (
-            (state.job_state == int(JI.CREATED))
-            | (state.job_state == int(JI.TIMED_OUT))
-            | (state.job_state == int(JI.FAILED))
-        )
-        & (state.job_i32[:, state_mod.JB_RETRIES] > 0)
+    assignable = (
+        (state.job_state == int(JI.CREATED))
+        | (state.job_state == int(JI.TIMED_OUT))
+        | (state.job_state == int(JI.FAILED))
+    ) & (state.job_i32[:, state_mod.JB_RETRIES] > 0)
+    credited = state.sub_valid & (state.sub_credits > 0)
+    backlog = jnp.any(
+        assignable[:, None]
+        & credited[None, :]
+        & (state.job_i32[:, state_mod.JB_TYPE, None] == state.sub_type[None, :])
     )
-    credits_free = jnp.any(state.sub_valid & (state.sub_credits > 0))
     return (
         (job_due | timer_due | msg_due).astype(jnp.int32) * PROBE_DEADLINES
-        + (assignable & credits_free).astype(jnp.int32) * PROBE_JOB_BACKLOG
+        + backlog.astype(jnp.int32) * PROBE_JOB_BACKLOG
     )
 
 
@@ -148,6 +154,13 @@ class TpuPartitionEngine:
         self.num_partitions = num_partitions
         self.repository = repository if repository is not None else WorkflowRepository()
         self.clock = clock or (lambda: 0)
+        # pallas-vs-XLA dispatch is BUILD-dependent (PERF_NOTES round 4):
+        # measure once per process on the actual libtpu build (disk-cached
+        # per build fingerprint) instead of trusting a static env default.
+        # No-op off-TPU; ZB_PALLAS stays the manual override.
+        from zeebe_tpu.tpu import autotune
+
+        autotune.ensure_autotuned()
         self.capacity = capacity
         self.num_vars = num_vars
         self.interns = InternTable()
@@ -883,8 +896,13 @@ class TpuPartitionEngine:
         ]
         out: List[Record] = []
         now = self.clock()
-        rr = 0
         sub_slots = [int(i) for i in np.nonzero(valid)[0]]
+        # the round-robin cursor persists in state.sub_rr across calls
+        # (and across snapshot/restore): a fresh `rr = 0` every tick made
+        # the first credited subscription win every drain, starving the
+        # rest — the oracle's _job_rr_cursor is global, so this is also
+        # host-oracle parity
+        rr = int(np.asarray(s.sub_rr)) % len(sub_slots)
         for key, slot in sorted(candidates):
             type_id = int(job_i32[slot, state_mod.JB_TYPE])
             target = None
@@ -912,9 +930,10 @@ class TpuPartitionEngine:
                     ),
                 )
             )
-        if out:
+        if out:  # rr only advances on an assignment, which also appends
             self.state = dataclasses.replace(
-                s, sub_credits=jnp.asarray(sub_credits)
+                s, sub_credits=jnp.asarray(sub_credits),
+                sub_rr=jnp.asarray(rr, jnp.int32),
             )
         return out
 

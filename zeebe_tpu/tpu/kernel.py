@@ -161,63 +161,6 @@ def _indexed_lookup(index, key_col, fallback_map, keys, want, cap):
     return hit | fb_ok, jnp.where(hit, cand_clip, fb_clip)
 
 
-def _scatter_pay(pay, slots, mask, b_pay, size):
-    """Write packed batch payload rows ([B, 3V] i32) into table rows at
-    ``slots`` (last writer wins). The explicit last-writer dedup keeps the
-    XLA fallback deterministic on duplicate slots (XLA duplicate-index
-    scatter order is implementation-defined) and matches the pallas
-    path's serial batch order exactly."""
-    win = _last_writer(slots, mask, size)
-    return pops.masked_row_update(pay, slots, win, b_pay)
-
-
-def _col_update(tbl, slots, active, col, val):
-    """Single-column table update: ``tbl[slot, col] = val`` for active
-    records (replaces ``.at[where(active, slot, cap), col].set``)."""
-    b = slots.shape[0]
-    k = tbl.shape[1]
-    if jnp.ndim(val) == 0:
-        val = jnp.full((b,), val, tbl.dtype)
-    vals = jnp.zeros((b, k), tbl.dtype).at[:, col].set(val)
-    mask = jnp.zeros((b, k), bool).at[:, col].set(True)
-    return pops.masked_row_update(tbl, slots, active, vals, mask)
-
-
-def _cols_update(tbl, slots, active, cols, col_vals):
-    """Multi-column variant: cols is a static tuple, col_vals matching
-    [B]-vectors (or scalars)."""
-    b = slots.shape[0]
-    k = tbl.shape[1]
-    vals = jnp.zeros((b, k), tbl.dtype)
-    mask = jnp.zeros((b, k), bool)
-    for col, val in zip(cols, col_vals):
-        if jnp.ndim(val) == 0:
-            val = jnp.full((b,), val, tbl.dtype)
-        vals = vals.at[:, col].set(val.astype(tbl.dtype))
-        mask = mask.at[:, col].set(True)
-    return pops.masked_row_update(tbl, slots, active, vals, mask)
-
-
-def _col64_update(planes, slots, active, col, val64):
-    """Single-i64-column update on a planes view ([N, 2C] i32)."""
-    b = slots.shape[0]
-    if jnp.ndim(val64) == 0:
-        val64 = jnp.full((b,), val64, jnp.int64)
-    v2 = pops.vec64_to_planes(val64.astype(jnp.int64))
-    k = planes.shape[1]
-    vals = (
-        jnp.zeros((b, k), jnp.int32)
-        .at[:, 2 * col].set(v2[:, 0])
-        .at[:, 2 * col + 1].set(v2[:, 1])
-    )
-    mask = (
-        jnp.zeros((b, k), bool)
-        .at[:, 2 * col].set(True)
-        .at[:, 2 * col + 1].set(True)
-    )
-    return pops.masked_row_update(planes, slots, active, vals, mask)
-
-
 def _apply_mappings(graph, wf, elem, src_vt, src_num, src_sid, is_input):
     """Vectorized MappingProcessor.extract (input) source selection.
 
@@ -369,12 +312,18 @@ def step_kernel(
     jb_clip = jnp.clip(jb_slot, 0, m_cap - 1)
     tm_clip = jnp.clip(tm_slot, 0, t_cap - 1)
 
-    # ONE row gather per slot vector feeds every phase-B column read —
+    # ONE row gather per slot vector feeds every phase-B/C column read —
     # a [B, 6] row gather costs the same as a [B] column gather (the cost
-    # is per-index issue, not bytes), and phases read 2-3 columns per role
+    # is per-index issue, not bytes), and phases read 2-3 columns per role.
+    # The same applies to the i64 planes (aik instance keys) and the job
+    # table: every per-role read below slices these gathered rows instead
+    # of issuing its own [B] column gather.
     ei_rows = state.ei_i32[ei_clip]
     sc_rows = state.ei_i32[sc_clip]
     aik_rows = state.ei_i32[aik_clip]
+    aik_i64_rows = state.ei_i64[aik_clip]
+    jb_i32_rows = state.job_i32[jb_clip]
+    jb_i64_rows = state.job_i64[jb_clip]
     inst_state = jnp.where(ei_found, ei_rows[:, EI_STATE], -1)
     scope_state = jnp.where(sc_found, sc_rows[:, EI_STATE], -1)
 
@@ -453,7 +402,7 @@ def step_kernel(
     m_mi = m_step(BS.MULTI_INSTANCE_SPLIT)
 
     # job commands
-    job_state = jnp.where(jb_found, state.job_state[jb_clip], -1)
+    job_state = jnp.where(jb_found, jb_i32_rows[:, JB_STATE], -1)
     m_jcreate = job_cmd & (it == int(JI.CREATE))
     m_jactivate = job_cmd & (it == int(JI.ACTIVATE))
     m_jcomplete = job_cmd & (it == int(JI.COMPLETE))
@@ -938,9 +887,7 @@ def step_kernel(
     pid_col = jnp.broadcast_to(jnp.asarray(partition_id, jnp.int32), (b,))
 
     # --- slot 0: workflow-instance emissions
-    scope_parent = jnp.where(
-        sc_found, state.ei_scope_slot[sc_clip], -1
-    )
+    scope_parent = jnp.where(sc_found, sc_rows[:, EI_SCOPE], -1)
     scope_parent_key = jnp.where(
         scope_parent >= 0, state.ei_key[jnp.clip(scope_parent, 0, n_cap - 1)], -1
     )
@@ -1103,15 +1050,16 @@ def step_kernel(
             type_id=batch.type_id, retries=batch.retries,
             worker=batch.worker, src=jnp.full((b,), -1, jnp.int32),
         )
-    # completed value = stored job record + command payload
-    st_elem = state.job_elem[jb_clip]
-    st_wf = state.job_wf[jb_clip]
-    st_ik = state.job_instance_key[jb_clip]
-    st_aik = state.job_aik[jb_clip]
-    st_type = state.job_type[jb_clip]
-    st_retries = state.job_retries[jb_clip]
-    st_worker = state.job_worker[jb_clip]
-    st_deadline = state.job_deadline[jb_clip]
+    # completed value = stored job record + command payload (columns of
+    # the phase-A jb row gathers — no per-column gathers here)
+    st_elem = jb_i32_rows[:, JB_ELEM]
+    st_wf = jb_i32_rows[:, JB_WF]
+    st_ik = jb_i64_rows[:, JBL_IKEY]
+    st_aik = jb_i64_rows[:, JBL_AIK]
+    st_type = jb_i32_rows[:, JB_TYPE]
+    st_retries = jb_i32_rows[:, JB_RETRIES]
+    st_worker = jb_i32_rows[:, JB_WORKER]
+    st_deadline = jb_i64_rows[:, JBL_DEADLINE]
     e0 = put(
         e0, jcomp_ok,
         valid=True, rtype=RT_EVENT, vtype=VT_JOB, intent=int(JI.COMPLETED),
@@ -1260,7 +1208,7 @@ def step_kernel(
     e1["v_num"] = jnp.where(ttrig_any_inst[:, None], wi_of_inst_num, e1["v_num"])
     e1["v_str"] = jnp.where(ttrig_any_inst[:, None], wi_of_inst_sid, e1["v_str"])
     e1["instance_key"] = jnp.where(
-        ttrig_any_inst, state.ei_instance_key[aik_clip], e1["instance_key"]
+        ttrig_any_inst, aik_i64_rows[:, EIL_IKEY], e1["instance_key"]
     )
     e0 = put(
         e0, ttrig_rej,
@@ -1409,7 +1357,7 @@ def step_kernel(
         e1["v_str"] = jnp.where(corr_bd_int[:, None], wi_of_inst_sid, e1["v_str"])
         corr_any_inst = corr_inst_ok | corr_bd_non | corr_bd_int
         e1["instance_key"] = jnp.where(
-            corr_any_inst, state.ei_instance_key[aik_clip], e1["instance_key"]
+            corr_any_inst, aik_i64_rows[:, EIL_IKEY], e1["instance_key"]
         )
         e2 = put(
             e2, corr_inst_ok | corr_bd_int,
@@ -1727,38 +1675,62 @@ def step_kernel(
             )
             em["src"] = em["src"].at[:, f].set(rows)
 
-    # ---------------- state scatters ----------------
-    # token counters
-    tok_delta = jnp.zeros((n_cap,), jnp.int32)
-    tok_delta = pops.masked_lane_accum(
-        tok_delta, sc_clip, m_consume, jnp.full((b,), -1, jnp.int32)
-    )
-    tok_delta = pops.masked_lane_accum(
-        tok_delta, sc_clip, m_psplit, out_count - 1
-    )
+    # -------- state scatters: fused phase-E commits --------
+    # Every table write below is expressed as a pops.TableOp and committed
+    # through pops.fused_table_commit: ONE pallas mega-pass per table group
+    # (element instances, jobs, timers) that keeps the tables VMEM-resident
+    # and applies the whole ~20-op write tail in a single serial pass — the
+    # per-record cost is a handful of VPU instructions instead of ~20ns of
+    # per-index DMA issue PER OP (PERF_NOTES round-4 cost model). Where the
+    # engine-boot autotune picked the unfused path (or off-TPU), the commit
+    # degrades to the exact previous op chain, so the CPU parity suites pin
+    # the semantics bit-for-bit. Op order matches the old op-major chain;
+    # the only cross-op row sharing between records is through commutative
+    # "add" ops (token counters), so the mega-pass's chunk-major execution
+    # is observationally identical.
+    ei_i64_pl = pops.i64_to_planes(state.ei_i64)
+    ei_k32 = state.ei_i32.shape[1]
+    T_EI32, T_EI64, T_EIPAY, T_EIFREE, T_EIIDX = range(5)
+    ei_ops = []
+
+    def _col_op(k, col, val):
+        """([B, k] vals, [B, k] mask) pair writing ``val`` into one column."""
+        if jnp.ndim(val) == 0:
+            val = jnp.full((b,), val, jnp.int32)
+        vals = jnp.zeros((b, k), jnp.int32).at[:, col].set(
+            val.astype(jnp.int32)
+        )
+        mask = jnp.zeros((b, k), bool).at[:, col].set(True)
+        return vals, mask
+
+    # token counters: one select-by-kind accumulate on the scope row (a
+    # record is exactly one of consume / parallel-split / join-complete,
+    # so the old per-kind accumulate chain merges into one commutative op)
     nin_rec = join_nin_arr[arr_slot]
-    tok_delta = pops.masked_lane_accum(
-        tok_delta, sc_clip, completer, -(nin_rec - 1)
+    tok_m = m_consume | m_psplit | completer
+    tok_v = jnp.where(
+        m_consume, jnp.int32(-1),
+        jnp.where(m_psplit, out_count - 1, -(nin_rec - 1)),
     )
+    tok_vals, tok_mask = _col_op(ei_k32, EI_TOKENS, tok_v)
+    ei_ops.append(pops.TableOp(T_EI32, "add", sc_clip, tok_m, tok_vals, tok_mask))
     if graph.has_boundaries:
         # non-interrupting boundary fire: the host's scope gains a token
         # for the boundary path (oracle: scope.active_tokens += 1)
-        tok_delta = pops.masked_lane_accum(
-            tok_delta, jnp.clip(inst_scope_slot, 0, n_cap - 1),
-            ttrig_bd_non | corr_bd_non, jnp.ones((b,), jnp.int32),
-        )
-    ei_i32_arr = state.ei_i32.at[:, EI_TOKENS].add(tok_delta)
-    ei_i32_arr = _col_update(ei_i32_arr, ei_clip, m_trigstart, EI_TOKENS, 1)
+        bd_vals, bd_mask = _col_op(ei_k32, EI_TOKENS, jnp.ones((b,), jnp.int32))
+        ei_ops.append(pops.TableOp(
+            T_EI32, "add", jnp.clip(inst_scope_slot, 0, n_cap - 1),
+            ttrig_bd_non | corr_bd_non, bd_vals, bd_mask,
+        ))
+    # start-trigger / multi-instance container token counts (own row; the
+    # container holds one token per body iteration — disjoint step kinds)
+    tokset_m = m_trigstart
+    tokset_v = jnp.ones((b,), jnp.int32)
     if graph.has_multi_instance:
-        # the container holds one token per body iteration
-        ei_i32_arr = _col_update(
-            ei_i32_arr, ei_clip, m_mi, EI_TOKENS,
-            emeta[:, graph_mod.EM_MI_CARD],
-        )
-
-    # i64 columns operate on the planes view until the end of the phase
-    # (TPU i64 is emulated; the pallas kernels take i32 planes)
-    ei_i64_pl = pops.i64_to_planes(state.ei_i64)
+        tokset_m = tokset_m | m_mi
+        tokset_v = jnp.where(m_mi, emeta[:, graph_mod.EM_MI_CARD], 1)
+    ts_vals, ts_mask = _col_op(ei_k32, EI_TOKENS, tokset_v)
+    ei_ops.append(pops.TableOp(T_EI32, "set", ei_clip, tokset_m, ts_vals, ts_mask))
 
     # scope payload on consume (oracle: scope value.payload = record
     # payload — EXCEPT multi-instance containers, whose iteration-local
@@ -1774,24 +1746,26 @@ def step_kernel(
             0, graph.elem_type.shape[0] - 1,
         )
         mi_scope = graph.mi_cardinality[scope_wf_c, scope_elem_c] > 0
-        ei_pay = _scatter_pay(
-            state.ei_pay, sc_clip, m_consume & ~mi_scope, b_pay, n_cap
-        )
+        consume_pay_m = m_consume & ~mi_scope
     else:
-        mi_scope = jnp.zeros((b,), bool)
-        ei_pay = _scatter_pay(state.ei_pay, sc_clip, m_consume, b_pay, n_cap)
+        consume_pay_m = m_consume
+    ei_ops.append(pops.TableOp(
+        T_EIPAY, "set", sc_clip,
+        _last_writer(sc_clip, consume_pay_m, n_cap), b_pay,
+    ))
     # scope state transition by consume completer
-    ei_i32_arr = _col_update(
-        ei_i32_arr, sc_clip, consume_completer, EI_STATE,
-        int(WI.ELEMENT_COMPLETING),
+    cc_vals, cc_mask = _col_op(
+        ei_k32, EI_STATE, jnp.int32(int(WI.ELEMENT_COMPLETING))
     )
-    # -- own-row transitions, ONE composed scatter per dtype family -------
+    ei_ops.append(pops.TableOp(
+        T_EI32, "set", sc_clip, consume_completer, cc_vals, cc_mask
+    ))
+    # -- own-row transitions, ONE composed write per dtype family ---------
     # Every record is exactly one step kind (the guard predicates are
     # mutually exclusive per record, and the no-concurrent-transition
     # guards exclude two records transitioning the same instance row in
     # one round), so the per-kind column writes compose into a single
-    # select-by-kind row scatter instead of one scatter per kind — the
-    # profiled cost is per-op, and this section was ~9 ops.
+    # select-by-kind row write instead of one write per kind.
     if graph.has_boundaries:
         bd_int_any = ttrig_bd_int | corr_bd_int
         term_all = m_term_job | m_term_catch | m_term_elem
@@ -1817,8 +1791,8 @@ def step_kernel(
             ),
         ),
     )
-    own_vals = jnp.zeros((b, ei_i32_arr.shape[1]), jnp.int32)
-    own_mask = jnp.zeros((b, ei_i32_arr.shape[1]), bool)
+    own_vals = jnp.zeros((b, ei_k32), jnp.int32)
+    own_mask = jnp.zeros((b, ei_k32), bool)
     own_vals = own_vals.at[:, EI_STATE].set(own_state_v)
     own_mask = own_mask.at[:, EI_STATE].set(own_state_m)
     if graph.has_boundaries:
@@ -1828,9 +1802,9 @@ def step_kernel(
         )
         own_mask = own_mask.at[:, EI_PENDING_BD].set(bd_int_any)
     own_active = own_state_m
-    ei_i32_arr = pops.masked_row_update(
-        ei_i32_arr, own_slot, own_active, own_vals, own_mask
-    )
+    ei_ops.append(pops.TableOp(
+        T_EI32, "set", own_slot, own_active, own_vals, own_mask
+    ))
 
     # own-row payloads: input mapping writes the mapped document, job
     # completion / message-boundary interruption write the record payload
@@ -1838,7 +1812,10 @@ def step_kernel(
                                             else jnp.zeros((b,), bool))
     inmap_pay = pack_payload(in_vt, in_sid, in_num)
     own_pay = jnp.where(inmap_ok[:, None], inmap_pay, b_pay)
-    ei_pay = _scatter_pay(ei_pay, own_slot, own_pay_m, own_pay, n_cap)
+    ei_ops.append(pops.TableOp(
+        T_EIPAY, "set", own_slot,
+        _last_writer(own_slot, own_pay_m, n_cap), own_pay,
+    ))
 
     # own-row i64 columns (job-key attach/detach, removal key clear)
     jobkey_m = jev_completed | (jev_created & aik_found)
@@ -1864,9 +1841,9 @@ def step_kernel(
         .at[:, 2 * EIL_KEY + 1].set(True),
         ei64_mask,
     )
-    ei_i64_pl = pops.masked_row_update(
-        ei_i64_pl, ei64_slot, jobkey_m | ei_remove, ei64_vals, ei64_mask
-    )
+    ei_ops.append(pops.TableOp(
+        T_EI64, "set", ei64_slot, jobkey_m | ei_remove, ei64_vals, ei64_mask
+    ))
     # no map delete: the removed row's key column is cleared above, and
     # every lookup verifies against it — stale index/map entries are inert
     ei_map = state.ei_map
@@ -1900,32 +1877,58 @@ def step_kernel(
     ei_push_m = _last_writer(ei_clip, ei_remove, n_cap)
     ei_rm_rank = _excl_cumsum(ei_push_m.astype(jnp.int32))
     ei_push_idx = state.free_ei_push + ei_rm_rank.astype(jnp.int64)
-    free_ei_arr = state.free_ei.at[
-        jnp.where(ei_push_m, (ei_push_idx % n_cap).astype(jnp.int32), n_cap)
-    ].set(ei_clip, mode="drop")
+    ei_ops.append(pops.TableOp(
+        T_EIFREE, "set", (ei_push_idx % n_cap).astype(jnp.int32),
+        ei_push_m, ei_clip,
+    ))
     free_ei_push_new = state.free_ei_push + jnp.sum(ei_push_m, dtype=jnp.int64)
-    # one row pass per dtype group (the point of the packed layout)
+    # one row write per dtype group (the point of the packed layout)
     ei_i32_rows = jnp.stack(
         [ins_elem,
          jnp.full((b,), int(WI.ELEMENT_READY), jnp.int32),
          batch.wf, ins_parent, jnp.zeros((b,), jnp.int32),
          jnp.full((b,), -1, jnp.int32)], axis=-1,  # no pending boundary
     )
-    ei_i32_arr = pops.masked_row_update(ei_i32_arr, ins_slot, ins, ei_i32_rows)
+    ei_ops.append(pops.TableOp(T_EI32, "set", ins_slot, ins, ei_i32_rows))
     ei_i64_rows = jnp.stack(
         [ins_key, ins_ikey, jnp.full((b,), -1, jnp.int64)], axis=-1
     )
-    ei_i64_pl = pops.masked_row_update(
-        ei_i64_pl, ins_slot, ins, pops.i64_to_planes(ei_i64_rows)
-    )
-    ei_pay = pops.masked_row_update(ei_pay, ins_slot, ins, b_pay)
+    ei_ops.append(pops.TableOp(
+        T_EI64, "set", ins_slot, ins, pops.i64_to_planes(ei_i64_rows)
+    ))
+    ei_ops.append(pops.TableOp(T_EIPAY, "set", ins_slot, ins, b_pay))
     ei_icap = state.ei_index.shape[0]
-    ei_index_arr = state.ei_index.at[
-        jnp.where(ins, (ins_key // 5) & (ei_icap - 1), ei_icap).astype(jnp.int32)
-    ].set(ins_slot, mode="drop")
+    ei_ops.append(pops.TableOp(
+        T_EIIDX, "set", ((ins_key // 5) & (ei_icap - 1)).astype(jnp.int32),
+        ins, ins_slot,
+    ))
+    if graph.has_messages:
+        # correlate arrival → instance completes with the message payload
+        corr_vals, corr_mask = _col_op(
+            ei_k32, EI_STATE, jnp.int32(int(WI.ELEMENT_COMPLETING))
+        )
+        ei_ops.append(pops.TableOp(
+            T_EI32, "set", aik_clip, corr_inst_ok, corr_vals, corr_mask
+        ))
+        ei_ops.append(pops.TableOp(
+            T_EIPAY, "set", aik_clip,
+            _last_writer(aik_clip, corr_inst_ok, n_cap), b_pay,
+        ))
+
+    ei_i32_arr, ei_i64_pl, ei_pay, free_ei_arr, ei_index_arr = (
+        pops.fused_table_commit(
+            [state.ei_i32, ei_i64_pl, state.ei_pay, state.free_ei,
+             state.ei_index],
+            ei_ops,
+        )
+    )
     ei_i64_arr = pops.planes_to_i64(ei_i64_pl)
 
-    # ---------------- job table ----------------
+    # ---------------- job table (fused commit) ----------------
+    T_J32, T_J64, T_JPAY, T_JFREE, T_JIDX = range(5)
+    job_i64_pl = pops.i64_to_planes(state.job_i64)
+    job_k32 = state.job_i32.shape[1]
+    job_ops = []
     job_ins = m_jcreate
     j_rank = _excl_cumsum(job_ins.astype(jnp.int32))
     job_pop_idx = state.free_job_pop + j_rank.astype(jnp.int64)
@@ -1942,26 +1945,24 @@ def step_kernel(
          batch.elem, batch.wf, batch.type_id, batch.retries,
          jnp.zeros((b,), jnp.int32)], axis=-1,
     )
-    job_i32_arr = pops.masked_row_update(
-        state.job_i32, j_slot, job_ins, job_i32_rows
-    )
-    job_i64_pl = pops.i64_to_planes(state.job_i64)
+    job_ops.append(pops.TableOp(T_J32, "set", j_slot, job_ins, job_i32_rows))
     job_i64_rows = jnp.stack(
         [job_base, batch.instance_key, batch.aux_key,
          jnp.full((b,), -1, jnp.int64)], axis=-1,
     )
-    job_i64_pl = pops.masked_row_update(
-        job_i64_pl, j_slot, job_ins, pops.i64_to_planes(job_i64_rows)
-    )
-    job_pay_arr = pops.masked_row_update(state.job_pay, j_slot, job_ins, b_pay)
+    job_ops.append(pops.TableOp(
+        T_J64, "set", j_slot, job_ins, pops.i64_to_planes(job_i64_rows)
+    ))
+    job_ops.append(pops.TableOp(T_JPAY, "set", j_slot, job_ins, b_pay))
     job_icap = state.job_index.shape[0]
-    job_index_arr = state.job_index.at[
-        jnp.where(job_ins, (job_base // 5) & (job_icap - 1), job_icap).astype(jnp.int32)
-    ].set(j_slot, mode="drop")
+    job_ops.append(pops.TableOp(
+        T_JIDX, "set", ((job_base // 5) & (job_icap - 1)).astype(jnp.int32),
+        job_ins, j_slot,
+    ))
     job_map = state.job_map
 
     # transitions: every record is one job step kind and all kinds target
-    # jb_clip, so the per-kind column writes compose into ONE row scatter
+    # jb_clip, so the per-kind column writes compose into ONE row write
     # per dtype family (select-by-kind values)
     job_rm = jcomp_ok | jcan_ok
     jstate_m = jact_ok | jfail_ok | jtime_ok | job_rm
@@ -1976,17 +1977,17 @@ def step_kernel(
         ),
     )
     jretries_m = jact_ok | jfail_ok | jret_ok
-    jb_vals = jnp.zeros((b, job_i32_arr.shape[1]), jnp.int32)
-    jb_mask = jnp.zeros((b, job_i32_arr.shape[1]), bool)
+    jb_vals = jnp.zeros((b, job_k32), jnp.int32)
+    jb_mask = jnp.zeros((b, job_k32), bool)
     jb_vals = jb_vals.at[:, JB_STATE].set(jstate_v)
     jb_mask = jb_mask.at[:, JB_STATE].set(jstate_m)
     jb_vals = jb_vals.at[:, JB_RETRIES].set(batch.retries)
     jb_mask = jb_mask.at[:, JB_RETRIES].set(jretries_m)
     jb_vals = jb_vals.at[:, JB_WORKER].set(batch.worker)
     jb_mask = jb_mask.at[:, JB_WORKER].set(jact_ok)
-    job_i32_arr = pops.masked_row_update(
-        job_i32_arr, jb_clip, jstate_m | jret_ok, jb_vals, jb_mask
-    )
+    job_ops.append(pops.TableOp(
+        T_J32, "set", jb_clip, jstate_m | jret_ok, jb_vals, jb_mask
+    ))
 
     jd2 = pops.vec64_to_planes(batch.deadline)
     jneg2 = pops.vec64_to_planes(jnp.full((b,), -1, jnp.int64))
@@ -2008,24 +2009,33 @@ def step_kernel(
         .at[:, 2 * JBL_KEY + 1].set(True),
         j64_mask,
     )
-    job_i64_pl = pops.masked_row_update(
-        job_i64_pl, jb_clip, jact_ok | job_rm, j64_vals, j64_mask
-    )
+    job_ops.append(pops.TableOp(
+        T_J64, "set", jb_clip, jact_ok | job_rm, j64_vals, j64_mask
+    ))
 
     jpay_m = jact_ok | jfail_ok
     jpay = jnp.where(
         jfail_ok[:, None], pack_payload(fail_vt, fail_sid, fail_num), b_pay
     )
-    job_pay_arr = pops.masked_row_update(job_pay_arr, jb_clip, jpay_m, jpay)
-    job_i64_arr = pops.planes_to_i64(job_i64_pl)
+    job_ops.append(pops.TableOp(T_JPAY, "set", jb_clip, jpay_m, jpay))
     # dedup per slot (see the ei ring push)
     job_push_m = _last_writer(jb_clip, job_rm, m_cap)
     job_rm_rank = _excl_cumsum(job_push_m.astype(jnp.int32))
     job_push_idx = state.free_job_push + job_rm_rank.astype(jnp.int64)
-    free_job_arr = state.free_job.at[
-        jnp.where(job_push_m, (job_push_idx % m_cap).astype(jnp.int32), m_cap)
-    ].set(jb_clip, mode="drop")
+    job_ops.append(pops.TableOp(
+        T_JFREE, "set", (job_push_idx % m_cap).astype(jnp.int32),
+        job_push_m, jb_clip,
+    ))
     free_job_push_new = state.free_job_push + jnp.sum(job_push_m, dtype=jnp.int64)
+
+    job_i32_arr, job_i64_pl, job_pay_arr, free_job_arr, job_index_arr = (
+        pops.fused_table_commit(
+            [state.job_i32, job_i64_pl, state.job_pay, state.free_job,
+             state.job_index],
+            job_ops,
+        )
+    )
+    job_i64_arr = pops.planes_to_i64(job_i64_pl)
 
     # ---------------- join cleanup ----------------
     if graph.has_parallel_joins:
@@ -2050,37 +2060,50 @@ def step_kernel(
 
     # ---------------- timer table ----------------
     if graph.has_timers:
+        # fused commit over the timer bookkeeping columns (i64 columns as
+        # [TM, 2] i32 planes, elem/wf as 1D lane tables): the 8 insert /
+        # remove writes ride one mega-pass; the hashmap insert/delete stay
+        # their own probe kernels
         t_ins = m_tcreate
         tfree = _first_true_indices(state.timer_key < 0, b)
         t_rank = _excl_cumsum(t_ins.astype(jnp.int32))
         t_slot = tfree[jnp.clip(t_rank, 0, b - 1)]
         timer_overflow = jnp.any(t_ins & (t_slot >= t_cap))
-        timer_key_arr = pops.masked_vec64_update(
-            state.timer_key, t_slot, t_ins, key0
-        )
-        timer_due_arr = pops.masked_vec64_update(
-            state.timer_due, t_slot, t_ins, batch.deadline
-        )
-        timer_aik_arr = pops.masked_vec64_update(
-            state.timer_aik, t_slot, t_ins, batch.aux_key
-        )
-        timer_ik_arr = pops.masked_vec64_update(
-            state.timer_instance_key, t_slot, t_ins, batch.instance_key
-        )
-        timer_elem_arr = pops.masked_lane_update(
-            state.timer_elem, t_slot, t_ins, batch.elem
-        )
-        timer_wf_arr = pops.masked_lane_update(
-            state.timer_wf, t_slot, t_ins, batch.wf
-        )
-        timer_map, _t_ok = pops.insert(state.timer_map, key0, t_slot, t_ins)
         t_rm = ttrig_ok | tcan_ok
-        timer_key_arr = pops.masked_vec64_update(
-            timer_key_arr, tm_clip, t_rm, jnp.full((b,), -1, jnp.int64)
+        tneg_pl = pops.vec64_to_planes(jnp.full((b,), -1, jnp.int64))
+        T_TK, T_TD, T_TA, T_TIK, T_TE, T_TW = range(6)
+        timer_ops = [
+            pops.TableOp(T_TK, "set", t_slot, t_ins, pops.vec64_to_planes(key0)),
+            pops.TableOp(
+                T_TD, "set", t_slot, t_ins, pops.vec64_to_planes(batch.deadline)
+            ),
+            pops.TableOp(
+                T_TA, "set", t_slot, t_ins, pops.vec64_to_planes(batch.aux_key)
+            ),
+            pops.TableOp(
+                T_TIK, "set", t_slot, t_ins,
+                pops.vec64_to_planes(batch.instance_key),
+            ),
+            pops.TableOp(T_TE, "set", t_slot, t_ins, batch.elem),
+            pops.TableOp(T_TW, "set", t_slot, t_ins, batch.wf),
+            pops.TableOp(T_TK, "set", tm_clip, t_rm, tneg_pl),
+            pops.TableOp(T_TD, "set", tm_clip, t_rm, tneg_pl),
+        ]
+        tk_pl, td_pl, ta_pl, tik_pl, timer_elem_arr, timer_wf_arr = (
+            pops.fused_table_commit(
+                [pops.i64_to_planes(state.timer_key[:, None]),
+                 pops.i64_to_planes(state.timer_due[:, None]),
+                 pops.i64_to_planes(state.timer_aik[:, None]),
+                 pops.i64_to_planes(state.timer_instance_key[:, None]),
+                 state.timer_elem, state.timer_wf],
+                timer_ops,
+            )
         )
-        timer_due_arr = pops.masked_vec64_update(
-            timer_due_arr, tm_clip, t_rm, jnp.full((b,), -1, jnp.int64)
-        )
+        timer_key_arr = pops.planes_to_i64(tk_pl)[:, 0]
+        timer_due_arr = pops.planes_to_i64(td_pl)[:, 0]
+        timer_aik_arr = pops.planes_to_i64(ta_pl)[:, 0]
+        timer_ik_arr = pops.planes_to_i64(tik_pl)[:, 0]
+        timer_map, _t_ok = pops.insert(state.timer_map, key0, t_slot, t_ins)
         timer_map = pops.delete(timer_map, batch.key, t_rm)
     else:
         timer_overflow = jnp.zeros((), bool)
@@ -2159,13 +2182,6 @@ def step_kernel(
             msg_deadline_arr, mmsg_clip, del_ok, neg64
         )
         msg_map_arr = pops.delete(msg_map_arr, ckey, del_ok)
-
-        # correlate arrival → instance completes with the message payload
-        ei_i32_arr = _col_update(
-            ei_i32_arr, aik_clip, corr_inst_ok, EI_STATE,
-            int(WI.ELEMENT_COMPLETING),
-        )
-        ei_pay = _scatter_pay(ei_pay, aik_clip, corr_inst_ok, b_pay, n_cap)
 
         message_overflow = (
             msub_overflow | msg_overflow

@@ -33,8 +33,10 @@ check in benchmarks/).
 
 from __future__ import annotations
 
+import contextlib
+import dataclasses
 import functools
-from typing import Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -49,18 +51,84 @@ LANES = 128
 # python literal adopts i32 from the row instead of promoting
 _CHUNK = 2048  # records per grid step; scalars per chunk must fit SMEM
 
+# ---------------------------------------------------------------------------
+# per-family dispatch: pallas vs XLA is BUILD-dependent (PERF_NOTES round 4:
+# libtpu builds with the serial per-index scatter lowering need the pallas
+# passes, builds with the DMA-pipelined lowering are faster through plain
+# XLA — and the winner flipped between builds). The engine-boot autotune
+# (zeebe_tpu.tpu.autotune) measures both paths per op family on the actual
+# build and writes the winners here; ZB_PALLAS=0/1 remains the manual
+# override for A/B benchmarking.
+# ---------------------------------------------------------------------------
 
-def _use_pallas() -> bool:
-    """Pallas table ops on TPU; ``ZB_PALLAS=0`` forces the XLA fallbacks
-    (useful for A/B benchmarking — the fast path differs per XLA build:
-    libtpu builds with the serial per-index scatter lowering need the
-    pallas passes, builds with the DMA-pipelined scatter/gather lowering
-    are faster through plain XLA)."""
+FAMILIES = (
+    "row_update", "row_max", "row_add", "lane", "vec64",
+    "lookup", "insert", "delete", "fused",
+)
+
+# family -> use pallas?  Written once by autotune.set_dispatch; until then
+# every family defaults to pallas-on-TPU (the pre-autotune behavior).
+_DECISIONS: dict = {}
+_FORCED: Optional[str] = None  # "pallas" | "xla" | None (autotune probes)
+
+
+def set_dispatch(decisions: dict) -> None:
+    """Install autotuned per-family decisions ({family: bool})."""
+    _DECISIONS.clear()
+    _DECISIONS.update({k: bool(v) for k, v in decisions.items()})
+
+
+def get_dispatch() -> dict:
+    return dict(_DECISIONS)
+
+
+@contextlib.contextmanager
+def forced(mode: Optional[str]):
+    """Force every op onto one path regardless of env/autotune decisions
+    (``"pallas"`` / ``"xla"``). Used by the autotune microbenches and the
+    parity checks; traces taken inside the context bake the forced path
+    into the compiled program."""
+    global _FORCED
+    prev = _FORCED
+    _FORCED = mode
+    try:
+        yield
+    finally:
+        _FORCED = prev
+
+
+def env_override() -> Optional[bool]:
+    """The ``ZB_PALLAS`` manual override, or None when unset/unrecognized
+    (one parser shared with the autotune, so an unrecognized value can
+    never disable tuning while also failing to force a path)."""
     import os
 
-    if os.environ.get("ZB_PALLAS", "").strip() in ("0", "false", "off"):
+    env = os.environ.get("ZB_PALLAS", "").strip().lower()
+    if env in ("0", "false", "off", "no"):
         return False
-    return jax.default_backend() == "tpu"
+    if env in ("1", "true", "on", "yes"):
+        return True
+    return None
+
+
+def use_pallas(family: str = "row_update") -> bool:
+    """Pallas path for this op family? Priority: forced() context >
+    ZB_PALLAS env override > autotuned per-family decision > default
+    (pallas on TPU). Always False off-TPU (Mosaic is TPU-only)."""
+    if jax.default_backend() != "tpu":
+        return False
+    if _FORCED == "pallas":
+        return True
+    if _FORCED == "xla":
+        return False
+    env = env_override()
+    if env is not None:
+        return env
+    return _DECISIONS.get(family, True)
+
+
+def _use_pallas(family: str = "row_update") -> bool:
+    return use_pallas(family)
 
 
 def _chunk(b: int) -> int:
@@ -129,7 +197,7 @@ def masked_row_update(
 
     Equivalent to the XLA ``table.at[where(active, slots, T)].set(vals,
     mode="drop")`` chain (last writer in batch order wins)."""
-    if not _use_pallas():
+    if not _use_pallas("row_update"):
         idx = jnp.where(active, slots, table.shape[0])
         if lane_mask is None:
             return table.at[idx].set(vals, mode="drop")
@@ -225,7 +293,7 @@ def masked_row_max(
     """Serial ``table[slot[i]] = maximum(old, vals[i])`` for active records
     (the ``.at[slots].max(vals, mode="drop")`` analogue; max commutes, so
     batch order does not matter)."""
-    if not _use_pallas():
+    if not _use_pallas("row_max"):
         idx = jnp.where(active, slots, table.shape[0])
         return table.at[idx].max(vals.astype(table.dtype), mode="drop")
 
@@ -266,6 +334,69 @@ def masked_row_max(
     )
 
 
+def masked_row_add(
+    table: jax.Array,  # [T, K] i32
+    slots: jax.Array,  # [B] i32
+    active: jax.Array,  # [B] bool
+    vals: jax.Array,  # [B, K] i32
+    lane_mask: Optional[jax.Array] = None,  # [B, K] bool; None = full row
+) -> jax.Array:
+    """Serial ``table[slot[i], lane] += vals[i, lane]`` for active records
+    and masked lanes (integer addition commutes, so batch order does not
+    matter; duplicates accumulate like ``.at[].add(..., mode="drop")``)."""
+    if not _use_pallas("row_add"):
+        idx = jnp.where(active, slots, table.shape[0])
+        add = vals if lane_mask is None else jnp.where(lane_mask, vals, 0)
+        return table.at[idx].add(add.astype(table.dtype), mode="drop")
+
+    b = slots.shape[0]
+    t, k = table.shape
+    c = _chunk(b)
+    blind = lane_mask is None
+    if blind:
+        lane_mask = jnp.ones((1, 1), jnp.int32)  # placeholder operand
+
+    def kernel(slots_ref, active_ref, vals_ref, mask_ref, tbl_ref, out_ref):
+        _init_out(out_ref, tbl_ref)
+
+        def body(i, _):
+            @functools.partial(_when, active_ref[i] != 0)
+            def _():
+                s = slots_ref[i]
+                row = out_ref[s, :]
+                if blind:
+                    out_ref[s, :] = row + vals_ref[i, :]
+                else:
+                    out_ref[s, :] = jnp.where(
+                        mask_ref[i, :] != 0, row + vals_ref[i, :], row
+                    )
+            return jnp.int32(0)
+
+        lax.fori_loop(jnp.int32(0), jnp.int32(c), body, jnp.int32(0))
+
+    mask_spec = _vmem_full_spec((1, 1)) if blind else _vmem_rows_spec(c, k)
+    return _pallas_call(
+        kernel,
+        grid=(b // c,),
+        in_specs=[
+            _smem_spec(c),
+            _smem_spec(c),
+            _vmem_rows_spec(c, k),
+            mask_spec,
+            _vmem_full_spec((t, k)),
+        ],
+        out_specs=_vmem_full_spec((t, k)),
+        out_shape=jax.ShapeDtypeStruct((t, k), table.dtype),
+        aliases={4: 0},
+    )(
+        slots.astype(jnp.int32),
+        active.astype(jnp.int32),
+        vals.astype(table.dtype),
+        (lane_mask if blind else lane_mask.astype(jnp.int32)),
+        table,
+    )
+
+
 # ---------------------------------------------------------------------------
 # 1D-table lane updates (table viewed as [T/128, 128])
 # ---------------------------------------------------------------------------
@@ -300,7 +431,7 @@ def _lane_kernel(accumulate: bool):
 def _lane_op(table1d, slots, active, vals, accumulate):
     t = table1d.shape[0]
     b = slots.shape[0]
-    if not _use_pallas() or t % LANES:
+    if not _use_pallas("lane") or t % LANES:
         idx = jnp.where(active, slots, t)
         if accumulate:
             return table1d.at[idx].add(vals.astype(table1d.dtype), mode="drop")
@@ -365,12 +496,201 @@ def vec64_to_planes(x: jax.Array) -> jax.Array:
 
 def masked_vec64_update(table1d, slots, active, vals64):
     """1D i64 table scatter: ``table[slot[i]] = vals64[i]`` via planes."""
-    if not _use_pallas():
+    if not _use_pallas("vec64"):
         idx = jnp.where(active, slots, table1d.shape[0])
         return table1d.at[idx].set(vals64.astype(table1d.dtype), mode="drop")
     planes = i64_to_planes(table1d[:, None])
-    out = masked_row_update(planes, slots, active, vec64_to_planes(vals64))
+    # force the inner row update onto the pallas path: this call must be
+    # exactly what the autotune's "vec64" pallas arm measured — letting it
+    # re-consult the independent "row_update" decision could install a
+    # planes-conversion + XLA-scatter hybrid neither A/B arm ever timed
+    with forced("pallas"):
+        out = masked_row_update(planes, slots, active, vec64_to_planes(vals64))
     return planes_to_i64(out)[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# fused phase-E mega-pass
+# ---------------------------------------------------------------------------
+#
+# The step kernel's phase-E tail is a dependent chain of ~20 masked table
+# writes (element-instance rows, job rows, timer bookkeeping, free-slot
+# rings, direct-mapped indexes). Profiled on-chip, EVERY one of those ops
+# costs ~20ns/record in per-index DMA issue — the chain, not the math, is
+# the round's floor (PERF_NOTES round-4 cost model). ``fused_table_commit``
+# collapses the whole tail into ONE pallas launch: the tables live in VMEM
+# for the duration, each op is a serial register-resident RMW loop, and the
+# per-record cost of the entire tail is a handful of VPU instructions.
+#
+# Ordering contract: ops apply in list order per batch chunk (chunk-major,
+# op-minor). This equals the XLA chain's global op-major order whenever
+# cross-record conflicts between DIFFERENT ops are confined to commutative
+# kinds ("add"/"max") — which the step kernel guarantees: its guards make
+# record kinds disjoint per row, so two records never hit the same (row,
+# lane) through different non-commutative ops in one round. Within one op,
+# serial batch order = the XLA chain's last-writer-wins rank order.
+
+
+@dataclasses.dataclass
+class TableOp:
+    """One masked table write inside a fused commit.
+
+    ``table`` indexes into the commit's table list. 2D [T, K] tables take
+    ``vals`` [B, K] (+ optional ``mask`` [B, K]); 1D [T] tables (free
+    rings, direct-mapped indexes) take scalar ``vals`` [B] and no mask.
+    ``kind``: "set" (masked row write, serial last-writer-wins), "add"
+    (commutative accumulate), "max" (commutative monotonic merge).
+    """
+
+    table: int
+    kind: str
+    slots: jax.Array
+    active: jax.Array
+    vals: jax.Array
+    mask: Optional[jax.Array] = None
+
+
+def _apply_op_unfused(tbl: jax.Array, op: TableOp) -> jax.Array:
+    """One TableOp through the standalone per-family ops (exact XLA-chain
+    semantics off-TPU; per-family autotuned dispatch on-TPU)."""
+    if tbl.ndim == 1:
+        if op.kind == "add":
+            return masked_lane_accum(tbl, op.slots, op.active, op.vals)
+        return masked_lane_update(tbl, op.slots, op.active, op.vals)
+    if op.kind == "max":
+        return masked_row_max(tbl, op.slots, op.active, op.vals)
+    if op.kind == "add":
+        return masked_row_add(tbl, op.slots, op.active, op.vals, op.mask)
+    return masked_row_update(tbl, op.slots, op.active, op.vals, op.mask)
+
+
+def fused_table_commit(
+    tables: Sequence[jax.Array], ops: Sequence[TableOp], vmem_mb: int = 128
+) -> List[jax.Array]:
+    """Apply ``ops`` to ``tables`` (all i32; i64 state enters as planes) as
+    ONE pallas serial pass — or, when the fused family lost the autotune
+    A/B (or off-TPU), as the equivalent unfused op chain. Returns the new
+    tables in input order.
+    """
+    ops = list(ops)
+    if not ops:
+        return list(tables)
+    b = ops[0].slots.shape[0]
+    fusable = (
+        use_pallas("fused")
+        and all(t.ndim == 1 or t.ndim == 2 for t in tables)
+        and all(t.shape[0] % LANES == 0 for t in tables if t.ndim == 1)
+        and all(op.slots.shape[0] == b for op in ops)
+    )
+    if not fusable:
+        out = list(tables)
+        for op in ops:
+            out[op.table] = _apply_op_unfused(out[op.table], op)
+        return out
+
+    c = _chunk(b)
+    ntab = len(tables)
+    folded = [
+        t.reshape(t.shape[0] // LANES, LANES) if t.ndim == 1 else t
+        for t in tables
+    ]
+    is1d = [t.ndim == 1 for t in tables]
+
+    # static operand layout: per op (slots, active, vals[, mask]) then the
+    # tables; refs arrive in the same flat order, outputs one per table
+    operands: List[jax.Array] = []
+    in_specs = []
+    meta = []  # (kind, table, one_d, masked, base ref index)
+    for op in ops:
+        one_d = is1d[op.table]
+        base = len(operands)
+        operands.append(op.slots.astype(jnp.int32))
+        in_specs.append(_smem_spec(c))
+        operands.append(op.active.astype(jnp.int32))
+        in_specs.append(_smem_spec(c))
+        if one_d:
+            operands.append(op.vals.astype(tables[op.table].dtype))
+            in_specs.append(_smem_spec(c))
+        else:
+            k = tables[op.table].shape[1]
+            operands.append(op.vals.astype(tables[op.table].dtype))
+            in_specs.append(_vmem_rows_spec(c, k))
+        masked = (not one_d) and op.mask is not None
+        if masked:
+            operands.append(op.mask.astype(jnp.int32))
+            in_specs.append(_vmem_rows_spec(c, k))
+        meta.append((op.kind, op.table, one_d, masked, base))
+    n_operands = len(operands)
+    for f in folded:
+        in_specs.append(_vmem_full_spec(f.shape))
+
+    def kernel(*refs):
+        in_tab = refs[n_operands : n_operands + ntab]
+        out_tab = refs[n_operands + ntab :]
+        for j in range(ntab):
+            _init_out(out_tab[j], in_tab[j])
+        lane_iota = lax.broadcasted_iota(jnp.int32, (LANES,), 0)
+
+        for kind, tab, one_d, masked, base in meta:
+            s_ref = refs[base]
+            a_ref = refs[base + 1]
+            v_ref = refs[base + 2]
+            m_ref = refs[base + 3] if masked else None
+            o_ref = out_tab[tab]
+
+            def body(i, _, s_ref=s_ref, a_ref=a_ref, v_ref=v_ref,
+                     m_ref=m_ref, o_ref=o_ref, kind=kind, one_d=one_d,
+                     masked=masked):
+                @functools.partial(_when, a_ref[i] != 0)
+                def _():
+                    s = s_ref[i]
+                    if one_d:
+                        r = s >> 7
+                        hit = lane_iota == (s & (LANES - 1))
+                        row = o_ref[r, :]
+                        v = v_ref[i]
+                        if kind == "add":
+                            o_ref[r, :] = jnp.where(hit, row + v, row)
+                        else:
+                            o_ref[r, :] = jnp.where(hit, v, row)
+                    else:
+                        row = o_ref[s, :]
+                        v = v_ref[i, :]
+                        if kind == "max":
+                            o_ref[s, :] = jnp.maximum(row, v)
+                        elif kind == "add":
+                            if masked:
+                                o_ref[s, :] = jnp.where(
+                                    m_ref[i, :] != 0, row + v, row
+                                )
+                            else:
+                                o_ref[s, :] = row + v
+                        else:
+                            if masked:
+                                o_ref[s, :] = jnp.where(
+                                    m_ref[i, :] != 0, v, row
+                                )
+                            else:
+                                o_ref[s, :] = v
+                return jnp.int32(0)
+
+            lax.fori_loop(jnp.int32(0), jnp.int32(c), body, jnp.int32(0))
+
+    out = _pallas_call(
+        kernel,
+        grid=(b // c,),
+        in_specs=in_specs,
+        out_specs=tuple(_vmem_full_spec(f.shape) for f in folded),
+        out_shape=tuple(
+            jax.ShapeDtypeStruct(f.shape, f.dtype) for f in folded
+        ),
+        aliases={n_operands + j: j for j in range(ntab)},
+        vmem_mb=vmem_mb,
+    )(*operands, *folded)
+    return [
+        o.reshape(tables[j].shape) if is1d[j] else o
+        for j, o in enumerate(out)
+    ]
 
 
 # ---------------------------------------------------------------------------
@@ -416,7 +736,7 @@ def lookup(table: HashTable, keys: jax.Array, valid: jax.Array):
     """Batched probe; identical results to hashmap.lookup."""
     t = table.keys.shape[0]
     b = keys.shape[0]
-    if not _use_pallas() or t % LANES:
+    if not _use_pallas("lookup") or t % LANES:
         return hashmap.lookup(table, keys, valid)
     c = _chunk(b)
     lo, hi = _split_keys(keys)
@@ -488,7 +808,7 @@ def insert(table: HashTable, keys: jax.Array, vals: jax.Array, valid: jax.Array)
     layout may differ on collisions — see module docstring)."""
     t = table.keys.shape[0]
     b = keys.shape[0]
-    if not _use_pallas() or t % LANES:
+    if not _use_pallas("insert") or t % LANES:
         return hashmap.insert(table, keys, vals, valid)
     c = _chunk(b)
     lo, hi = _split_keys(keys)
@@ -574,7 +894,7 @@ def delete(table: HashTable, keys: jax.Array, valid: jax.Array) -> HashTable:
     """Batched delete (tombstones); identical to hashmap.delete."""
     t = table.keys.shape[0]
     b = keys.shape[0]
-    if not _use_pallas() or t % LANES:
+    if not _use_pallas("delete") or t % LANES:
         return hashmap.delete(table, keys, valid)
     c = _chunk(b)
     lo, hi = _split_keys(keys)

@@ -27,6 +27,19 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+# jax promoted shard_map out of experimental at different versions; this
+# build only ships the experimental name (and spells the replication-check
+# kwarg ``check_rep`` instead of ``check_vma``). Resolve once here so the
+# two shard_map call sites below work on either build.
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is None:
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map(f, **kw):
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        return _exp_shard_map(f, **kw)
+
 from zeebe_tpu.engine import keyspace
 from zeebe_tpu.protocol.enums import RecordType, ValueType
 from zeebe_tpu.tpu import batch as rb
@@ -163,7 +176,7 @@ def build_sharded_step(mesh: Mesh, exchange_slots: int = 128):
         return jax.tree.map(lambda _: spec, tree)
 
     def sharded_step(graph, state, batch, sends, now):
-        fn = jax.shard_map(
+        fn = _shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(
@@ -345,7 +358,7 @@ def build_sharded_drive(
         return jax.tree.map(lambda _: spec, tree)
 
     def drive(graph, state, queue, now):
-        fn = jax.shard_map(
+        fn = _shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(

@@ -17,6 +17,12 @@ each state family is a fixed-capacity SoA table plus an HBM hash index
 
 Capacities are static (jit shapes); the host engine grows tables by
 re-padding when occupancy crosses a threshold.
+
+Write-path note: the step kernel commits each table GROUP (ei_i32 +
+ei_i64-as-planes + ei_pay + free ring + index; likewise jobs and timers)
+through ONE fused pallas mega-pass (``pallas_ops.fused_table_commit``) on
+builds where the boot autotune picked fusion — the packed same-dtype
+layout below is what makes those groups commit as whole-row writes.
 """
 
 from __future__ import annotations
@@ -202,7 +208,10 @@ class EngineState:
     sub_credits: jax.Array     # i32
     sub_timeout: jax.Array     # i64
     sub_valid: jax.Array       # bool
-    sub_rr: jax.Array          # i32 round-robin cursor (global, like the oracle)
+    # i32 round-robin cursor (global, like the oracle's _job_rr_cursor);
+    # persisted by engine.device_backlog_activations across calls and
+    # across snapshot/restore so drain fairness survives ticks and leaders
+    sub_rr: jax.Array
 
     # key counters (i64 scalars)
     next_wf_key: jax.Array
