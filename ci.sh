@@ -3,8 +3,10 @@
 # checkstyle, githooks-plugin): refuses a dirty exit. Run before every
 # end-of-round snapshot — and from .githooks/pre-commit for the fast lint.
 #
-#   ./ci.sh          lint + full test suite + pallas parity check
+#   ./ci.sh          lint + tier-1 test suite + chaos smoke + pallas parity
 #   ./ci.sh fast     lint only (pre-commit speed)
+#   ./ci.sh slow     tier-2 only: volume pins, randomized chaos sweeps,
+#                    device-engine cluster suites (pytest -m slow)
 set -e
 cd "$(dirname "$0")"
 
@@ -19,8 +21,18 @@ if [ "$1" = "fast" ]; then
   exit 0
 fi
 
-echo "== full test suite =="
-python -m pytest tests/ -x -q
+if [ "$1" = "slow" ]; then
+  echo "== tier-2: volume pins, randomized chaos sweeps, device clusters =="
+  python -m pytest tests/ -q -m "slow"
+  echo "CI GATE (slow tier) GREEN"
+  exit 0
+fi
+
+echo "== chaos smoke (fixed-seed fault schedule; tier-1, <60s) =="
+python -m pytest tests/test_chaos.py -q -m "not slow"
+
+echo "== full test suite (tier-1; run './ci.sh slow' for the slow tier) =="
+python -m pytest tests/ -x -q -m "not slow" --ignore=tests/test_chaos.py
 
 echo "== pallas ops + mega-pass parity (skips without a TPU) =="
 python benchmarks/pallas_ops_check.py

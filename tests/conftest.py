@@ -78,6 +78,17 @@ jax.config.update("jax_enable_compilation_cache", False)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier gating: ci.sh and the tier-1 verify run `-m "not slow"`; the
+    # marker must be registered or pytest treats it as unknown (warning
+    # noise, and a typo'd mark silently drops a suite out of its tier)
+    config.addinivalue_line(
+        "markers",
+        "slow: tier-2 suites (volume pins, randomized sweeps, device-engine "
+        "clusters) excluded from tier-1; run with `pytest -m slow`",
+    )
+
+
 @pytest.fixture
 def tmp_log_dir(tmp_path):
     return str(tmp_path / "log")

@@ -1,0 +1,549 @@
+"""Chaos tests: seeded fault injection against the cluster's invariants.
+
+The four invariants (docs/CHAOS.md) that define the paper's semantics:
+
+1. **No acked append is ever lost** — a record whose position reached the
+   commit position survives partitions, leader crashes and torn disk
+   writes, identically on every live replica.
+2. **At most one raft leader per term.**
+3. **Replay parity** — replaying the surviving committed log through the
+   host oracle engine is deterministic (bit-identical across independent
+   replays) and reconstructs the live leader's state.
+4. **Snapshot-restore convergence** — a crash at any point inside the
+   snapshot commit's two-rename swap leaves a salvageable snapshot, and
+   restore + replay converges to the same state.
+
+Fixed-seed runs (tier-1, wired into ci.sh) replay the identical fault
+schedule every time; the randomized sweep across seeds is ``slow``.
+"""
+
+import os
+
+import pytest
+
+from zeebe_tpu.log import LogStream, SegmentedLogStorage
+from zeebe_tpu.log.snapshot import SnapshotMetadata, SnapshotStorage
+from zeebe_tpu.runtime.actors import ActorScheduler
+from zeebe_tpu.runtime.metrics import event_count
+from zeebe_tpu.testing.chaos import (
+    ChaosHarness,
+    DiskFaults,
+    FaultPlane,
+    oracle_state_bytes,
+    replay_oracle,
+)
+
+from tests.test_raft import FAST, Cluster, append_with_retry, job_record, wait_until
+
+SEED = 0xC0FFEE
+
+
+@pytest.fixture
+def scheduler():
+    s = ActorScheduler(cpu_threads=2, io_threads=2).start()
+    yield s
+    s.stop()
+
+
+# ---------------------------------------------------------------------------
+# fault schedule determinism
+# ---------------------------------------------------------------------------
+
+
+class TestFaultScheduleDeterminism:
+    @staticmethod
+    def _drive(plane):
+        plane.set_rule(drop=0.3, duplicate=0.2, delay_ms=5, delay_jitter_ms=10)
+        for i in range(300):
+            plane.decide(f"n{i % 3}", f"n{(i + 1) % 3}", b"x" * (i % 17))
+        return list(plane.trace)
+
+    def test_same_seed_replays_identical_schedule(self):
+        """Acceptance: the same seed replays the identical fault schedule
+        twice (decision sequence AND verbs, per edge)."""
+        assert self._drive(FaultPlane(seed=SEED)) == self._drive(FaultPlane(seed=SEED))
+
+    def test_different_seed_changes_the_schedule(self):
+        assert self._drive(FaultPlane(seed=SEED)) != self._drive(FaultPlane(seed=SEED + 1))
+
+    def test_partition_blocks_both_directions_and_heals(self):
+        plane = FaultPlane(seed=1)
+        plane.partition("a", "b")
+        assert plane.decide("a", "b", b"x") == []
+        assert plane.decide("b", "a", b"x") == []
+        assert plane.decide("a", "c", b"x") is None
+        plane.heal("a", "b")
+        assert plane.decide("a", "b", b"x") is None
+
+    def test_asymmetric_partition(self):
+        plane = FaultPlane(seed=1)
+        plane.partition("a", "b", symmetric=False)
+        assert plane.decide("a", "b", b"x") == []
+        assert plane.decide("b", "a", b"x") is None
+
+    def test_isolate_blocks_unknown_destinations_too(self):
+        plane = FaultPlane(seed=1)
+        plane.isolate("a")
+        assert plane.decide("a", None, b"x") == []  # server-side responses
+        assert plane.decide("a", "b", b"x") == []
+        assert plane.decide("c", "a", b"x") == []
+        assert plane.decide("c", "b", b"x") is None
+        plane.heal("a")
+        assert plane.decide("a", "b", b"x") is None
+
+
+# ---------------------------------------------------------------------------
+# disk fault injection: snapshot commit crash points + fsync failure
+# ---------------------------------------------------------------------------
+
+
+class TestDiskFaults:
+    def test_crash_after_aside_restores_the_committed_snapshot(self, tmp_path):
+        """Crash between _swap_in's two renames: the final dir is gone and
+        only the set-aside holds the committed snapshot — open() must
+        restore it (and delete the torn .tmp), not skip it."""
+        root = str(tmp_path)
+        storage = SnapshotStorage(root)
+        meta = SnapshotMetadata(10, 12, 1)
+        storage.write(meta, b"v1-committed")
+        s0 = event_count("snapshot_salvage_events")
+        DiskFaults.crash_snapshot_commit(
+            storage, meta, b"v2-torn", DiskFaults.CRASH_OLD_ASIDE
+        )
+        assert not os.path.exists(os.path.join(root, meta.dirname))
+
+        reopened = SnapshotStorage(root)
+        assert reopened.read(meta) == b"v1-committed"
+        assert event_count("snapshot_salvage_events") - s0 >= 2
+        leftovers = [
+            n for n in os.listdir(root)
+            if n.endswith(".tmp") or n.endswith(".aside") or n.endswith(".old")
+        ]
+        assert leftovers == []
+
+    def test_crash_after_swap_keeps_replacement_and_deletes_orphan(self, tmp_path):
+        root = str(tmp_path)
+        storage = SnapshotStorage(root)
+        meta = SnapshotMetadata(10, 12, 1)
+        storage.write(meta, b"v1")
+        DiskFaults.crash_snapshot_commit(
+            storage, meta, b"v2-replacement", DiskFaults.CRASH_SWAPPED
+        )
+        # replacement landed; the set-aside old dir is the orphan
+        assert os.path.exists(os.path.join(root, meta.dirname + ".aside"))
+        reopened = SnapshotStorage(root)
+        assert reopened.read(meta) == b"v2-replacement"
+        assert not os.path.exists(os.path.join(root, meta.dirname + ".aside"))
+
+    def test_crash_with_only_tmp_written_sweeps_it(self, tmp_path):
+        root = str(tmp_path)
+        storage = SnapshotStorage(root)
+        meta = SnapshotMetadata(5, 6, 0)
+        DiskFaults.crash_snapshot_commit(
+            storage, meta, b"torn", DiskFaults.CRASH_TMP_WRITTEN
+        )
+        reopened = SnapshotStorage(root)
+        assert reopened.list() == []
+        assert not os.path.exists(os.path.join(root, meta.dirname + ".tmp"))
+
+    def test_legacy_old_suffix_still_salvaged(self, tmp_path):
+        """Set-aside dirs written by the pre-chaos '.old' spelling are
+        swept identically."""
+        root = str(tmp_path)
+        storage = SnapshotStorage(root)
+        meta = SnapshotMetadata(3, 4, 0)
+        storage.write(meta, b"v1")
+        os.rename(
+            os.path.join(root, meta.dirname),
+            os.path.join(root, meta.dirname + ".old"),
+        )
+        reopened = SnapshotStorage(root)
+        assert reopened.read(meta) == b"v1"
+
+    def test_break_fsync_fails_then_recovers(self, tmp_path):
+        storage = SegmentedLogStorage(str(tmp_path / "log"))
+        storage.append(b"block")
+        DiskFaults.break_fsync(storage, times=2)
+        with pytest.raises(OSError):
+            storage.flush()
+        with pytest.raises(OSError):
+            storage.flush()
+        storage.flush()  # restored
+        storage.close()
+
+
+# ---------------------------------------------------------------------------
+# fixed-seed raft chaos: partition + leader crash + torn segment tail
+# ---------------------------------------------------------------------------
+
+
+class LeaderLedger:
+    """Records every LEADER transition as (node, term) for invariant 2."""
+
+    def __init__(self):
+        self.entries = []
+
+    def attach(self, raft):
+        from zeebe_tpu.cluster import RaftState
+
+        raft.on_state_change(
+            lambda state, term, nid=raft.node_id: self.entries.append((nid, term))
+            if state == RaftState.LEADER
+            else None
+        )
+
+    def assert_at_most_one_leader_per_term(self):
+        by_term = {}
+        for node, term in self.entries:
+            by_term.setdefault(term, set()).add(node)
+        offenders = {t: nodes for t, nodes in by_term.items() if len(nodes) > 1}
+        assert not offenders, f"multiple leaders in a term: {offenders}"
+
+
+class TestChaosRaftFixedSeed:
+    def _capture_acked(self, log, first: int, last: int, acked: dict) -> None:
+        for pos in range(first, last + 1):
+            record = log.record_at(pos)
+            assert record is not None
+            acked[pos] = (record.raft_term, getattr(record.value, "type", None))
+
+    def test_partition_leader_crash_torn_tail(self, scheduler, tmp_path):
+        """The acceptance scenario: background message chaos, a partial
+        partition, a full partition forcing failover, a leader crash with
+        a torn segment tail, restart, heal — then invariants 1 + 2."""
+        plane = FaultPlane(seed=SEED)
+        # background noise on every edge: seeded drops + reordering jitter
+        plane.set_rule(drop=0.05, delay_ms=0, delay_jitter_ms=5)
+        cluster = Cluster(scheduler, tmp_path, 3)
+        ledger = LeaderLedger()
+        try:
+            for nid, raft in cluster.nodes.items():
+                plane.register_endpoint(nid, raft.address)
+                plane.install_client(raft.client, nid)
+                ledger.attach(raft)
+            leader = cluster.await_leader()
+            lid = leader.node_id
+            acked = {}
+
+            # warm-up: the leader's initial no-op reaches every log before
+            # chaos accounting starts (replication sessions established)
+            assert wait_until(
+                lambda: all(l.commit_position >= 0 for l in cluster.logs.values()),
+                timeout=40,
+            ), {nid: l.commit_position for nid, l in cluster.logs.items()}
+
+            # phase 1: clean-ish appends (noise rule active) — all commit
+            leader, last = append_with_retry(
+                cluster, [job_record(i) for i in range(10)], timeout=30
+            )
+            assert wait_until(
+                lambda: all(l.commit_position >= last for l in cluster.logs.values()),
+                timeout=40,
+            ), {nid: l.commit_position for nid, l in cluster.logs.items()}
+            self._capture_acked(cluster.logs[leader.node_id], last - 9, last, acked)
+
+            # phase 2: partial partition (leader cut off from ONE follower);
+            # the remaining majority keeps committing
+            lid = leader.node_id
+            followers = [nid for nid in cluster.nodes if nid != lid]
+            plane.partition(lid, followers[0])
+            leader, last = append_with_retry(
+                cluster, [job_record(100 + i) for i in range(10)], timeout=30
+            )
+            assert wait_until(
+                lambda: cluster.logs[leader.node_id].commit_position >= last,
+                timeout=40,
+            )
+            self._capture_acked(cluster.logs[leader.node_id], last - 9, last, acked)
+
+            # phase 3: full partition of the leader, then crash it with a
+            # torn tail; the connected majority elects a successor
+            plane.heal()
+            plane.isolate(lid)
+            assert wait_until(
+                lambda: any(
+                    cluster.nodes[f].state.value == "leader" for f in followers
+                ),
+                timeout=40,
+            ), {nid: n.state for nid, n in cluster.nodes.items()}
+            crashed_log = cluster.logs[lid]
+            crashed_dir = crashed_log.storage.directory
+            cluster.nodes[lid].close()
+            del cluster.nodes[lid]
+            plane.heal(lid)
+
+            torn0 = event_count("log_torn_tail_truncations")
+            DiskFaults.tear_log_tail(crashed_dir, nbytes=11)
+
+            # the successor keeps acking appends meanwhile
+            leader, last = append_with_retry(
+                cluster, [job_record(200 + i) for i in range(10)], timeout=30
+            )
+            assert wait_until(
+                lambda: cluster.logs[leader.node_id].commit_position >= last,
+                timeout=40,
+            )
+            self._capture_acked(cluster.logs[leader.node_id], last - 9, last, acked)
+
+            # phase 4: restart the crashed node from its torn disk state —
+            # recovery must truncate to the last whole record and rejoin
+            from zeebe_tpu.cluster import Raft
+
+            storage = SegmentedLogStorage(crashed_dir)
+            log = LogStream(storage, partition_id=0, recover_commit=False)
+            assert event_count("log_torn_tail_truncations") > torn0
+            raft = Raft(
+                lid,
+                log,
+                scheduler,
+                config=FAST,
+                storage_path=os.path.join(str(tmp_path), f"raft-{lid}.meta"),
+            )
+            cluster.nodes[lid] = raft
+            cluster.logs[lid] = log
+            ledger.attach(raft)
+            plane.register_endpoint(lid, raft.address)
+            plane.install_client(raft.client, lid)
+            members = {nid: n.address for nid, n in cluster.nodes.items()}
+            for node in cluster.nodes.values():
+                node.bootstrap(members)
+
+            leader, last = append_with_retry(
+                cluster, [job_record(300 + i) for i in range(5)], timeout=30
+            )
+            assert wait_until(
+                lambda: all(l.commit_position >= last for l in cluster.logs.values()),
+                timeout=60,
+            ), {nid: l.commit_position for nid, l in cluster.logs.items()}
+            self._capture_acked(cluster.logs[leader.node_id], last - 4, last, acked)
+
+            # invariant 1: every acked record survives identically everywhere
+            for nid, log_ in cluster.logs.items():
+                for pos, (term, jtype) in acked.items():
+                    record = log_.record_at(pos)
+                    assert record is not None, (nid, pos)
+                    assert record.raft_term == term, (nid, pos)
+                    assert getattr(record.value, "type", None) == jtype, (nid, pos)
+
+            # invariant 2: at most one leader per term
+            ledger.assert_at_most_one_leader_per_term()
+
+            # the plane actually injected faults on this schedule
+            verbs = {entry[3] for entry in plane.trace}
+            assert "drop" in verbs or "drop-partition" in verbs
+        finally:
+            cluster.close()
+
+
+# ---------------------------------------------------------------------------
+# broker-level chaos: snapshot mid-commit crash + oracle replay parity
+# ---------------------------------------------------------------------------
+
+
+def order_process():
+    from zeebe_tpu.models.bpmn.builder import Bpmn
+
+    return (
+        Bpmn.create_process("order-process")
+        .start_event("start")
+        .service_task("collect-money", type="payment-service")
+        .end_event("end")
+        .done()
+    )
+
+
+def _drained(server) -> bool:
+    return server.next_read_position - 1 == server.log.commit_position
+
+
+def _assert_oracle_parity(leader_broker):
+    """Invariant 3: replay of the surviving committed log is deterministic
+    bit-for-bit, and reconstructs the live leader's engine state."""
+    import time as _time
+
+    server = leader_broker.partitions[0]
+    # settle: the log must be drained AND quiescent — a worker's last
+    # in-flight async completion may commit AFTER the drain check, so
+    # require the commit position to hold still across a settle window
+    # before trusting the captured record set
+    committed = []
+    deadline = _time.monotonic() + 20
+    while _time.monotonic() < deadline:
+        before = server.log.commit_position
+        _time.sleep(0.6)
+        if server.log.commit_position != before or not _drained(server):
+            continue
+        committed = server.log.reader(0).read_committed()
+        if committed and (
+            committed[-1].position == server.engine.last_processed_position
+        ):
+            break
+        committed = []
+    assert committed, (server.next_read_position, server.log.commit_position)
+    oracle_a = replay_oracle(committed)
+    oracle_b = replay_oracle(committed)
+    assert oracle_state_bytes(oracle_a) == oracle_state_bytes(oracle_b)
+    live = server.engine
+    assert set(oracle_a.jobs) == set(live.jobs)
+    for key, job in live.jobs.items():
+        assert oracle_a.jobs[key].state == job.state, key
+    assert sorted(oracle_a.element_instances.instances) == sorted(
+        live.element_instances.instances
+    )
+    assert oracle_a.last_processed_position == live.last_processed_position
+
+
+class TestChaosBrokerFixedSeed:
+    def test_mid_commit_snapshot_crash_converges(self, tmp_path):
+        """Invariant 4: a crash between the snapshot swap's two renames is
+        salvaged on restart, and restore + replay converges (the next
+        instance completes end-to-end on the recovered state)."""
+        from zeebe_tpu.log import stateser
+
+        harness = ChaosHarness(str(tmp_path), n_brokers=1)
+        client = None
+        try:
+            harness.await_leaders()
+            client = harness.client()
+            client.deploy_model(order_process())
+            done = []
+            worker = client.open_job_worker(
+                "payment-service",
+                lambda pid, rec: done.append(rec.key) or {"paid": True},
+            )
+            client.create_instance("order-process")
+            assert wait_until(lambda: len(done) >= 1, timeout=30)
+            worker.close()
+
+            broker = harness.brokers["b0"]
+            broker.snapshot_all()
+            server = broker.partitions[0]
+            metas = server.snapshots.storage.list()
+            assert metas, "snapshot_all produced no snapshot"
+            meta = metas[0]
+
+            # crash while REWRITING the same snapshot: old final set aside,
+            # replacement never renamed in
+            s0 = event_count("snapshot_salvage_events")
+            DiskFaults.crash_snapshot_commit(
+                server.snapshots.storage,
+                meta,
+                stateser.encode_state({"torn": True}),
+                DiskFaults.CRASH_OLD_ASIDE,
+            )
+            client.close()
+            client = None
+            harness.crash("b0")
+            harness.restart("b0")
+            assert event_count("snapshot_salvage_events") - s0 >= 2
+            harness.await_leaders()
+
+            # recovered broker: the salvaged snapshot + replay serve traffic
+            client = harness.client()
+            done2 = []
+            worker = client.open_job_worker(
+                "payment-service",
+                lambda pid, rec: done2.append(rec.key) or {"paid": True},
+            )
+            client.create_instance("order-process")
+            assert wait_until(lambda: len(done2) >= 1, timeout=30)
+            worker.close()
+            _assert_oracle_parity(harness.leader_of(0))
+        finally:
+            if client is not None:
+                client.close()
+            harness.close()
+
+    def test_replay_parity_after_leader_crash(self, tmp_path):
+        """Invariant 3 under failover: crash the partition leader mid-
+        traffic (with seeded network jitter), restart it, finish the work,
+        then prove the surviving committed log replays to the live state."""
+        plane = FaultPlane(seed=SEED)
+        plane.set_rule(delay_ms=0, delay_jitter_ms=3)  # reorder-y jitter
+        harness = ChaosHarness(str(tmp_path), n_brokers=3, plane=plane)
+        client = None
+        try:
+            harness.await_leaders()
+            client = harness.client()
+            client.deploy_model(order_process())
+            done = []
+            worker = client.open_job_worker(
+                "payment-service",
+                lambda pid, rec: done.append(rec.key) or {"paid": True},
+            )
+            client.create_instance("order-process")
+            client.create_instance("order-process")
+            assert wait_until(lambda: len(done) >= 2, timeout=30), done
+
+            old = harness.leader_of(0)
+            old_id = old.node_id
+            harness.crash(old_id)
+            assert wait_until(
+                lambda: harness.leader_of(0) is not None, timeout=30
+            ), "no successor elected"
+            new_leader = harness.leader_of(0)
+            assert wait_until(
+                lambda: new_leader.repository.latest("order-process") is not None,
+                timeout=20,
+            )
+            harness.restart(old_id)
+
+            client.create_instance("order-process")
+            assert wait_until(lambda: len(done) >= 3, timeout=30), done
+            worker.close()
+            _assert_oracle_parity(harness.leader_of(0))
+        finally:
+            if client is not None:
+                client.close()
+            harness.close()
+
+
+# ---------------------------------------------------------------------------
+# randomized sweep (slow): many seeds, probabilistic faults
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestChaosRandomizedSweep:
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_invariants_hold_under_random_faults(self, scheduler, tmp_path, seed):
+        plane = FaultPlane(seed=seed)
+        plane.set_rule(drop=0.1, duplicate=0.05, delay_ms=1, delay_jitter_ms=8)
+        cluster = Cluster(scheduler, tmp_path, 3)
+        ledger = LeaderLedger()
+        try:
+            for nid, raft in cluster.nodes.items():
+                plane.register_endpoint(nid, raft.address)
+                plane.install_client(raft.client, nid)
+                ledger.attach(raft)
+            cluster.await_leader()
+            acked = {}
+            for batch in range(6):
+                leader, last = append_with_retry(
+                    cluster, [job_record(batch * 10 + i) for i in range(5)],
+                    timeout=30,
+                )
+                assert wait_until(
+                    lambda: cluster.logs[leader.node_id].commit_position >= last,
+                    timeout=30,
+                )
+                log = cluster.logs[leader.node_id]
+                for pos in range(last - 4, last + 1):
+                    record = log.record_at(pos)
+                    acked[pos] = (record.raft_term, getattr(record.value, "type", None))
+            plane.clear_rules()
+            leader, last = append_with_retry(cluster, [job_record(999)], timeout=30)
+            assert wait_until(
+                lambda: all(l.commit_position >= last for l in cluster.logs.values()),
+                timeout=30,
+            )
+            for nid, log_ in cluster.logs.items():
+                for pos, (term, jtype) in acked.items():
+                    record = log_.record_at(pos)
+                    assert record is not None, (nid, pos)
+                    assert (record.raft_term, getattr(record.value, "type", None)) == (
+                        term, jtype,
+                    ), (nid, pos)
+            ledger.assert_at_most_one_leader_per_term()
+        finally:
+            cluster.close()

@@ -548,11 +548,18 @@ class TestMultiPartition:
             cluster.close()
 
 
+@pytest.mark.slow
 class TestTpuClusterServing:
     """VERDICT round-2 bar: the TPU device engine is the cluster serving
     path — installed per partition on raft leadership
     (``PartitionInstallService.java:106-291`` analogue), with device
-    snapshots replicating to followers and restore+replay on failover."""
+    snapshots replicating to followers and restore+replay on failover.
+
+    Tier-2 (``pytest -m slow``): 3-broker clusters serving from the device
+    kernel pay multi-ten-second cold XLA compiles PER LEADERSHIP INSTALL;
+    on a shared-CPU container that exceeds the in-test client budgets and
+    the whole class runs 200s+ — too heavy (and too machine-sensitive) for
+    the tier-1 wall budget."""
 
     def test_device_partitions_serve_and_failover(self, tmp_path):
         cluster = ClusterUnderTest(tmp_path, n_brokers=3, partitions=1, engine="tpu")
@@ -779,8 +786,10 @@ class TestTpuClusterServing:
             cluster.close()
 
 
+@pytest.mark.slow
 class TestTpuClusterDeadlines:
-    """Round-4 regression (deadline sweeps dead on clustered TPU
+    """Tier-2 with TestTpuClusterServing (same device-engine cluster
+    bring-up cost). Round-4 regression (deadline sweeps dead on clustered TPU
     partitions): the broker tick must fire job timeouts, timer events and
     host-oracle deadlines on a TPU-backed partition — the async device
     probe (``tpu/engine.deadlines_due_probe``) gates the expensive device

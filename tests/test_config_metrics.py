@@ -117,6 +117,103 @@ class TestMetrics:
             assert "zb_up 1" in f.read()
 
 
+class TestGlobalEventCounters:
+    """Chaos-relevant counters from layers with no broker registry in reach
+    (transport, log storage, snapshot storage, raft) count into the
+    process-global registry and ride along every metrics surface."""
+
+    CHAOS_COUNTERS = (
+        "raft_elections_started",
+        "raft_elections_won",
+        "transport_reconnects",
+        "transport_pending_expired",
+        "log_torn_tail_truncations",
+        "snapshot_salvage_events",
+    )
+
+    def test_count_event_merges_into_any_registry_dump(self):
+        from zeebe_tpu.runtime import metrics as m
+
+        m.count_event("chaos_test_evt", "a test event")
+        out = m.render_with_global(MetricsRegistry(), now_ms=1)
+        assert "zb_chaos_test_evt" in out
+        # the global registry itself is not duplicated
+        dump = m.render_with_global(m.GLOBAL_REGISTRY, now_ms=1)
+        series = [
+            line for line in dump.splitlines()
+            if line.startswith("zb_chaos_test_evt ")
+        ]
+        assert len(series) == 1
+
+    def test_chaos_counters_exposed_through_metrics_endpoint(self):
+        import urllib.request
+
+        from zeebe_tpu.runtime import metrics as m
+        from zeebe_tpu.runtime.metrics import MetricsHttpServer
+
+        for name in self.CHAOS_COUNTERS:
+            m.count_event(name, delta=0.0)  # allocate without bumping
+        reg = MetricsRegistry()
+        reg.counter("up").inc()
+        server = MetricsHttpServer(reg, host="127.0.0.1", port=0)
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=5
+            ).read().decode()
+        finally:
+            server.close()
+        assert "zb_up 1" in body
+        for name in self.CHAOS_COUNTERS:
+            assert f"zb_{name}" in body, name
+
+    def test_raft_election_counters_count_real_elections(self, tmp_path):
+        import os
+
+        from zeebe_tpu.cluster import Raft, RaftState
+        from zeebe_tpu.log import LogStream, SegmentedLogStorage
+        from zeebe_tpu.runtime import metrics as m
+        from zeebe_tpu.runtime.actors import ActorScheduler
+
+        started0 = m.event_count("raft_elections_started")
+        won0 = m.event_count("raft_elections_won")
+        scheduler = ActorScheduler(cpu_threads=2, io_threads=2).start()
+        log = LogStream(
+            SegmentedLogStorage(str(tmp_path / "log")), recover_commit=False
+        )
+        raft = Raft(
+            "m0", log, scheduler,
+            storage_path=os.path.join(str(tmp_path), "raft.meta"),
+        )
+        try:
+            raft.bootstrap({"m0": raft.address})
+            import time as _t
+
+            deadline = _t.monotonic() + 10
+            while _t.monotonic() < deadline and raft.state != RaftState.LEADER:
+                _t.sleep(0.02)
+            assert raft.state == RaftState.LEADER
+            assert m.event_count("raft_elections_started") > started0
+            assert m.event_count("raft_elections_won") > won0
+        finally:
+            raft.close()
+            scheduler.stop()
+
+    def test_file_writer_includes_global_counters(self, tmp_path):
+        from zeebe_tpu.runtime import metrics as m
+
+        m.count_event("chaos_file_evt")
+        clock = ControlledClock()
+        scheduler = ControlledActorScheduler(clock=clock).start()
+        reg = MetricsRegistry()
+        path = str(tmp_path / "metrics" / "zeebe.prom")
+        MetricsFileWriter(reg, path, scheduler, flush_period_ms=5000)
+        scheduler.work_until_done()
+        clock.advance(5000)
+        scheduler.work_until_done()
+        with open(path) as f:
+            assert "zb_chaos_file_evt" in f.read()
+
+
 class TestWorkflowRepositoryQueries:
     """Reference WorkflowRepositoryService: list-workflows / get-workflow
     resource requests (gateway newWorkflowRequest / newResourceRequest)."""

@@ -400,6 +400,141 @@ class TestMembershipChange:
             cluster.close()
 
 
+class TestMembershipChurnUnderFaults:
+    """Membership change while the leader is partitioned away must either
+    complete (forwarded to the new leader, finishing after heal) or roll
+    back cleanly via ``_rollback_config`` when the deposed leader's
+    uncommitted config entry is truncated."""
+
+    def test_change_on_partitioned_leader_rolls_back_after_heal(
+        self, scheduler, tmp_path
+    ):
+        from zeebe_tpu.testing.chaos import FaultPlane
+
+        plane = FaultPlane(seed=7)
+        cluster = Cluster(scheduler, tmp_path, 3)
+        extra = None
+        try:
+            for nid, node in cluster.nodes.items():
+                plane.register_endpoint(nid, node.address)
+                plane.install_client(node.client, nid)
+            leader = cluster.await_leader()
+            lid = leader.node_id
+            original_members = set(leader.persistent.members)
+            assert wait_until(lambda: cluster.logs[lid].commit_position >= 0)
+            followers = [n for n in cluster.nodes if n != lid]
+
+            # cut the leader off completely, then have it accept an
+            # add_member it can never commit (applies on append)
+            plane.isolate(lid)
+            extra = cluster._make_node("n3")
+            del cluster.nodes["n3"]  # keep leader() blind to the bystander
+            leader.add_member("n3", extra.address).join(5)
+            assert wait_until(lambda: "n3" in leader.persistent.members)
+
+            # the connected majority elects a successor that never saw the
+            # config entry
+            assert wait_until(
+                lambda: any(
+                    cluster.nodes[f].state == RaftState.LEADER for f in followers
+                ),
+                timeout=15,
+            ), {nid: n.state for nid, n in cluster.nodes.items()}
+
+            # heal: the deposed leader's conflicting suffix is truncated and
+            # the configuration rolls back to the one in force before it
+            plane.heal(lid)
+            assert wait_until(
+                lambda: leader.state != RaftState.LEADER, timeout=15
+            )
+            assert wait_until(
+                lambda: set(leader.persistent.members) == original_members,
+                timeout=15,
+            ), leader.persistent.members
+            for f in followers:
+                assert set(cluster.nodes[f].persistent.members) == original_members
+        finally:
+            if extra is not None:
+                extra.close()
+            cluster.close()
+
+    def test_change_forwarded_during_partition_completes_after_failover(
+        self, scheduler, tmp_path
+    ):
+        from zeebe_tpu.testing.chaos import FaultPlane
+
+        plane = FaultPlane(seed=8)
+        cluster = Cluster(scheduler, tmp_path, 3)
+        try:
+            for nid, node in cluster.nodes.items():
+                plane.register_endpoint(nid, node.address)
+                plane.install_client(node.client, nid)
+            leader = cluster.await_leader()
+            lid = leader.node_id
+            followers = [n for n in cluster.nodes if n != lid]
+            assert wait_until(lambda: cluster.logs[lid].commit_position >= 0)
+
+            plane.isolate(lid)
+            # a follower takes the op while the old leader is unreachable:
+            # it forwards/retries across the leadership flap until the NEW
+            # leader accepts (reference RaftJoinService retry semantics)
+            new = cluster._make_node("n4")
+            members = {nid: n.address for nid, n in cluster.nodes.items()}
+            new.bootstrap(members)
+            position = cluster.nodes[followers[0]].add_member(
+                "n4", new.address
+            ).join(15)
+            assert position >= 0
+            new_leader = next(
+                cluster.nodes[f] for f in followers
+                if cluster.nodes[f].state == RaftState.LEADER
+            )
+            assert "n4" in new_leader.persistent.members
+
+            # after heal the deposed leader converges onto the new config
+            plane.heal(lid)
+            old = cluster.nodes[lid]
+            assert wait_until(
+                lambda: "n4" in old.persistent.members, timeout=15
+            ), old.persistent.members
+        finally:
+            cluster.close()
+
+
+class TestRpcBackoff:
+    def test_backoff_ramps_per_window_and_clears_on_inbound(self):
+        """One outage fails every in-flight request at once — the burst
+        must count as ONE failure (ramp 1x, 2x, ... per retry round, not
+        straight to the max), and inbound traffic from the peer (a healed
+        follower's poll) clears the backoff instead of sitting it out."""
+        import random
+        import types
+
+        r = Raft.__new__(Raft)
+        r.config = RaftConfig(rpc_backoff_base_ms=50, rpc_backoff_max_ms=2000)
+        r.rng = random.Random(0)
+        now = [0]
+        r.scheduler = types.SimpleNamespace(now_ms=lambda: now[0])
+        r._peer_backoff = {}
+
+        for _ in range(10):  # 10 in-flight failures from the same outage
+            r._note_peer_failure("p")
+        assert r._peer_backoff["p"][0] == 1  # counted once, not ten times
+        assert r._peer_backed_off("p")
+
+        # window expired + another failure: NOW it escalates
+        now[0] = r._peer_backoff["p"][1]
+        r._note_peer_failure("p")
+        assert r._peer_backoff["p"][0] == 2
+        first_window = r._peer_backoff["p"][1]
+        assert first_window > now[0]
+
+        # inbound traffic from the peer clears everything immediately
+        r._note_peer_ok("p")
+        assert not r._peer_backed_off("p")
+        assert "p" not in r._peer_backoff
+
+
 class TestCompaction:
     def test_compaction_is_segment_aligned_and_survives_restart(
         self, scheduler, tmp_path

@@ -17,6 +17,12 @@ import time
 
 import pytest
 
+# Volume + device-engine wire tests: on a shared-CPU container the cold
+# XLA compiles and the 10k-instance run exceed tier-1's wall budget (this
+# module alone ran >550s there), so the whole module is tier-2 — run it
+# with `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
 from zeebe_tpu.gateway.cluster_client import ClusterClient
 from zeebe_tpu.models.bpmn.builder import Bpmn
 from zeebe_tpu.runtime.cluster_broker import ClusterBroker

@@ -158,6 +158,60 @@ class TestRobustness:
         finally:
             server.close()
 
+    def test_stale_pooled_connection_reconnects_and_retries_once(self, client):
+        """Regression: after a peer restart, the first send_request on the
+        stale pooled connection must reconnect-and-retry internally instead
+        of surfacing a TransportError to the caller. The server kills the
+        connection under the second request; the internal retry redials and
+        the caller sees a normal response."""
+        import socket as socket_mod
+
+        from zeebe_tpu.runtime.metrics import event_count
+
+        calls = []
+
+        def handler(payload, conn):
+            calls.append(payload)
+            if len(calls) == 2:
+                # simulate the peer restarting under the pooled connection
+                conn._conn.sock.shutdown(socket_mod.SHUT_RDWR)
+                return None
+            return b"ok:" + payload
+
+        server = ServerTransport(request_handler=handler)
+        try:
+            assert client.send_request(server.address, b"a").join(5) == b"ok:a"
+            r0 = event_count("transport_reconnects")
+            # second request: the server tears the connection down instead
+            # of answering — one internal reconnect-and-retry must succeed
+            assert client.send_request(server.address, b"b").join(5) == b"ok:b"
+            assert len(calls) == 3
+            assert event_count("transport_reconnects") - r0 == 1
+        finally:
+            server.close()
+
+    def test_fresh_connection_failure_is_not_retried(self, client):
+        """The stale-connection retry must not loop on a server that kills
+        EVERY connection: a request whose connection was dialed fresh for it
+        fails without retry (and a retried request fails on the second
+        kill)."""
+        import socket as socket_mod
+
+        calls = []
+
+        def handler(payload, conn):
+            calls.append(payload)
+            conn._conn.sock.shutdown(socket_mod.SHUT_RDWR)
+            return None
+
+        server = ServerTransport(request_handler=handler)
+        try:
+            with pytest.raises(TransportError):
+                client.send_request(server.address, b"x", timeout_ms=3000).join(5)
+            assert len(calls) <= 2  # at most the original + one retry
+        finally:
+            server.close()
+
     def test_pending_request_fails_fast_on_disconnect(self, client):
         server = ServerTransport(request_handler=lambda p: None)
         addr = server.address

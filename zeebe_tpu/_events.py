@@ -1,0 +1,20 @@
+"""Process-global event counting for low-level layers.
+
+Transport, log storage, snapshot storage and raft have no broker metrics
+registry in reach (they are constructed in many places, some several
+layers from a broker), so chaos-relevant events count into the global
+registry of :mod:`zeebe_tpu.runtime.metrics`. This module exists so those
+layers share ONE shim: it is import-cycle-free (no imports at module
+level) because ``zeebe_tpu.runtime`` initializes the broker — which
+imports ``zeebe_tpu.log`` — at package-init time, and a top-level metrics
+import from inside ``log`` would re-enter that cycle half-built.
+"""
+
+from __future__ import annotations
+
+
+def count_event(name: str) -> None:
+    """Bump a process-global event counter (allocate-on-first-use)."""
+    from zeebe_tpu.runtime.metrics import count_event as _impl
+
+    _impl(name)

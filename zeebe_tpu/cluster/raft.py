@@ -34,6 +34,7 @@ import os
 import random
 from typing import Callable, Dict, List, Optional
 
+from zeebe_tpu._events import count_event as _count_event
 from zeebe_tpu.log.logstream import LogStream
 from zeebe_tpu.protocol import codec, msgpack
 from zeebe_tpu.runtime.actors import Actor, ActorFuture, ActorScheduler
@@ -55,6 +56,13 @@ class RaftConfig:
     election_timeout_ms: int = 400
     election_jitter_ms: int = 400
     replication_batch_records: int = 128
+    # per-peer RPC backoff: after a failed append/poll/vote exchange the
+    # peer is not re-contacted for base * 2^(failures-1) ms (+ jitter),
+    # capped at max — a dead or partitioned-away peer must not be hammered
+    # at the full heartbeat rate (bare re-sends amplified exactly when the
+    # cluster was least healthy)
+    rpc_backoff_base_ms: int = 50
+    rpc_backoff_max_ms: int = 2000
 
 
 class RaftPersistentStorage:
@@ -118,6 +126,9 @@ class Raft(Actor):
         self.match_position: Dict[str, int] = {}
         self._last_heartbeat_ms = 0
         self._election_deadline_ms = 0
+        # per-peer RPC backoff state: member id → (consecutive_failures,
+        # earliest retry time in scheduler ms); see RaftConfig.rpc_backoff_*
+        self._peer_backoff: Dict[str, tuple] = {}
         # set when the leader probes us with snapshot_needed (we are below
         # its compaction floor); the snapshot-replication service reads it
         # to decide a log fast-forward is legitimate
@@ -380,6 +391,43 @@ class Raft(Actor):
             + self.rng.randrange(self.config.election_jitter_ms + 1)
         )
 
+    # -- per-peer RPC backoff ----------------------------------------------
+    # Scope: the backoff gates only the APPEND path (_replicate_one), which
+    # re-sends at the heartbeat rate. Election poll/vote sends are NOT
+    # gated — they are already paced and jittered by the election timer
+    # (one send per member per timeout), and skipping a just-healed peer
+    # there would stretch the leaderless window by up to the max backoff.
+    # Poll/vote responses still feed the failure accounting, so a dead
+    # peer discovered during an election is backed off on the append path.
+    def _peer_backed_off(self, member_id: str) -> bool:
+        entry = self._peer_backoff.get(member_id)
+        return entry is not None and self.scheduler.now_ms() < entry[1]
+
+    def _note_peer_failure(self, member_id: str) -> None:
+        """A request to this peer failed (no/undecodable response): back off
+        exponentially with jitter before contacting it again.
+
+        Failures landing while the peer is ALREADY backed off don't
+        escalate: one outage kills every in-flight request at once (several
+        heartbeat-interval appends share the request-timeout window), and
+        counting that burst as N failures would jump the delay straight to
+        the max instead of ramping 1x, 2x, 4x per retry round."""
+        entry = self._peer_backoff.get(member_id, (0, 0))
+        if self.scheduler.now_ms() < entry[1]:
+            return
+        failures = entry[0] + 1
+        delay = min(
+            self.config.rpc_backoff_max_ms,
+            self.config.rpc_backoff_base_ms * (1 << min(failures - 1, 16)),
+        )
+        delay += self.rng.randrange(delay // 2 + 1)  # jitter: desynchronize
+        self._peer_backoff[member_id] = (
+            failures, self.scheduler.now_ms() + delay
+        )
+
+    def _note_peer_ok(self, member_id: str) -> None:
+        self._peer_backoff.pop(member_id, None)
+
     def _become(self, state: RaftState) -> None:
         if self.state == state:
             return
@@ -425,10 +473,14 @@ class Raft(Actor):
             }
         )
         for mid, addr in others.items():
-            self._ask(addr, request, lambda msg, mid=mid: self._on_poll_response(msg))
+            self._ask(addr, request, lambda msg, mid=mid: self._on_poll_response(mid, msg))
 
-    def _on_poll_response(self, msg: dict) -> None:
-        if self.state == RaftState.LEADER or msg is None:
+    def _on_poll_response(self, member_id: str, msg: Optional[dict]) -> None:
+        if msg is None:
+            self._note_peer_failure(member_id)
+            return
+        self._note_peer_ok(member_id)
+        if self.state == RaftState.LEADER:
             return
         if msg.get("granted"):
             self.polls.add(msg.get("from", len(self.polls)))
@@ -437,6 +489,7 @@ class Raft(Actor):
                 self._start_election()
 
     def _start_election(self) -> None:
+        _count_event("raft_elections_started")
         self._become(RaftState.CANDIDATE)
         self.persistent.term += 1
         self.persistent.voted_for = self.node_id
@@ -461,7 +514,11 @@ class Raft(Actor):
             self._ask(addr, request, lambda msg, mid=mid: self._on_vote_response(mid, msg))
 
     def _on_vote_response(self, member_id: str, msg: Optional[dict]) -> None:
-        if msg is None or self.state != RaftState.CANDIDATE:
+        if msg is None:
+            self._note_peer_failure(member_id)
+            return
+        self._note_peer_ok(member_id)
+        if self.state != RaftState.CANDIDATE:
             return
         if msg.get("term", 0) > self.persistent.term:
             self._step_down(msg["term"])
@@ -472,6 +529,7 @@ class Raft(Actor):
                 self._become_leader()
 
     def _become_leader(self) -> None:
+        _count_event("raft_elections_won")
         self.leader_id = self.node_id
         last, _ = self._last_entry()
         for mid in self._other_members():
@@ -518,6 +576,8 @@ class Raft(Actor):
             self._replicate_one(mid, addr)
 
     def _replicate_one(self, member_id: str, addr: RemoteAddress) -> None:
+        if self._peer_backed_off(member_id):
+            return  # unreachable peer: exponential backoff, not bare re-sends
         next_pos = self.next_position.get(member_id, 0)
         if next_pos < self.log.base_position:
             # the member is behind the compaction floor: the records it
@@ -578,7 +638,11 @@ class Raft(Actor):
     def _on_append_response(
         self, member_id: str, last_sent: int, msg: Optional[dict]
     ) -> None:
-        if msg is None or self.state != RaftState.LEADER:
+        if msg is None:
+            self._note_peer_failure(member_id)
+            return
+        self._note_peer_ok(member_id)
+        if self.state != RaftState.LEADER:
             return
         term = msg.get("term", 0)
         if term > self.persistent.term:
@@ -685,6 +749,10 @@ class Raft(Actor):
         return msgpack.pack({"ok": True, "position": position})
 
     def _handle_poll(self, msg: dict) -> bytes:
+        # inbound traffic proves the peer is back (a backed-off healed
+        # follower times out and polls — without this, the leader would sit
+        # out the rest of the backoff before resuming its appends)
+        self._note_peer_ok(msg.get("candidate"))
         # A current leader never grants pre-votes: _last_heartbeat_ms is
         # only refreshed by incoming appends, which a leader does not
         # receive, so without this guard a rejoining up-to-date node could
@@ -702,6 +770,7 @@ class Raft(Actor):
         )
 
     def _handle_vote(self, msg: dict) -> bytes:
+        self._note_peer_ok(msg.get("candidate"))  # see _handle_poll
         term = msg.get("term", 0)
         if term > self.persistent.term:
             self._step_down(term)
@@ -719,6 +788,7 @@ class Raft(Actor):
         )
 
     def _handle_append(self, msg: dict) -> bytes:
+        self._note_peer_ok(msg.get("leader"))  # see _handle_poll
         term = msg.get("term", 0)
         if term < self.persistent.term:
             return msgpack.pack(

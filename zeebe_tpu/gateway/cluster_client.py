@@ -51,10 +51,16 @@ class ClusterClient:
         contact_points: List[RemoteAddress],
         request_timeout_ms: int = 10_000,
         num_partitions: int = 1,
+        retry_budget: int = 32,
     ):
         self.contact_points = list(contact_points)
         self.request_timeout_ms = request_timeout_ms
         self.num_partitions = num_partitions
+        # per-command retry budget: leader changes and connection losses are
+        # retried (with topology rediscovery) at most this many times inside
+        # the request deadline — a permanently sick partition fails the
+        # command with the retry history instead of spinning out the clock
+        self.retry_budget = max(1, retry_budget)
         self.transport = ClientTransport(
             default_timeout_ms=request_timeout_ms,
             message_handler=self._on_push,
@@ -149,7 +155,18 @@ class ClusterClient:
         deadline = time.monotonic() + self.request_timeout_ms / 1000.0
         attempt_ms = max(1_000, self.request_timeout_ms // 4)
         last_error = "no leader known"
-        while time.monotonic() < deadline:
+        failures = 0
+
+        # the pause cap scales with the deadline so the budget genuinely
+        # spans it (fast NOT_LEADER churn must not burn 32 retries while a
+        # 60s-deadline caller's new leader is seconds away); floor 0.5s
+        # keeps short-deadline clients responsive
+        pause_cap = max(0.5, self.request_timeout_ms / 1000.0 / self.retry_budget)
+
+        def pause():
+            time.sleep(min(pause_cap, 0.05 * (1 << min(failures, 6))))
+
+        while time.monotonic() < deadline and failures < self.retry_budget:
             addr = self._leader_for(partition)
             if addr is None:
                 time.sleep(0.05)
@@ -162,10 +179,13 @@ class ClusterClient:
                 ).join(timeout_ms / 1000.0 + 1)
                 msg = msgpack.unpack(payload)
             except (TransportError, ValueError, TimeoutError) as e:
+                # connection loss / timeout: burn one retry, rediscover the
+                # leader, try again
                 last_error = str(e)
+                failures += 1
                 with self._lock:
                     self._leaders.pop(partition, None)
-                time.sleep(0.05)
+                pause()
                 continue
             if msg.get("t") == "command-rsp":
                 response, _ = codec.decode_record(bytes(msg["frame"]))
@@ -176,14 +196,19 @@ class ClusterClient:
                     )
                 return response
             if msg.get("t") == "error" and msg.get("code") == "NOT_LEADER":
+                # leader change: burn one retry and follow the topology
                 last_error = "NOT_LEADER"
+                failures += 1
                 with self._lock:
                     self._leaders.pop(partition, None)
-                time.sleep(0.05)
+                pause()
                 continue
             last_error = str(msg)
-            time.sleep(0.05)
-        raise TransportError(f"command failed: {last_error}")
+            failures += 1
+            pause()
+        raise TransportError(
+            f"command failed after {failures} retries: {last_error}"
+        )
 
     # -- topics (reference TopicClient.newCreateTopicCommand) --------------
     def create_topic(

@@ -109,6 +109,7 @@ class LogStream:
     def _recover(self) -> None:
         last_position = -1
         torn = False
+        torn_address = None
         for base_address, data in self.storage.iter_blocks():
             if torn:
                 break
@@ -117,11 +118,13 @@ class LogStream:
                 frame_len = codec.peek_frame_length(data, offset)
                 if frame_len is None or offset + frame_len > len(data):
                     torn = True  # torn tail write: discard
+                    torn_address = base_address + offset
                     break
                 try:
                     record, next_offset = codec.decode_record(data, offset)
                 except ValueError:
                     torn = True  # corrupt tail frame (bad crc): discard
+                    torn_address = base_address + offset
                     break
                 if record.position % BLOCK_INDEX_DENSITY == 0:
                     self._block_index.append((record.position, base_address + offset))
@@ -132,6 +135,17 @@ class LogStream:
                 self._records.append(record)
                 last_position = record.position
                 offset = next_offset
+        if torn_address is not None:
+            # physically cut the torn tail so the next append resumes at the
+            # last whole record — an in-memory discard alone would leave new
+            # appends stranded AFTER the partial frame, unreachable to every
+            # future recovery scan (the storage layer's crc pre-scan catches
+            # most of this; this covers a torn FIRST record and frames whose
+            # prefix validates but whose body the codec rejects)
+            try:
+                self.storage.truncate(torn_address)
+            except OSError:
+                pass  # read-only/odd storage: recovery still discards in memory
         self._next_position = last_position + 1
         if not self._records and self._base_meta_position > 0:
             # empty log after a fast-forward (or compaction that emptied

@@ -20,12 +20,17 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import logging
 import os
 import re
 import shutil
 import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
+
+from zeebe_tpu._events import count_event as _count_event
+
+logger = logging.getLogger(__name__)
 
 _SNAPSHOT_DIR_RE = re.compile(r"^snapshot_(-?\d+)_(-?\d+)_(-?\d+)$")
 _STATE_FILE = "state.bin"
@@ -77,22 +82,52 @@ class SnapshotStorage:
     atomic rename (reference FsSnapshotStorage temp-write + commit).
     """
 
+    # set-aside suffix used by _swap_in; ".old" is the legacy spelling
+    # (pre-chaos-plane dirs) and is swept identically
+    _ASIDE_SUFFIXES = (".aside", ".old")
+
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
-        # sweep torn temp dirs from a crash mid-write; recover ".old"
-        # set-aside dirs from a crash mid-swap (see _swap_in): if the
-        # replacement never landed, the set-aside IS the committed snapshot
-        for name in os.listdir(root):
-            path = os.path.join(root, name)
+        self._sweep_orphans()
+
+    def _sweep_orphans(self) -> None:
+        """Crash-recovery sweep of the snapshot root (runs on open).
+
+        ``.tmp`` dirs are torn writes — DELETED (never just skipped: a
+        skipped orphan survives forever and later swap-ins trip over it).
+        ``.aside`` set-aside dirs come from a crash between ``_swap_in``'s
+        two renames: when the replacement never landed the set-aside IS the
+        committed snapshot and is restored; when the final exists the
+        set-aside is obsolete and DELETED. Every action logs a salvage
+        event and counts into ``snapshot_salvage_events``."""
+        for name in sorted(os.listdir(self.root)):
+            path = os.path.join(self.root, name)
             if name.endswith(".tmp"):
                 shutil.rmtree(path, ignore_errors=True)
-            elif name.endswith(".old"):
-                final = path[: -len(".old")]
-                if os.path.exists(final):
-                    shutil.rmtree(path, ignore_errors=True)
-                else:
-                    os.rename(path, final)
+                self._salvage("deleted torn temp dir %s", name)
+                continue
+            suffix = next(
+                (s for s in self._ASIDE_SUFFIXES if name.endswith(s)), None
+            )
+            if suffix is None:
+                continue
+            final = path[: -len(suffix)]
+            if os.path.exists(final):
+                shutil.rmtree(path, ignore_errors=True)
+                self._salvage(
+                    "deleted orphaned set-aside %s (replacement committed)", name
+                )
+            else:
+                os.rename(path, final)
+                self._salvage(
+                    "restored set-aside snapshot %s (replacement never landed)",
+                    name,
+                )
+
+    def _salvage(self, fmt: str, *args) -> None:
+        logger.warning("snapshot salvage in %s: " + fmt, self.root, *args)
+        _count_event("snapshot_salvage_events")
 
     def _swap_in(self, tmp: str, final: str) -> None:
         """Commit ``tmp`` over ``final`` without ever unlinking a committed
@@ -101,7 +136,7 @@ class SnapshotStorage:
         point leaves either the old or the new snapshot on disk
         (round-4 advisor finding on _commit_manifest)."""
         if os.path.exists(final):
-            aside = final + ".old"
+            aside = final + ".aside"
             if os.path.exists(aside):
                 shutil.rmtree(aside)
             os.rename(final, aside)
@@ -120,9 +155,12 @@ class SnapshotStorage:
         out.sort(reverse=True)
         return out
 
-    def write(self, metadata: SnapshotMetadata, payload: bytes) -> None:
-        tmp = os.path.join(self.root, metadata.dirname + ".tmp")
-        final = os.path.join(self.root, metadata.dirname)
+    @staticmethod
+    def populate_blob_dir(tmp: str, payload: bytes) -> None:
+        """Write a single-blob snapshot's content (state + checksum, both
+        fsync'd) into ``tmp``. Shared with the chaos plane's crash-point
+        injector so simulated crashes leave exactly the on-disk layout a
+        real one would."""
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
         os.makedirs(tmp)
@@ -134,6 +172,11 @@ class SnapshotStorage:
             f.write(str(zlib.crc32(payload)))
             f.flush()
             os.fsync(f.fileno())
+
+    def write(self, metadata: SnapshotMetadata, payload: bytes) -> None:
+        tmp = os.path.join(self.root, metadata.dirname + ".tmp")
+        final = os.path.join(self.root, metadata.dirname)
+        self.populate_blob_dir(tmp, payload)
         self._swap_in(tmp, final)
 
     def read(self, metadata: SnapshotMetadata) -> Optional[bytes]:

@@ -11,15 +11,41 @@ mmap backend with the same on-disk format (selected via
 
 from __future__ import annotations
 
+import logging
 import os
 import struct
+import zlib
 from typing import Iterator, List, Optional, Tuple
+
+from zeebe_tpu._events import count_event as _count_event
+
+logger = logging.getLogger(__name__)
 
 SEGMENT_MAGIC = 0x5A4C4F47  # "ZLOG"
 SEGMENT_HEADER = struct.Struct("<IIq")  # magic, segment_id, start_offset_unused
 SEGMENT_HEADER_SIZE = SEGMENT_HEADER.size
 
 DEFAULT_SEGMENT_SIZE = 64 * 1024 * 1024  # reference default is 512M; smaller here
+
+# Shared record-frame prefix (protocol/codec.py layout): u32 frame_length
+# (total, including itself), u32 crc32 over bytes [8:frame_length). The
+# storage layer validates this prefix on reopen to find a torn tail; the
+# full decode stays the codec's concern.
+_FRAME_PREFIX = struct.Struct("<iI")
+
+
+def _has_resync_frame(data: bytes, start: int) -> bool:
+    """Does any byte position after ``start`` begin a valid frame? True
+    means the invalid region does not extend to EOF — intact frames follow
+    the corruption, which a torn append can never produce (a crash leaves
+    at most one partial frame, at the tail)."""
+    for pos in range(start + 1, len(data) - _FRAME_PREFIX.size + 1):
+        frame_len, crc = _FRAME_PREFIX.unpack_from(data, pos)
+        if frame_len < _FRAME_PREFIX.size or pos + frame_len > len(data):
+            continue
+        if zlib.crc32(data[pos + 8 : pos + frame_len]) == crc:
+            return True
+    return False
 
 
 class SegmentedLogStorage:
@@ -91,8 +117,82 @@ class SegmentedLogStorage:
             self._current_file.seek(0, os.SEEK_END)
             self._current_size = self._current_file.tell()
             self._current_id = last
+            self._truncate_torn_tail()
         else:
             self._roll_segment(0)
+
+    def _truncate_torn_tail(self) -> None:
+        """Crash recovery for the current (last) segment: walk its record
+        frames validating the shared length+crc32 prefix and truncate the
+        file to the last whole record. Without this, a torn append poisons
+        replay — recovery's scan stops at the partial frame, but new appends
+        land AFTER it, so every record written post-restart is unreachable.
+
+        Only the last segment can be torn (appends never touch earlier
+        ones). Opaque non-record payloads are left alone: if the FIRST frame
+        after the header does not validate, the segment is treated as
+        opaque and not scanned (raw-block users of this storage)."""
+        f = self._current_file
+        f.seek(0)
+        header = f.read(SEGMENT_HEADER_SIZE)
+        if len(header) < SEGMENT_HEADER_SIZE or (
+            SEGMENT_HEADER.unpack(header)[0] != SEGMENT_MAGIC
+        ):
+            # crash during _roll_segment: the header itself is torn — the
+            # segment never held a record, rewrite it empty
+            logger.warning(
+                "segment %s: torn header (%d bytes), rewriting empty",
+                self._segment_path(self._current_id), len(header),
+            )
+            f.seek(0)
+            f.truncate(0)
+            f.write(SEGMENT_HEADER.pack(SEGMENT_MAGIC, self._current_id, 0))
+            f.flush()
+            self._current_size = SEGMENT_HEADER_SIZE
+            _count_event("log_torn_tail_truncations")
+            return
+        data = f.read()
+        offset = 0
+        while offset < len(data):
+            if len(data) - offset < _FRAME_PREFIX.size:
+                break
+            frame_len, crc = _FRAME_PREFIX.unpack_from(data, offset)
+            if frame_len < _FRAME_PREFIX.size or offset + frame_len > len(data):
+                break
+            if zlib.crc32(data[offset + 8 : offset + frame_len]) != crc:
+                break
+            offset += frame_len
+        if offset == 0 and data:
+            return  # opaque content: never truncate what we can't parse
+        valid_end = SEGMENT_HEADER_SIZE + offset
+        if valid_end < SEGMENT_HEADER_SIZE + len(data):
+            if _has_resync_frame(data, offset):
+                # A later frame validates, so the invalid region does NOT
+                # reach EOF: this is mid-file corruption (bitrot, external
+                # tampering), not the single partial frame a crashed append
+                # leaves. Truncation is still the only state that lets
+                # replay and appends proceed — records are positionally
+                # sequential, so the suffix is unreachable either way, and
+                # raft re-replicates it from the leader — but it discards
+                # INTACT frames, so escalate past the benign-tail warning.
+                logger.error(
+                    "segment %s: CRC failure at %d with valid frames after "
+                    "it — mid-file corruption, not a torn tail; discarding "
+                    "the suffix (%d bytes) including intact records",
+                    self._segment_path(self._current_id), valid_end,
+                    len(data) - offset,
+                )
+                _count_event("log_midfile_corruption")
+            else:
+                logger.warning(
+                    "segment %s: torn tail at %d (%d bytes discarded)",
+                    self._segment_path(self._current_id), valid_end,
+                    len(data) - offset,
+                )
+            f.truncate(valid_end)
+            f.flush()
+            self._current_size = valid_end
+            _count_event("log_torn_tail_truncations")
 
     def _roll_segment(self, segment_id: int) -> None:
         if self._current_file is not None:

@@ -13,7 +13,11 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
+
+try:
+    import tomllib
+except ImportError:  # Python < 3.11: tomli is the API-compatible backport
+    import tomli as tomllib
 from typing import Any, Dict, List, Optional
 
 # default ports mirror the reference layout (client 26501, management 26502,

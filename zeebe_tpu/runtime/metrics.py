@@ -96,6 +96,41 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
+# -- process-global event counters ------------------------------------------
+# Low-level components (transport, log storage, snapshot storage, raft) have
+# no broker registry in reach — they are constructed in many places, some
+# (raft's own ClientTransport) several layers away from the broker. Chaos-
+# relevant events from those layers count into one process-global registry
+# instead, merged into every /metrics dump and metrics-file flush via
+# ``render_with_global``. Names used today: raft_elections_started,
+# raft_elections_won, transport_reconnects, transport_pending_expired,
+# log_torn_tail_truncations, snapshot_salvage_events.
+GLOBAL_REGISTRY = MetricsRegistry()
+
+
+def global_counter(name: str, help_text: str = "", **labels: str) -> Metric:
+    return GLOBAL_REGISTRY.counter(name, help_text, **labels)
+
+
+def count_event(name: str, help_text: str = "", delta: float = 1.0) -> None:
+    """Bump a process-global event counter (allocate-on-first-use)."""
+    GLOBAL_REGISTRY.counter(name, help_text).inc(delta)
+
+
+def event_count(name: str) -> float:
+    """Current value of a global event counter (0 if never bumped)."""
+    return GLOBAL_REGISTRY.counter(name).value
+
+
+def render_with_global(registry: MetricsRegistry, now_ms: Optional[int] = None) -> str:
+    """A registry's Prometheus dump with the global event counters appended
+    (skipped when the registry IS the global one — no duplicate series)."""
+    text = registry.dump(now_ms)
+    if registry is not GLOBAL_REGISTRY:
+        text += GLOBAL_REGISTRY.dump(now_ms)
+    return text
+
+
 class MetricsHttpServer:
     """Serves ``GET /metrics`` with the registry's Prometheus text dump.
 
@@ -115,7 +150,7 @@ class MetricsHttpServer:
                     self.send_response(404)
                     self.end_headers()
                     return
-                body = registry_ref.dump().encode("utf-8")
+                body = render_with_global(registry_ref).encode("utf-8")
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(body)))
@@ -162,5 +197,5 @@ class MetricsFileWriter(Actor):
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
-            f.write(self.registry.dump())
+            f.write(render_with_global(self.registry))
         os.replace(tmp, self.path)

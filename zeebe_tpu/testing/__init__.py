@@ -3,3 +3,10 @@
 clocks are product surface, not private test code)."""
 
 from zeebe_tpu.testing.stub_broker import StubBroker  # noqa: F401
+from zeebe_tpu.testing.chaos import (  # noqa: F401
+    ChaosHarness,
+    DiskFaults,
+    FaultPlane,
+    oracle_state_bytes,
+    replay_oracle,
+)

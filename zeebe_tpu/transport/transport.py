@@ -33,6 +33,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, Optional, Tuple
 
+from zeebe_tpu._events import count_event as _count_event
 from zeebe_tpu.runtime.actors import ActorFuture
 
 _HDR = struct.Struct("<IBQ")
@@ -56,6 +57,34 @@ class RemoteAddress:
 
 def _encode(frame_type: int, correlation_id: int, payload: bytes) -> bytes:
     return _HDR.pack(len(payload) + 9, frame_type, correlation_id) + payload
+
+
+def _deliver(loop: "_IoLoop", conn: "_Conn", peer, data: bytes, hook) -> None:
+    """Send ``data`` on ``conn``, consulting the optional fault-injection
+    hook first (``zeebe_tpu.testing.chaos.FaultPlane`` installs one).
+
+    ``hook(peer, data)`` returns a list of ``(delay_seconds, payload)``
+    deliveries — empty list drops the frame, a >0 delay defers it (reorder
+    and duplication fall out of multiple entries), ``None`` means deliver
+    normally. ``peer`` is the dialed RemoteAddress on the client side and
+    None on the server side (responses ride the requester's connection)."""
+    if hook is None:
+        loop.send(conn, data)
+        return
+    deliveries = hook(peer, data)
+    if deliveries is None:
+        loop.send(conn, data)
+        return
+    for delay_s, chunk in deliveries:
+        if delay_s <= 0:
+            loop.send(conn, chunk)
+        else:
+            timer = threading.Timer(
+                delay_s,
+                lambda c=conn, d=chunk: loop.send(c, d) if c.open else None,
+            )
+            timer.daemon = True
+            timer.start()
 
 
 class _Conn:
@@ -347,6 +376,10 @@ class ServerTransport:
             self._handler_wants_conn = False
         self.request_handler = handler
         self.message_handler = message_handler or (lambda payload: None)
+        # chaos injection point for RESPONSE frames (see _deliver); pushes
+        # through ConnectionHandle bypass it — the chaos plane severs RPC
+        # by blocking the request direction
+        self.fault_hook = None
         self._listener = socket.create_server((host, port))
         self._listener.setblocking(False)
         self.address = RemoteAddress(host, self._listener.getsockname()[1])
@@ -392,7 +425,10 @@ class ServerTransport:
                         lambda f, c=conn, i=cid: self._send_async_response(c, i, f)
                     )
                 elif response is not None:
-                    self._loop.send(conn, _encode(RESPONSE, cid, response))
+                    _deliver(
+                        self._loop, conn, None,
+                        _encode(RESPONSE, cid, response), self.fault_hook,
+                    )
             elif ftype == MESSAGE:
                 try:
                     self.message_handler(payload)
@@ -405,7 +441,10 @@ class ServerTransport:
         if future._exception is not None or future._value is None:
             return  # no response (caller times out, like a handler returning None)
         if conn.open:
-            self._loop.send(conn, _encode(RESPONSE, cid, future._value))
+            _deliver(
+                self._loop, conn, None,
+                _encode(RESPONSE, cid, future._value), self.fault_hook,
+            )
 
     def _on_close(self, conn: _Conn):
         self._conns.pop(conn.sock, None)
@@ -438,10 +477,15 @@ class ClientTransport:
 
     ``send_request`` returns an ``ActorFuture`` completed with the response
     payload, failed fast with ``TransportError`` when the connection breaks
-    or the timeout lapses. Callers that want the reference's retry-forever
-    semantics (``ClientOutput.sendRequest`` retried by the gateway request
-    manager) loop on the failure and reconnect — the pool dials a fresh
-    connection on the next send. ``send_message`` is fire-and-forget.
+    or the timeout lapses. One failure mode is retried INTERNALLY: a request
+    written to a stale pooled connection (the peer restarted since the last
+    exchange, so the first write after the restart hits a dead socket)
+    redials and resends once — callers must not see a ``TransportError``
+    merely because the pool was behind reality (reference: ClientOutput
+    retries on channel close before giving the failure to the request
+    manager). All other failures surface; callers wanting retry-forever
+    semantics loop and reconnect — the pool dials a fresh connection on the
+    next send. ``send_message`` is fire-and-forget.
     """
 
     def __init__(
@@ -450,6 +494,7 @@ class ClientTransport:
         message_handler: Optional[Callable[[bytes], None]] = None,
     ):
         self.message_handler = message_handler
+        self.fault_hook = None  # chaos injection point (see _deliver)
         self._loop = _IoLoop("zb-client").start()
         self._conns: Dict[RemoteAddress, _Conn] = {}
         self._by_sock: Dict[socket.socket, Tuple[RemoteAddress, _Conn]] = {}
@@ -540,6 +585,7 @@ class ClientTransport:
                         expired.append((cid, future))
                         del self._pending[cid]
             for _cid, future in expired:
+                _count_event("transport_pending_expired")
                 future.complete_exceptionally(TransportError("request timed out"))
             time.sleep(0.01)
 
@@ -552,23 +598,67 @@ class ClientTransport:
     ) -> ActorFuture:
         future = ActorFuture()
         timeout = (timeout_ms or self.default_timeout_ms) / 1000.0
+        self._send_attempt(addr, payload, future, time.monotonic() + timeout, retried=False)
+        return future
+
+    def _send_attempt(
+        self,
+        addr: RemoteAddress,
+        payload: bytes,
+        future: ActorFuture,
+        deadline: float,
+        retried: bool,
+    ) -> None:
+        # was there a live pooled connection BEFORE this attempt? Only those
+        # qualify for the stale-connection retry: a connection dialed fresh
+        # for this very request that immediately breaks is a real failure.
+        with self._lock:
+            existing = self._conns.get(addr)
+        pooled = existing is not None and existing.open
         cid = next(self._correlation)
         try:
             conn = self._connect(addr)
         except OSError as e:
             future.complete_exceptionally(TransportError(f"connect to {addr}: {e}"))
-            return future
+            return
+        inner = ActorFuture()
         with self._lock:
-            self._pending[cid] = (future, time.monotonic() + timeout, conn)
-        self._loop.send(conn, _encode(REQUEST, cid, payload))
-        return future
+            self._pending[cid] = (inner, deadline, conn)
+
+        def on_done(f: ActorFuture):
+            if f._exception is None:
+                future.complete(f._value)
+                return
+            if (
+                pooled
+                and not retried
+                and not self._closing
+                and "connection closed" in str(f._exception)
+                and time.monotonic() < deadline
+            ):
+                # the pool's connection died under the request (peer
+                # restarted): reconnect and resend once on a fresh socket.
+                # On a dedicated thread — this callback runs on the IO
+                # thread, and the redial blocks up to the connect timeout
+                _count_event("transport_reconnects")
+                threading.Thread(
+                    target=self._send_attempt,
+                    args=(addr, payload, future, deadline, True),
+                    daemon=True,
+                    name="zb-client-reconnect",
+                ).start()
+                return
+            future.complete_exceptionally(f._exception)
+
+        inner.on_complete(on_done)
+        _deliver(self._loop, conn, addr, _encode(REQUEST, cid, payload), self.fault_hook)
 
     def send_message(self, addr: RemoteAddress, payload: bytes) -> bool:
         try:
             conn = self._connect(addr)
         except OSError:
             return False
-        self._loop.send(conn, _encode(MESSAGE, 0, payload))
+        _deliver(self._loop, conn, addr, _encode(MESSAGE, 0, payload), self.fault_hook)
         return True
 
     def close(self):
