@@ -31,8 +31,14 @@ fi
 echo "== chaos smoke (fixed-seed fault schedule; tier-1, <60s) =="
 python -m pytest tests/test_chaos.py -q -m "not slow"
 
+echo "== exporter plane (director/compaction gating/sinks; tier-1) =="
+python -m pytest tests/test_exporters.py -q -m "not slow"
+
+echo "== JSONL exporter smoke (boot broker, run a workflow, replay audit) =="
+python tools/exporter_smoke.py
+
 echo "== full test suite (tier-1; run './ci.sh slow' for the slow tier) =="
-python -m pytest tests/ -x -q -m "not slow" --ignore=tests/test_chaos.py
+python -m pytest tests/ -x -q -m "not slow" --ignore=tests/test_chaos.py --ignore=tests/test_exporters.py
 
 echo "== pallas ops + mega-pass parity (skips without a TPU) =="
 python benchmarks/pallas_ops_check.py

@@ -528,8 +528,31 @@ class TestMultiPartition:
                     "msg-flow", {"orderId": "order-9"}, partition_id=1
                 )
                 instance_key = created.value.workflow_instance_key
-                # give the subscription a moment to open on the message partition
-                time.sleep(0.5)
+                # wait until the subscription is actually OPEN on the
+                # hash-routed message partition before publishing: with
+                # the default TTL of 0 a message that finds no open
+                # subscription is deleted immediately (reference
+                # semantics), so publishing on a fixed sleep raced the
+                # cross-partition OPEN command under CI load and the
+                # instance waited forever
+                from zeebe_tpu.gateway.cluster_client import _correlation_hash
+
+                msg_partition = _correlation_hash("order-9") % 3
+
+                def subscription_open():
+                    leader = cluster.leader_of(msg_partition)
+                    if leader is None:
+                        return False
+                    engine = leader.partitions[msg_partition].engine
+                    return engine is not None and any(
+                        s.message_name == "order-paid"
+                        and s.correlation_key == "order-9"
+                        for s in engine.message_subscriptions
+                    )
+
+                assert wait_until(subscription_open), (
+                    "message subscription never opened on the message partition"
+                )
                 client.publish_message("order-paid", "order-9", {"paid": True})
 
                 def instance_completed():

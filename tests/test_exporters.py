@@ -1,0 +1,880 @@
+"""Exporter plane: director dispatch, position acks, compaction gating,
+built-in sinks, config, and crash-resume (single-broker level; the
+cluster-level invariants live in tests/test_chaos.py)."""
+
+import json
+import os
+
+import pytest
+
+from zeebe_tpu.exporter import (
+    Exporter,
+    InMemoryExporter,
+    JsonlExporter,
+    MetricsExporter,
+    build_exporter,
+    read_audit_docs,
+)
+from zeebe_tpu.exporter.director import ExporterDirector, fold_tail_acks
+from zeebe_tpu.exporter.jsonl import _recover_file_tail
+from zeebe_tpu.gateway import JobWorker, ZeebeClient
+from zeebe_tpu.log import LogStream, SegmentedLogStorage
+from zeebe_tpu.models.bpmn.builder import Bpmn
+from zeebe_tpu.protocol.enums import RecordType, ValueType
+from zeebe_tpu.protocol.intents import ExporterIntent, JobIntent
+from zeebe_tpu.protocol.metadata import RecordMetadata
+from zeebe_tpu.protocol.records import ExporterPositionRecord, JobRecord, Record
+from zeebe_tpu.runtime import Broker, ControlledClock
+from zeebe_tpu.runtime.config import ExporterCfg, load_config
+from zeebe_tpu.runtime.metrics import (
+    GLOBAL_REGISTRY,
+    MetricsRegistry,
+    event_count,
+    render_with_global,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset_memory_sinks():
+    InMemoryExporter.reset()
+    yield
+    InMemoryExporter.reset()
+
+
+def job_record(i: int) -> Record:
+    return Record(
+        key=i,
+        metadata=RecordMetadata(
+            record_type=RecordType.EVENT,
+            value_type=ValueType.JOB,
+            intent=int(JobIntent.CREATED),
+        ),
+        value=JobRecord(type=f"t{i}"),
+    )
+
+
+def simple_model(pid="exp-proc"):
+    return (
+        Bpmn.create_process(pid)
+        .start_event("s")
+        .service_task("t", type="svc")
+        .end_event("e")
+        .done()
+    )
+
+
+def make_log(tmp_path, segment_size=512):
+    storage = SegmentedLogStorage(str(tmp_path / "log"), segment_size=segment_size)
+    return LogStream(storage)
+
+
+def make_director(log, exporters, clock=None):
+    director = ExporterDirector(
+        0, log, exporters, append_fn=lambda recs: log.append(recs),
+        clock=clock,
+    )
+    return director
+
+
+# ---------------------------------------------------------------------------
+# director core
+# ---------------------------------------------------------------------------
+
+
+class TestDirector:
+    def test_dispatches_committed_records_in_order_and_acks(self, tmp_path):
+        log = make_log(tmp_path)
+        log.append([job_record(i) for i in range(10)])
+        mem = InMemoryExporter()
+        director = make_director(log, [("mem", mem)])
+        director.open({})
+        assert director.pump() is True
+        assert mem.positions() == list(range(10))
+        # the ack went into the log as a replicated EXPORTER record
+        acks = [
+            r for r in log.reader(0)
+            if int(r.metadata.value_type) == int(ValueType.EXPORTER)
+            and int(r.metadata.intent) == int(ExporterIntent.ACKNOWLEDGE)
+        ]
+        # registration ack (-1) + progress ack; the progress ack lands on
+        # the last VISIBLE record (9) — never on the trailing hidden
+        # registration record at 10, which the exporter never saw (a file
+        # sink compares its recovered tail against the replicated ack on
+        # open, and an ack on a hidden position would false-report an
+        # audit hole after a restart)
+        assert [a.value.position for a in acks] == [-1, 9]
+        director.close()
+        assert mem.closed
+
+    def test_acks_never_self_feed(self, tmp_path):
+        """Pumping to quiescence terminates: ack records are hidden from
+        exporters and an admin-only batch writes no further ack."""
+        log = make_log(tmp_path)
+        log.append([job_record(0)])
+        director = make_director(log, [("mem", InMemoryExporter())])
+        director.open({})
+        for _ in range(5):
+            if not director.pump():
+                break
+        else:
+            pytest.fail("director never reached quiescence")
+        n_records = log.next_position
+        director.pump()
+        assert log.next_position == n_records, "idle pump appended records"
+
+    def test_failing_exporter_is_isolated_and_retried(self, tmp_path):
+        clock_ms = [1_000_000]
+        log = make_log(tmp_path)
+        log.append([job_record(i) for i in range(4)])
+        ok, bad = InMemoryExporter(), InMemoryExporter()
+        bad.fail = True
+        director = make_director(
+            log, [("ok", ok), ("bad", bad)], clock=lambda: clock_ms[0]
+        )
+        director.open({})
+        director.pump()
+        assert ok.positions() == [0, 1, 2, 3], "healthy exporter blocked"
+        assert bad.positions() == []
+        f = GLOBAL_REGISTRY.counter(
+            "exporter_export_failures", exporter="bad", partition="0"
+        )
+        assert f.value >= 1
+        # backoff: an immediate re-pump skips the failing exporter
+        failures_before = f.value
+        director.pump()
+        assert f.value == failures_before
+        # after the backoff window it retries; once fixed it catches up
+        bad.fail = False
+        clock_ms[0] += 60_000
+        director.pump()
+        assert bad.positions()[: 4] == [0, 1, 2, 3]
+        director.close()
+
+    def test_exporter_lag_gauge_tracks_commit_distance(self, tmp_path):
+        log = make_log(tmp_path)
+        log.append([job_record(i) for i in range(6)])
+        stuck = InMemoryExporter()
+        stuck.fail = True
+        director = make_director(log, [("lagging", stuck)])
+        director.open({})
+        director.pump()
+        gauge = GLOBAL_REGISTRY.gauge(
+            "exporter_lag", exporter="lagging", partition="0"
+        )
+        # behind by every VISIBLE record — the registration ack is this
+        # plane's own hidden traffic, not exportable lag (measured against
+        # the raw commit position the gauge could never read 0)
+        assert gauge.value == 6
+        stuck.fail = False
+        director.handles[0].retry_at_ms = 0
+        director.pump()
+        assert gauge.value == 0  # fully caught up reads zero at idle
+        director.close()
+        # the gauge renders into the merged /metrics text
+        assert "exporter_lag" in render_with_global(MetricsRegistry())
+
+    def test_broken_open_is_isolated(self, tmp_path):
+        class Exploding(Exporter):
+            def open(self, controller):
+                raise RuntimeError("boom")
+
+        log = make_log(tmp_path)
+        log.append([job_record(0)])
+        ok = InMemoryExporter()
+        director = make_director(log, [("boom", Exploding()), ("ok", ok)])
+        director.open({})
+        director.pump()
+        assert ok.positions() == [0]
+        assert director.handles[0].broken is not None
+        director.close()
+
+    def test_manual_ack_holds_position_until_confirmed(self, tmp_path):
+        class AsyncSink(InMemoryExporter):
+            MANUAL_ACK = True
+
+        log = make_log(tmp_path)
+        log.append([job_record(i) for i in range(3)])
+        sink = AsyncSink()
+        director = make_director(log, [("async", sink)])
+        director.open({})
+        director.pump()
+        handle = director.handles[0]
+        assert sink.positions() == [0, 1, 2]  # delivered...
+        assert handle.position == -1          # ...but not acked
+        assert director.compaction_floor() == 0
+        sink.controller.update_position(2)
+        director.pump()
+        assert handle.position == 2
+        assert director.compaction_floor() == 3
+        director.close()
+
+    def test_manual_ack_consuming_without_confirm_fires_stall(self, tmp_path):
+        """A MANUAL_ACK sink that keeps accepting batches but never calls
+        update_position is a stall: its position pins the floor even
+        though its cursor runs ahead of the commit position."""
+        class AsyncSink(InMemoryExporter):
+            MANUAL_ACK = True
+
+        clock_ms = [1_000_000]
+        log = make_log(tmp_path)
+        log.append([job_record(i) for i in range(3)])
+        sink = AsyncSink()
+        director = make_director(log, [("async", sink)], clock=lambda: clock_ms[0])
+        director.open({})
+        director.pump()
+        assert sink.positions() == [0, 1, 2]  # consuming fine...
+        assert director.compaction_floor() == 0  # ...but pinning
+        s0 = event_count("exporter_floor_stalls")
+        clock_ms[0] += ExporterDirector.STALL_AFTER_MS + 1
+        director.pump()
+        assert event_count("exporter_floor_stalls") - s0 == 1
+        # confirming clears the stall episode
+        sink.controller.update_position(2)
+        director.pump()
+        assert director.handles[0].stall_warned is False
+        director.close()
+
+    def test_manual_ack_confirming_everything_visible_is_not_a_stall(self, tmp_path):
+        """A MANUAL_ACK sink acked at the last VISIBLE record is fully
+        caught up — the trailing hidden ack records above it must not
+        read as lag or fire a false stall warning."""
+        class AsyncSink(InMemoryExporter):
+            MANUAL_ACK = True
+
+        clock_ms = [1_000_000]
+        log = make_log(tmp_path)
+        log.append([job_record(i) for i in range(3)])
+        sink = AsyncSink()
+        director = make_director(log, [("async", sink)], clock=lambda: clock_ms[0])
+        director.open({})
+        director.pump()
+        sink.controller.update_position(2)  # confirm everything visible
+        director.pump()
+        s0 = event_count("exporter_floor_stalls")
+        clock_ms[0] += ExporterDirector.STALL_AFTER_MS * 3
+        director.pump()
+        assert event_count("exporter_floor_stalls") == s0, "false stall"
+        assert director.handles[0].stall_warned is False
+        gauge = GLOBAL_REGISTRY.gauge(
+            "exporter_lag", exporter="async", partition="0"
+        )
+        assert gauge.value == 0, "hidden ack records counted as lag"
+        director.close()
+
+    def test_fold_tail_acks_covers_unreplayed_tail(self, tmp_path):
+        log = make_log(tmp_path)
+        log.append([job_record(0)])
+        log.append([
+            Record(
+                metadata=RecordMetadata(
+                    record_type=RecordType.COMMAND,
+                    value_type=ValueType.EXPORTER,
+                    intent=int(ExporterIntent.ACKNOWLEDGE),
+                ),
+                value=ExporterPositionRecord(exporter_id="x", position=7),
+            )
+        ])
+        assert fold_tail_acks({"x": 3}, log, 0) == {"x": 7}
+        assert fold_tail_acks({}, log, 0) == {"x": 7}
+        # monotonic: engine state ahead of the tail wins
+        assert fold_tail_acks({"x": 11}, log, 0) == {"x": 11}
+
+
+# ---------------------------------------------------------------------------
+# compaction gating + stall warning
+# ---------------------------------------------------------------------------
+
+
+class TestCompactionGating:
+    def _fill_segments(self, log, n=40):
+        for i in range(n):
+            log.append([job_record(i)])
+        log.flush()
+
+    def test_stuck_exporter_holds_the_floor_and_compact_refuses(self, tmp_path):
+        clock_ms = [1_000_000]
+        log = make_log(tmp_path, segment_size=256)
+        self._fill_segments(log)
+        stuck = InMemoryExporter()
+        stuck.fail = True
+        director = make_director(log, [("stuck", stuck)], clock=lambda: clock_ms[0])
+        director.open({})
+        director.pump()
+        # the caller asks to compact everything; the floor provider refuses
+        assert log.compact(log.next_position) == 0
+        assert log.base_position == 0
+        assert log.record_at(0) is not None, "unexported record dropped"
+        # the stall warning fires once the exporter stays stuck
+        s0 = event_count("exporter_floor_stalls")
+        clock_ms[0] += ExporterDirector.STALL_AFTER_MS + 1
+        director.pump()
+        assert event_count("exporter_floor_stalls") - s0 == 1
+        # ...once per episode, not per pump
+        clock_ms[0] += ExporterDirector.STALL_AFTER_MS + 1
+        director.pump()
+        assert event_count("exporter_floor_stalls") - s0 == 1
+        director.close()
+
+    def test_acking_releases_the_floor(self, tmp_path):
+        clock_ms = [1_000_000]
+        log = make_log(tmp_path, segment_size=256)
+        self._fill_segments(log)
+        stuck = InMemoryExporter()
+        stuck.fail = True
+        director = make_director(log, [("stuck", stuck)], clock=lambda: clock_ms[0])
+        director.open({})
+        director.pump()
+        assert log.compact(log.next_position) == 0
+        stuck.fail = False
+        clock_ms[0] += 60_000
+        director.pump()
+        new_base = log.compact(log.next_position)
+        assert new_base > 0, "ack did not release the compaction floor"
+        # at-least-once: everything the exporter saw is still in order
+        positions = stuck.positions()
+        assert positions == sorted(positions)
+        director.close()
+
+    def test_removed_provider_stops_gating(self, tmp_path):
+        log = make_log(tmp_path, segment_size=256)
+        self._fill_segments(log)
+        stuck = InMemoryExporter()
+        stuck.fail = True
+        director = make_director(log, [("stuck", stuck)])
+        director.open({})
+        director.pump()
+        assert log.compact(log.next_position) == 0
+        director.close()  # deconfigured exporter no longer pins
+        assert log.compact(log.next_position) > 0
+
+
+# ---------------------------------------------------------------------------
+# broker integration (engine state + snapshot + crash resume)
+# ---------------------------------------------------------------------------
+
+
+class TestBrokerIntegration:
+    def _run_traffic(self, broker, n=5, pid="exp-proc"):
+        client = ZeebeClient(broker)
+        client.deploy_model(simple_model(pid))
+        worker = JobWorker(broker, "svc", lambda ctx: {"done": True})
+        for i in range(n):
+            client.create_instance(pid, {"i": i})
+        broker.run_until_idle()
+        return worker
+
+    def test_exports_every_committed_record_and_persists_positions(self, tmp_path):
+        mem = InMemoryExporter()
+        broker = Broker(data_dir=str(tmp_path), exporters=[("mem", mem)])
+        self._run_traffic(broker)
+        log = broker.partitions[0].log
+        visible = [
+            r.position for r in log.reader(0)
+            if int(r.metadata.value_type) != int(ValueType.EXPORTER)
+        ]
+        assert mem.positions() == visible
+        engine = broker.partitions[0].engine
+        assert engine.exporter_positions["mem"] >= visible[-1]
+        assert engine.compaction_floor() <= engine.exporter_positions["mem"] + 1
+        broker.close()
+
+    def test_shared_instance_pair_rejected_with_multiple_partitions(self, tmp_path):
+        """One instance across partitions would interleave both streams
+        into one sink (and the JSONL dedup tail would silently drop the
+        lower partition's records) — fail boot instead."""
+        with pytest.raises(ValueError, match="instance pairs"):
+            Broker(
+                num_partitions=2, data_dir=str(tmp_path),
+                exporters=[("mem", InMemoryExporter())],
+            )
+        # cfg entries build a fresh instance per partition: fine
+        broker = Broker(
+            num_partitions=2, data_dir=str(tmp_path / "ok"),
+            exporters=[ExporterCfg(id="mem", type="memory")],
+        )
+        directors = [p.exporter_director for p in broker.partitions]
+        assert directors[0].handles[0].exporter is not directors[1].handles[0].exporter
+        broker.close()
+
+    def test_positions_survive_snapshot_restore(self, tmp_path):
+        mem = InMemoryExporter()
+        broker = Broker(data_dir=str(tmp_path), exporters=[("mem", mem)])
+        self._run_traffic(broker)
+        broker.snapshot()
+        acked = broker.partitions[0].engine.exporter_positions["mem"]
+        broker.close()
+
+        mem2 = InMemoryExporter()
+        restarted = Broker(data_dir=str(tmp_path), exporters=[("mem", mem2)])
+        assert restarted.partitions[0].engine.exporter_positions["mem"] == acked
+        # resume: nothing re-exported below the ack
+        restarted.run_until_idle()
+        assert all(p > acked for p in mem2.positions())
+        restarted.close()
+
+    def test_deconfigured_exporter_stops_pinning_the_floor(self, tmp_path):
+        """Restarting without a previously configured exporter appends an
+        EXPORTER REMOVE for its recovered entry: the stale position (here
+        a -1 registration that never acked) no longer pins compaction."""
+        never = InMemoryExporter()
+        never.fail = True  # registers at -1, never acks
+        mem = InMemoryExporter()
+        broker = Broker(
+            data_dir=str(tmp_path), exporters=[("mem", mem), ("gone", never)]
+        )
+        self._run_traffic(broker)
+        engine = broker.partitions[0].engine
+        assert engine.exporter_positions["gone"] == -1
+        assert engine.compaction_floor() == 0  # pinned by "gone"
+        broker.close()
+
+        mem2 = InMemoryExporter()
+        restarted = Broker(data_dir=str(tmp_path), exporters=[("mem", mem2)])
+        restarted.run_until_idle()
+        engine = restarted.partitions[0].engine
+        assert "gone" not in engine.exporter_positions
+        assert engine.compaction_floor() > 0
+        restarted.close()
+
+    def test_removing_the_last_exporter_sweeps_its_position(self, tmp_path):
+        """Removing ALL exporters still sweeps the recovered entries: with
+        no director installed at all, the boot path itself must append the
+        REMOVEs or the last-removed exporter's stale position pins the
+        compaction floor forever."""
+        mem = InMemoryExporter()
+        broker = Broker(data_dir=str(tmp_path), exporters=[("mem", mem)])
+        self._run_traffic(broker)
+        assert broker.partitions[0].engine.exporter_positions["mem"] >= 0
+        broker.close()
+
+        restarted = Broker(data_dir=str(tmp_path))  # no exporters at all
+        restarted.run_until_idle()
+        engine = restarted.partitions[0].engine
+        assert engine.exporter_positions == {}
+        restarted.close()
+
+    def test_crash_resume_without_snapshot_reads_tail_acks(self, tmp_path):
+        """No snapshot at all (crash before the first checkpoint): the
+        director folds committed tail acks in and still resumes exactly."""
+        mem = InMemoryExporter()
+        broker = Broker(data_dir=str(tmp_path), exporters=[("mem", mem)])
+        self._run_traffic(broker)
+        broker.close()
+        mem2 = InMemoryExporter()
+        restarted = Broker(data_dir=str(tmp_path), exporters=[("mem", mem2)])
+        restarted.run_until_idle()
+        assert mem2.positions() == []
+        restarted.close()
+
+
+# ---------------------------------------------------------------------------
+# JSONL exporter
+# ---------------------------------------------------------------------------
+
+
+class TestJsonlExporter:
+    def _cfg(self, tmp_path, **extra):
+        return ExporterCfg(
+            id="audit", type="jsonl",
+            args={"path": str(tmp_path / "audit"), **extra},
+        )
+
+    def test_audit_file_replays_to_the_log_sequence(self, tmp_path):
+        broker = Broker(
+            data_dir=str(tmp_path / "data"),
+            exporters=[self._cfg(tmp_path)],
+        )
+        TestBrokerIntegration()._run_traffic(broker)
+        log = broker.partitions[0].log
+        expected = [
+            (r.position, int(r.metadata.intent))
+            for r in log.reader(0)
+            if int(r.metadata.value_type) != int(ValueType.EXPORTER)
+        ]
+        broker.close()
+        docs = read_audit_docs(str(tmp_path / "audit"))
+        assert [d["position"] for d in docs] == [p for p, _ in expected]
+        assert all("valueType" in d and "intent" in d for d in docs)
+
+    def test_rotation_by_size(self, tmp_path):
+        broker = Broker(
+            data_dir=str(tmp_path / "data"),
+            exporters=[self._cfg(tmp_path, rotate_bytes=2048)],
+        )
+        TestBrokerIntegration()._run_traffic(broker, n=10)
+        broker.close()
+        files = os.listdir(str(tmp_path / "audit"))
+        assert len(files) > 1, "no rotation happened"
+        docs = read_audit_docs(str(tmp_path / "audit"))
+        positions = [d["position"] for d in docs]
+        assert positions == sorted(positions)
+
+    def test_torn_tail_line_is_truncated_and_redelivery_fills_the_gap(self, tmp_path):
+        """Kernel-crash model: the last audit line is torn mid-write. A
+        fresh exporter instance truncates it on open and the director's
+        at-least-once re-delivery (export resumes at the last acked
+        position, which trails the file tail) restores a gap-free,
+        duplicate-free file."""
+        from zeebe_tpu.exporter.base import ExporterContext
+
+        audit = str(tmp_path / "audit")
+        records = [job_record(i) for i in range(6)]
+        for i, r in enumerate(records):
+            r.position = i
+            r.timestamp = 0
+
+        def fresh():
+            exporter = JsonlExporter()
+            exporter.configure(ExporterContext("audit", {"path": audit}))
+            exporter.open(None)
+            return exporter
+
+        first = fresh()
+        first.export_batch(records)
+        first.close()
+        files = sorted(os.listdir(audit))
+        path = os.path.join(audit, files[-1])
+        # tear mid-line (crash mid-write of the final record)
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) - 9)
+        assert _recover_file_tail(path) == 4
+        with open(path, "rb") as f:
+            assert f.read().endswith(b"\n"), "torn line not truncated"
+        # restart: re-delivery overlaps the surviving tail (positions 3..5)
+        second = fresh()
+        second.export_batch(records[3:])
+        second.close()
+        docs = read_audit_docs(audit)
+        assert [d["position"] for d in docs] == [0, 1, 2, 3, 4, 5]
+
+    def test_crash_after_rotation_recovers_tail_from_older_files(self, tmp_path):
+        """A crash between rotation and the new file's first flush leaves
+        the newest file EMPTY; open() must walk back to the older files
+        for the dedup tail or re-delivery duplicates their records."""
+        from zeebe_tpu.exporter.base import ExporterContext
+
+        audit = str(tmp_path / "audit")
+        records = [job_record(i) for i in range(4)]
+        for i, r in enumerate(records):
+            r.position = i
+            r.timestamp = 0
+
+        def fresh():
+            exporter = JsonlExporter()
+            exporter.configure(ExporterContext("audit", {"path": audit}))
+            exporter.open(None)
+            return exporter
+
+        first = fresh()
+        first.export_batch(records)
+        first.close()
+        # crash model: rotation created the next file but nothing reached it
+        open(os.path.join(audit, "audit-p0-000000000004.jsonl"), "w").close()
+        second = fresh()
+        assert second._last_position == 3, "tail not recovered from older file"
+        second.export_batch(records[2:])  # at-least-once re-delivery
+        second.close()
+        docs = read_audit_docs(audit)
+        assert [d["position"] for d in docs] == [0, 1, 2, 3]
+
+    def test_wiped_audit_directory_under_an_ack_reports_a_hole(self, tmp_path):
+        """The ENTIRE audit directory lost (disk replaced, volume not
+        mounted) while the acked position survives in replicated engine
+        state: open() must report the hole exactly like a lost tail, not
+        silently resume above the missing history."""
+        import shutil
+
+        from zeebe_tpu.exporter.base import ExporterContext, ExporterController
+
+        audit = str(tmp_path / "audit")
+        records = [job_record(i) for i in range(3)]
+        for i, r in enumerate(records):
+            r.position = i
+            r.timestamp = 0
+        first = JsonlExporter()
+        first.configure(ExporterContext("audit", {"path": audit}))
+        first.open(None)
+        first.export_batch(records)
+        first.close()
+
+        shutil.rmtree(audit)
+        holes = event_count("exporter_audit_holes")
+        second = JsonlExporter()
+        second.configure(ExporterContext("audit", {"path": audit}))
+        second.open(ExporterController(
+            lambda _p: None, lambda _d, _f: None, acked_position=2
+        ))
+        second.close()
+        assert event_count("exporter_audit_holes") == holes + 1
+
+    def test_recover_tail_preserves_lines_after_midfile_bitrot(self, tmp_path):
+        """A corrupt line FOLLOWED by more content is bitrot, not a torn
+        tail: recovery must preserve the intact lines after it as
+        forensic evidence (replay raises on the corruption instead of
+        silently losing it to truncation), and the dedup tail still
+        comes from the valid lines beyond the corruption."""
+        path = str(tmp_path / "audit-p0-000000000000.jsonl")
+        content = '{"position": 1}\nGARBAGE\n{"position": 3}\n'
+        with open(path, "w") as f:
+            f.write(content)
+        bitrot = event_count("exporter_audit_bitrot")
+        assert _recover_file_tail(path) == 3
+        with open(path) as f:
+            assert f.read() == content, "bitrot evidence truncated"
+        assert event_count("exporter_audit_bitrot") == bitrot + 1
+        with pytest.raises(ValueError):
+            read_audit_docs(str(tmp_path))
+        # a trailing torn fragment after the bitrot is still cut — but
+        # never the corruption or the valid lines around it
+        with open(path, "a") as f:
+            f.write('{"posi')
+        assert _recover_file_tail(path) == 3
+        with open(path) as f:
+            assert f.read() == content
+
+    def test_recover_tail_cuts_complete_but_non_dict_lines(self, tmp_path):
+        """Bitrot can leave a COMPLETE line whose json is not a dict
+        (`42\\n`): recovery must truncate it like any corrupt tail, not
+        crash open() with a TypeError and brick the exporter."""
+        path = str(tmp_path / "audit-p0-000000000000.jsonl")
+        with open(path, "w") as f:
+            f.write('{"position": 3}\n42\n')
+        assert _recover_file_tail(path) == 3
+        with open(path) as f:
+            assert f.read() == '{"position": 3}\n'
+        # same gap for a dict whose position is null
+        with open(path, "a") as f:
+            f.write('{"position": null}\n')
+        assert _recover_file_tail(path) == 3
+
+    def test_recover_tail_scans_backwards_in_chunks(self, tmp_path, monkeypatch):
+        """A near-rotation-size audit file must not be slurped + parsed
+        whole on every leadership install: the backwards scan reads only
+        the tail window (widened until a valid line is found)."""
+        import zeebe_tpu.exporter.jsonl as jsonl_mod
+
+        monkeypatch.setattr(jsonl_mod, "_TAIL_CHUNK", 64)
+        path = str(tmp_path / "audit-p0-000000000000.jsonl")
+        with open(path, "w") as f:
+            for i in range(100):
+                f.write(json.dumps({"position": i, "pad": "x" * 40}) + "\n")
+            f.write('{"position": 100, "torn...')  # crash mid-write
+        assert _recover_file_tail(path) == 99
+        with open(path, "rb") as f:
+            data = f.read()
+        assert data.endswith(b"\n") and b"torn" not in data
+        # torn tail LONGER than the first window: widening still finds it
+        with open(path, "a") as f:
+            f.write('{"position": 100, ' + "y" * 500)
+        assert _recover_file_tail(path) == 99
+
+    def test_mid_file_corruption_raises_instead_of_silent_hole(self, tmp_path):
+        """A corrupt line in a NON-newest file is bitrot, not a torn tail:
+        replay must raise, not return a sequence missing records."""
+        from zeebe_tpu.exporter.base import ExporterContext
+
+        audit = str(tmp_path / "audit")
+        records = [job_record(i) for i in range(3)]
+        for i, r in enumerate(records):
+            r.position = i
+            r.timestamp = 0
+        exporter = JsonlExporter()
+        exporter.configure(ExporterContext("audit", {"path": audit}))
+        exporter.open(None)
+        exporter.export_batch(records)
+        exporter.close()
+        files = sorted(os.listdir(audit))
+        older = os.path.join(audit, files[0])
+        with open(older, "r+b") as f:
+            f.seek(2)
+            f.write(b"\x00\x00")  # bitrot mid-line (valid utf-8, broken json)
+        open(os.path.join(audit, "audit-p0-000000000009.jsonl"), "w").close()
+        with pytest.raises(ValueError, match="corrupt audit line"):
+            read_audit_docs(audit)
+
+    def test_missing_path_arg_fails_loudly(self, tmp_path):
+        spec = ExporterCfg(id="audit", type="jsonl", args={})
+        exporter_id, exporter = build_exporter(spec)
+        with pytest.raises(ValueError, match="path"):
+            from zeebe_tpu.exporter.base import ExporterContext
+
+            exporter.configure(ExporterContext(exporter_id, {}))
+
+
+# ---------------------------------------------------------------------------
+# metrics exporter
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsExporter:
+    def test_per_value_type_counters_and_latency_histograms(self, tmp_path):
+        registry = MetricsRegistry()
+        clock = ControlledClock(start_ms=1_000_000)
+        broker = Broker(
+            data_dir=str(tmp_path), clock=clock,
+            exporters=[("metrics", MetricsExporter(registry=registry))],
+        )
+        TestBrokerIntegration()._run_traffic(broker)
+        text = registry.dump(now_ms=0)
+        assert 'exported_records_total{' in text
+        assert 'value_type="JOB"' in text
+        assert 'value_type="WORKFLOW_INSTANCE"' in text
+        assert 'intent="CREATED"' in text
+        assert "export_latency_ms_bucket" in text
+        assert "export_latency_ms_count" in text
+        broker.close()
+
+    def test_histogram_rendering_is_cumulative(self):
+        registry = MetricsRegistry()
+        hist = registry.histogram("h_test", buckets=(1, 10, 100))
+        for v in (0.5, 5, 50, 500):
+            hist.observe(v)
+        text = registry.dump(now_ms=0)
+        assert 'h_test_bucket{le="1"} 1' in text
+        assert 'h_test_bucket{le="10"} 2' in text
+        assert 'h_test_bucket{le="100"} 3' in text
+        assert 'h_test_bucket{le="+Inf"} 4' in text
+        assert "h_test_count 4" in text
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+
+class TestExporterConfig:
+    def test_exporters_section_parses(self):
+        cfg = load_config(toml_text="""
+[[exporters]]
+id = "audit"
+type = "jsonl"
+args = { path = "/tmp/audit", rotate_bytes = 1024 }
+
+[[exporters]]
+id = "metrics"
+type = "metrics"
+""", env={})
+        assert [e.id for e in cfg.exporters] == ["audit", "metrics"]
+        assert cfg.exporters[0].args == {"path": "/tmp/audit", "rotate_bytes": 1024}
+
+    def test_exporter_entry_requires_id_and_type(self):
+        with pytest.raises(ValueError, match="id"):
+            load_config(toml_text="""
+[[exporters]]
+type = "jsonl"
+""", env={})
+
+    def test_build_exporter_rejects_unknown_type(self):
+        with pytest.raises(ValueError, match="unknown exporter type"):
+            build_exporter(ExporterCfg(id="x", type="nope"))
+
+    def test_build_exporter_dotted_path(self):
+        _, exporter = build_exporter(
+            ExporterCfg(id="x", type="zeebe_tpu.exporter.memory:InMemoryExporter")
+        )
+        assert isinstance(exporter, InMemoryExporter)
+
+    def test_duplicate_exporter_ids_rejected_everywhere(self, tmp_path):
+        """Two exporters on one id share one replicated position entry —
+        the faster one's ack masks the slower one's gap after restart, so
+        every boot path must refuse the config."""
+        from zeebe_tpu.runtime.cluster_broker import ClusterBroker
+        from zeebe_tpu.runtime.config import BrokerCfg
+
+        with pytest.raises(ValueError, match="duplicate exporter id"):
+            load_config(toml_text="""
+[[exporters]]
+id = "audit"
+type = "jsonl"
+args = { path = "/tmp/a" }
+
+[[exporters]]
+id = "audit"
+type = "memory"
+""", env={})
+        with pytest.raises(ValueError, match="duplicate exporter id"):
+            Broker(
+                data_dir=str(tmp_path / "b"),
+                exporters=[("mem", InMemoryExporter()),
+                           ("mem", InMemoryExporter())],
+            )
+        cfg = BrokerCfg()
+        cfg.exporters = [
+            ExporterCfg(id="mem", type="memory"),
+            ExporterCfg(id="mem", type="memory"),
+        ]
+        with pytest.raises(ValueError, match="duplicate exporter id"):
+            ClusterBroker(cfg, str(tmp_path / "c"))
+
+    def test_cluster_broker_rejects_bad_exporter_at_construction(self, tmp_path):
+        """Cluster path must fail boot loudly like the in-process Broker —
+        not surface the error inside the leadership-install actor job."""
+        from zeebe_tpu.runtime.cluster_broker import ClusterBroker
+        from zeebe_tpu.runtime.config import BrokerCfg
+
+        cfg = BrokerCfg()
+        cfg.exporters = [ExporterCfg(id="x", type="no-such-type")]
+        with pytest.raises(ValueError, match="unknown exporter type"):
+            ClusterBroker(cfg, str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# protocol round-trip
+# ---------------------------------------------------------------------------
+
+
+class TestExporterRecords:
+    def test_ack_record_codec_roundtrip(self):
+        from zeebe_tpu.protocol import codec
+
+        record = Record(
+            position=5,
+            metadata=RecordMetadata(
+                record_type=RecordType.COMMAND,
+                value_type=ValueType.EXPORTER,
+                intent=int(ExporterIntent.ACKNOWLEDGE),
+            ),
+            value=ExporterPositionRecord(exporter_id="audit", position=41),
+        )
+        decoded, _ = codec.decode_record(codec.encode_record(record))
+        assert decoded.value.exporter_id == "audit"
+        assert decoded.value.position == 41
+        assert int(decoded.metadata.value_type) == int(ValueType.EXPORTER)
+
+    def test_engine_folds_acks_and_registration_pins_floor(self, tmp_path):
+        broker = Broker(data_dir=str(tmp_path))
+        engine = broker.partitions[0].engine
+        from zeebe_tpu.protocol.records import ExporterPositionRecord as EPR
+
+        def ack(exporter_id, pos):
+            return Record(
+                metadata=RecordMetadata(
+                    record_type=RecordType.COMMAND,
+                    value_type=ValueType.EXPORTER,
+                    intent=int(ExporterIntent.ACKNOWLEDGE),
+                ),
+                value=EPR(exporter_id=exporter_id, position=pos),
+            )
+
+        broker.partitions[0].log.append([ack("a", -1)])
+        broker.run_until_idle()
+        assert engine.exporter_positions == {"a": -1}
+        assert engine.compaction_floor() == 0  # registration pins everything
+        broker.partitions[0].log.append([ack("a", 50), ack("a", 20)])
+        broker.run_until_idle()
+        assert engine.exporter_positions == {"a": 50}, "ack must be monotonic"
+        broker.close()
+
+    def test_exporter_positions_ride_state_serialization(self):
+        from zeebe_tpu.engine.interpreter import PartitionEngine, WorkflowRepository
+        from zeebe_tpu.log import stateser
+
+        engine = PartitionEngine(
+            partition_id=0, num_partitions=1,
+            repository=WorkflowRepository(), clock=lambda: 0,
+        )
+        engine.exporter_positions = {"audit": 17, "mem": -1}
+        restored = stateser.decode_state(
+            stateser.encode_state(engine.snapshot_state())
+        )
+        assert restored["exporter_positions"] == {"audit": 17, "mem": -1}

@@ -420,16 +420,39 @@ class TestMembershipChurnUnderFaults:
                 plane.install_client(node.client, nid)
             leader = cluster.await_leader()
             lid = leader.node_id
-            original_members = set(leader.persistent.members)
             assert wait_until(lambda: cluster.logs[lid].commit_position >= 0)
-            followers = [n for n in cluster.nodes if n != lid]
 
             # cut the leader off completely, then have it accept an
-            # add_member it can never commit (applies on append)
-            plane.isolate(lid)
+            # add_member it can never commit (applies on append). Under
+            # CI load a heartbeat hiccup can depose the just-observed
+            # leader (higher-term election) right around the isolation,
+            # voiding the premise — the cut-off node then neither accepts
+            # nor forwards the op and the test dies on its 10s deadline.
+            # So: isolate, let in-flight higher-term messages drain, and
+            # only proceed if the isolated node still leads (isolated,
+            # nothing can depose it anymore); else heal and re-acquire.
+            isolated_leader = False
+            for _ in range(5):
+                plane.isolate(lid)
+                time.sleep(0.3)
+                if leader.state == RaftState.LEADER:
+                    isolated_leader = True
+                    break
+                plane.heal(lid)
+                leader = cluster.await_leader()
+                lid = leader.node_id
+            # must record that the BREAK path was taken: after a failed
+            # final attempt `leader` is a freshly-healed, connected leader
+            # whose state check would pass vacuously
+            assert isolated_leader, "no stable leader to isolate"
+            original_members = set(leader.persistent.members)
+            followers = [n for n in cluster.nodes if n != lid]
             extra = cluster._make_node("n3")
             del cluster.nodes["n3"]  # keep leader() blind to the bystander
-            leader.add_member("n3", extra.address).join(5)
+            # join margin > MEMBERSHIP_TIMEOUT_MS (10s): the op's own
+            # deadline raises a far more diagnostic error than a bare
+            # join TimeoutError would
+            leader.add_member("n3", extra.address).join(15)
             assert wait_until(lambda: "n3" in leader.persistent.members)
 
             # the connected majority elects a successor that never saw the

@@ -403,6 +403,12 @@ class PartitionEngine:
         self.topic_sub_acks: Dict[str, int] = {}
         self.topic_sub_keys = keyspace.topic_subscriber_keys()
 
+        # exporter export progress (reference ExportersState: per exporter
+        # the last log position it durably exported; replicated through
+        # EXPORTER ACKNOWLEDGE records so a new leader resumes without
+        # gaps, and pins the compaction floor until exported)
+        self.exporter_positions: Dict[str, int] = {}
+
         # poison-record isolation (reference StreamProcessor onError):
         # (position, error) for records whose handler raised; they are
         # skipped by process_batch, never retried
@@ -446,6 +452,12 @@ class PartitionEngine:
         # bounded by exporter/subscriber positions)
         for acked in self.topic_sub_acks.values():
             floor = min(floor, acked + 1)
+        # exporters bound segment deletion the same way (reference: "the
+        # broker deletes segments only up to the lowest exporter
+        # position"): a registered exporter with no progress yet (-1)
+        # pins the floor at 0
+        for acked in self.exporter_positions.values():
+            floor = min(floor, acked + 1)
         return floor
 
     def snapshot_state(self) -> dict:
@@ -470,6 +482,7 @@ class PartitionEngine:
             "pending_boundary": self._pending_boundary,
             "awaiting_jobs": self._awaiting_jobs,
             "topic_sub_acks": self.topic_sub_acks,
+            "exporter_positions": self.exporter_positions,
             "topics": self.topics,
             "next_partition_id": self.next_partition_id,
             "last_processed_position": self.last_processed_position,
@@ -497,6 +510,7 @@ class PartitionEngine:
         self._pending_boundary = state.get("pending_boundary", {})
         self._awaiting_jobs = state.get("awaiting_jobs", {})
         self.topic_sub_acks = state.get("topic_sub_acks", {})
+        self.exporter_positions = state.get("exporter_positions", {})
         self.topics = state.get("topics", {})
         self.next_partition_id = state.get("next_partition_id", 1)
         self.last_processed_position = state["last_processed_position"]
@@ -600,6 +614,8 @@ class PartitionEngine:
             self._process_topic_subscriber(record, out)
         elif vt == ValueType.SUBSCRIPTION and rt == RecordType.COMMAND:
             self._process_topic_subscription_ack(record, out)
+        elif vt == ValueType.EXPORTER and rt == RecordType.COMMAND:
+            self._process_exporter_ack(record, out)
         elif vt == ValueType.TOPIC and rt == RecordType.COMMAND:
             self._process_topic(record, out)
 
@@ -702,6 +718,33 @@ class PartitionEngine:
             _record(RecordType.EVENT, value.copy(), SubscriptionIntent.ACKNOWLEDGED,
                     record.key, record.position)
         )
+
+    # -- exporter position acks (reference: exporter positions column in
+    # broker state, ExporterDirector#updateLastExportedPosition) -----------
+    def _process_exporter_ack(self, record: Record, out: ProcessingResult) -> None:
+        from zeebe_tpu.protocol.intents import ExporterIntent
+
+        intent = ExporterIntent(record.metadata.intent)
+        value = record.value
+        if not value.exporter_id:
+            return
+        if intent == ExporterIntent.REMOVE:
+            # deconfigured exporter: drop its entry so a stale position
+            # (possibly the -1 registration) stops pinning the compaction
+            # floor forever (the director appends REMOVE on open for
+            # recovered ids no longer in its configured set)
+            self.exporter_positions.pop(value.exporter_id, None)
+            return
+        if intent != ExporterIntent.ACKNOWLEDGE:
+            return
+        # monotonic: a late/duplicate ack (director retry after failover)
+        # never rewinds export progress. position -1 REGISTERS an exporter
+        # before its first ack so compaction is pinned from the start.
+        prior = self.exporter_positions.get(value.exporter_id)
+        if prior is None or value.position > prior:
+            self.exporter_positions[value.exporter_id] = value.position
+        # no follow-up event: the ack command itself is the durable,
+        # replicated artifact (state-only update, nothing re-processable)
 
     # ------------------------------------------------------------------
     # writers (reference TypedStreamWriter / ElementInstanceWriter)

@@ -72,6 +72,10 @@ class LogStream:
         # GIL, so the append hot path stays lock-free)
         self._view_lock = threading.Lock()
         self._commit_listeners: List[Callable[[int], None]] = []
+        # floor providers (exporter directors): each returns the first
+        # position it still needs; compact() never passes them (reference:
+        # segment deletion is bounded by exporter/subscriber positions)
+        self._floor_providers: List[Callable[[], int]] = []
         self._load_base_meta()
         self._recover()
 
@@ -206,7 +210,12 @@ class LogStream:
         what a restart recovers from the remaining segments. Only
         positions covered by a durable snapshot may be compacted (the
         caller's contract — reference: the broker deletes segments below
-        the snapshot position). Returns the new base position."""
+        the snapshot position). Registered floor providers (exporter
+        directors) additionally bound the floor HERE: records some
+        exporter has not acked survive even a caller that forgot them.
+        Returns the new base position."""
+        for provider in list(self._floor_providers):
+            position = min(position, provider())
         position = min(position, self._next_position)
         if position <= self._base_position:
             return self._base_position
@@ -325,6 +334,22 @@ class LogStream:
 
     def on_commit(self, listener: Callable[[int], None]) -> None:
         self._commit_listeners.append(listener)
+
+    def remove_commit_listener(self, listener: Callable[[int], None]) -> None:
+        """Unhook a commit listener (exporter directors close on leader
+        step-down; a stale listener would pump a dead director forever)."""
+        if listener in self._commit_listeners:
+            self._commit_listeners.remove(listener)
+
+    def add_floor_provider(self, provider: Callable[[], int]) -> None:
+        """Register a compaction bound: ``provider()`` returns the first
+        position its owner still needs (see ``compact``)."""
+        if provider not in self._floor_providers:
+            self._floor_providers.append(provider)
+
+    def remove_floor_provider(self, provider: Callable[[], int]) -> None:
+        if provider in self._floor_providers:
+            self._floor_providers.remove(provider)
 
     def flush(self) -> None:
         self.storage.flush()

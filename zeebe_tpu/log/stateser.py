@@ -259,6 +259,8 @@ def encode_host_state(state: Dict[str, Any]) -> bytes:
             for job_type, keys in state.get("awaiting_jobs", {}).items()
         },
         "topic_sub_acks": dict(state["topic_sub_acks"]),
+        # per-exporter acked positions; absent in pre-exporter snapshots
+        "exporter_positions": dict(state.get("exporter_positions", {})),
         "topics": {k: dict(v) for k, v in state["topics"].items()},
         "next_partition_id": state["next_partition_id"],
         "last_processed_position": state["last_processed_position"],
@@ -364,6 +366,10 @@ def _decode_host_doc(doc: dict) -> Dict[str, Any]:
             },
             "topic_sub_acks": {
                 str(k): int(v) for k, v in doc["topic_sub_acks"].items()
+            },
+            "exporter_positions": {
+                str(k): int(v)
+                for k, v in doc.get("exporter_positions", {}).items()
             },
             "topics": {str(k): dict(v) for k, v in doc["topics"].items()},
             "next_partition_id": int(doc["next_partition_id"]),

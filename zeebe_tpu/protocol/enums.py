@@ -41,6 +41,12 @@ class ValueType(enum.IntEnum):
     # timeouts from a polling processor; we materialize timers as records so
     # the device engine can fire them deterministically).
     TIMER = 14
+    # Exporter position acks (the reference persists exporter positions in
+    # broker state; here they are replicated THROUGH the log so a new raft
+    # leader resumes export without gaps — the same pattern as
+    # SUBSCRIPTION acks). EXPORTER records are broker-admin traffic:
+    # exporters themselves never see them.
+    EXPORTER = 15
 
     NULL_VAL = 255
 

@@ -173,6 +173,18 @@ class SubscriberIntent(enum.IntEnum):
     SUBSCRIBED = 1
 
 
+class ExporterIntent(enum.IntEnum):
+    """Exporter position acks (see ValueType.EXPORTER): ACKNOWLEDGE
+    commands persist an exporter's export progress in the replicated log;
+    the engine folds them into ``exporter_positions`` state (snapshotted,
+    bounds compaction). REMOVE drops a deconfigured exporter's entry so
+    its stale position stops pinning the compaction floor."""
+
+    ACKNOWLEDGE = 0
+    ACKNOWLEDGED = 1
+    REMOVE = 2
+
+
 class IdIntent(enum.IntEnum):
     # Reference: protocol/.../intent/IdIntent.java (partition id generator)
     GENERATED = 0
@@ -202,6 +214,7 @@ INTENTS_BY_VALUE_TYPE = {
     ValueType.SUBSCRIBER: SubscriberIntent,
     ValueType.ID: IdIntent,
     ValueType.TIMER: TimerIntent,
+    ValueType.EXPORTER: ExporterIntent,
 }
 
 

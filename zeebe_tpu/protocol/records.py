@@ -275,6 +275,19 @@ class TopicSubscriptionRecord(RecordValue):
 
 
 @dataclasses.dataclass
+class ExporterPositionRecord(RecordValue):
+    """Exporter export-progress ack (reference: the broker persists each
+    exporter's position and bounds segment deletion by their minimum —
+    ExporterDirectorService; here the ack is a replicated log record so a
+    new raft leader resumes from it without gaps)."""
+
+    VALUE_TYPE: ClassVar[ValueType] = ValueType.EXPORTER
+
+    exporter_id: str = _f("exporterId", "")
+    position: int = _f("position", -1)
+
+
+@dataclasses.dataclass
 class NoopRecord(RecordValue):
     """Empty value — raft initial/no-op entries (reference
     LeaderCommitInitialEvent appends a NOOP record on leader election)."""
@@ -311,6 +324,7 @@ VALUE_CLASS_BY_TYPE = {
     ValueType.TIMER: TimerRecord,
     ValueType.SUBSCRIBER: TopicSubscriberRecord,
     ValueType.SUBSCRIPTION: TopicSubscriptionRecord,
+    ValueType.EXPORTER: ExporterPositionRecord,
 }
 
 

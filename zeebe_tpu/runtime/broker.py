@@ -44,6 +44,7 @@ class Partition:
         self.snapshots = snapshots
         self.next_read_position = 0
         self.term = 0  # raft term once replicated; 0 in single-writer mode
+        self.exporter_director = None  # set when exporters are configured
 
     def has_backlog(self) -> bool:
         return self.next_read_position <= self.log.commit_position
@@ -83,10 +84,11 @@ class TopicSubscriptionHandle:
                     break
                 self.cursor = record.position + 1
                 advanced = True
-                # subscription-admin records are not re-delivered: pushing
-                # them would make every ack generate further pushes
+                # subscription/exporter-admin records are not re-delivered:
+                # pushing them would make every ack generate further pushes
                 if record.metadata.value_type in (
                     ValueType.SUBSCRIBER, ValueType.SUBSCRIPTION,
+                    ValueType.EXPORTER,
                 ):
                     continue
                 self._unacked.append(record.position)
@@ -125,7 +127,12 @@ class Broker:
         data_dir: Optional[str] = None,
         clock: Optional[Callable[[], int]] = None,
         engine_factory=None,
+        exporters=None,
     ):
+        """``exporters``: optional list of ``ExporterCfg`` entries and/or
+        ``(id, Exporter)`` pairs; each partition gets its own director
+        (cfg entries build a fresh instance per partition, instance pairs
+        are shared — fine for the default single partition)."""
         self.clock = clock or SystemClock()
         self.data_dir = data_dir or tempfile.mkdtemp(prefix="zeebe-tpu-")
         self.repository = WorkflowRepository()
@@ -136,6 +143,7 @@ class Broker:
         self._record_listeners: List[Callable[[int, Record], None]] = []
         self._topic_subscriptions: List[TopicSubscriptionHandle] = []
         self._rr_partition = 0
+        self._exporter_specs = list(exporters or [])
 
         factory = engine_factory or (
             lambda pid: PartitionEngine(
@@ -154,6 +162,7 @@ class Broker:
             )
             self.partitions.append(Partition(pid, log, factory(pid), snapshots))
         self._recover_partitions()
+        self._open_exporters()
 
     # -- recovery: snapshot + replay (reference StreamProcessorController
     # recovery :156-211 then reprocessing :213-279) -------------------------
@@ -181,6 +190,84 @@ class Broker:
             boundaries[partition.partition_id] = last_source
         for partition in self.partitions:
             self._replay(partition, boundaries[partition.partition_id])
+
+    def _open_exporters(self) -> None:
+        """One director per partition, resumed at the engine state's
+        recovered acked positions (reference ExporterDirectorService:
+        installed next to the stream processor). Synchronous mode: the
+        ``run_until_idle`` loop pumps directors to quiescence."""
+        from zeebe_tpu.exporter.director import (
+            fold_tail_acks,
+            remove_stale_positions,
+        )
+
+        if not self._exporter_specs:
+            # even with NO exporters configured the recovered positions of
+            # previously configured ones must be swept (REMOVE), or the
+            # last-removed exporter's stale entry pins the compaction
+            # floor forever
+            for partition in self.partitions:
+                stale = remove_stale_positions(
+                    fold_tail_acks(
+                        partition.engine.exporter_positions,
+                        partition.log,
+                        partition.next_read_position,
+                    ),
+                    (),
+                )
+                if stale:
+                    partition.log.append(stale)
+            return
+        from zeebe_tpu.exporter import ExporterDirector, build_exporter
+
+        ids = [
+            spec[0] if isinstance(spec, tuple) else spec.id
+            for spec in self._exporter_specs
+        ]
+        if len(set(ids)) != len(ids):
+            # two exporters on one id share one replicated position entry:
+            # the faster one's ack overwrites the slower one's progress
+            # and a restart silently skips the difference
+            raise ValueError(f"duplicate exporter ids in {ids}")
+        if len(self.partitions) > 1 and any(
+            isinstance(spec, tuple) for spec in self._exporter_specs
+        ):
+            # a shared instance would interleave partitions into one sink
+            # (and the JSONL dedup tail would silently DROP the lower
+            # partition's records); cfg entries build one instance per
+            # partition and are the only safe multi-partition shape
+            raise ValueError(
+                "exporter instance pairs cannot be shared across "
+                "multiple partitions — pass ExporterCfg entries instead"
+            )
+        for partition in self.partitions:
+            pairs = []
+            for spec in self._exporter_specs:
+                if isinstance(spec, tuple):
+                    pairs.append(spec)
+                else:
+                    pairs.append(build_exporter(spec))
+            director = ExporterDirector(
+                partition.partition_id,
+                partition.log,
+                pairs,
+                append_fn=lambda recs, p=partition: p.log.append(recs),
+                clock=self.clock,
+            )
+            director.open(fold_tail_acks(
+                partition.engine.exporter_positions,
+                partition.log,
+                partition.next_read_position,
+            ))
+            partition.exporter_director = director
+
+    def _pump_exporters(self) -> bool:
+        progress = False
+        for partition in self.partitions:
+            director = getattr(partition, "exporter_director", None)
+            if director is not None:
+                progress = director.pump() or progress
+        return progress
 
     def _replay(self, partition: Partition, last_source: int) -> None:
         # Reprocess only up to the last source event position — the highest
@@ -350,6 +437,10 @@ class Broker:
             # or commands, which the next pass processes
             if self._pump_topic_subscriptions():
                 progress = True
+            # exporters tail the freshly committed records; their position
+            # acks are records too and process on the next pass
+            if self._pump_exporters():
+                progress = True
         return processed
 
     def _process_one(self, partition: Partition, record: Record) -> None:
@@ -421,4 +512,6 @@ class Broker:
 
     def close(self) -> None:
         for partition in self.partitions:
+            if partition.exporter_director is not None:
+                partition.exporter_director.close()
             partition.log.storage.close()

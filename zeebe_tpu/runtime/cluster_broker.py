@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import zlib
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
@@ -46,7 +47,11 @@ from zeebe_tpu.protocol.records import Record
 from zeebe_tpu.runtime.actors import Actor, ActorFuture, ActorScheduler
 from zeebe_tpu.runtime.clock import SystemClock
 from zeebe_tpu.runtime.config import BrokerCfg
-from zeebe_tpu.runtime.metrics import MetricsFileWriter, MetricsRegistry
+from zeebe_tpu.runtime.metrics import (
+    MetricsFileWriter,
+    MetricsRegistry,
+    count_event,
+)
 from zeebe_tpu.transport import ClientTransport, RemoteAddress, ServerTransport
 
 logger = logging.getLogger(__name__)
@@ -137,6 +142,9 @@ class PartitionServer:
         # subscriber_key → topic-subscription pusher state (leader-local;
         # clients reopen on leader change and resume from logged acks)
         self.topic_pushers: Dict[int, dict] = {}
+        # exporter plane (leader-local like the stream processor; resumes
+        # from the replicated acked positions on any leader)
+        self.exporter_director = None
         self.is_leader = False
         self._processing_scheduled = False
         self._fetch_attempted = False  # one fetch try per parked record
@@ -179,6 +187,7 @@ class PartitionServer:
             self.engine.process(record)
             self.next_read_position = record.position + 1
         self.is_leader = True
+        self._install_exporters()
         self.broker.on_partition_leader(self.partition_id, term)
         if self.partition_id == 0:
             # topics caught mid-creation by the failover: resume
@@ -201,6 +210,91 @@ class PartitionServer:
         # surviving a leadership flap raced the new leader's pusher and
         # delivered records out of order (round-4 flake root cause)
         self.topic_pushers.clear()
+        # exporters likewise: close on step-down (the new leader's
+        # director resumes from the replicated acked positions)
+        if self.exporter_director is not None:
+            self.exporter_director.close()
+            self.exporter_director = None
+
+    def _install_exporters(self) -> None:
+        """Leader-only exporter plane (reference: the exporter stream
+        processor installs with leadership). Positions come from the
+        recovered engine state, so the new leader resumes the old leader's
+        progress without gaps; acks append through raft."""
+        if self.exporter_director is not None:
+            # re-election without an intervening step-down: replace the
+            # old install (its positions live in engine state, not in the
+            # director, so nothing is lost)
+            self.exporter_director.close()
+            self.exporter_director = None
+        if self.engine is None:
+            return
+        from zeebe_tpu.exporter import (
+            ExporterDirector,
+            ExporterDirectorActor,
+            build_exporter,
+        )
+        from zeebe_tpu.exporter.director import (
+            fold_tail_acks,
+            remove_stale_positions,
+        )
+
+        if not self.broker.cfg.exporters:
+            # no director to install, but recovered positions of
+            # previously configured exporters must still be swept
+            # (REMOVE) or the last-removed exporter's stale entry pins
+            # the compaction floor forever
+            try:
+                stale = remove_stale_positions(
+                    fold_tail_acks(
+                        self.engine.exporter_positions, self.log,
+                        self.next_read_position,
+                    ),
+                    (),
+                )
+                if stale:
+                    self.raft.append(stale)
+            except Exception as e:  # noqa: BLE001 - sweep must never
+                # wedge the leadership install; the pin merely persists
+                # until a later leader's sweep lands
+                logger.warning(
+                    "stale exporter-position sweep failed on partition "
+                    "%d (floor stays pinned until a later sweep): %r",
+                    self.partition_id, e,
+                )
+            return
+
+        # belt over the boot-time validation: an install failure must
+        # never wedge the leadership install (the partition would report
+        # itself leader but never process a record)
+        try:
+            pairs = [build_exporter(spec) for spec in self.broker.cfg.exporters]
+            director = ExporterDirector(
+                self.partition_id,
+                self.log,
+                pairs,
+                append_fn=self.raft.append,
+                clock=self.broker.clock,
+                node_label=self.broker.node_id,
+            )
+            director.open(fold_tail_acks(
+                self.engine.exporter_positions, self.log,
+                self.next_read_position,
+            ))
+            self.exporter_director = ExporterDirectorActor(
+                director, self.broker.scheduler
+            )
+        except Exception as e:  # noqa: BLE001 - exporters are isolated
+            self.exporter_director = None
+            count_event(
+                "exporter_install_failures",
+                "Leadership exporter installs that raised",
+            )
+            logger.error(
+                "exporter install failed on partition %d (partition keeps "
+                "processing WITHOUT exporters; compaction is not gated): %r",
+                self.partition_id, e,
+            )
 
     # -- the processing loop (StreamProcessorController hot loop) ----------
     def _schedule_processing(self) -> None:
@@ -310,6 +404,7 @@ class PartitionServer:
                     advanced = True
                     if record.metadata.value_type in (
                         ValueType.SUBSCRIBER, ValueType.SUBSCRIPTION,
+                        ValueType.EXPORTER,
                     ):
                         continue
                     if not pusher["push"](record):
@@ -369,6 +464,9 @@ class PartitionServer:
         self.raft.actor.run(lambda: self.log.compact(floor))
 
     def close(self) -> None:
+        if self.exporter_director is not None:
+            self.exporter_director.close()
+            self.exporter_director = None
         self.raft.close()
         self.storage.close()
 
@@ -390,6 +488,21 @@ class ClusterBroker(Actor):
     ):
         super().__init__(f"broker-{cfg.cluster.node_id}")
         self.cfg = cfg
+        # fail construction loudly on a misconfigured exporter (same
+        # contract as the in-process Broker): deferred to the leadership
+        # install, the error would fire inside an actor job and wedge the
+        # partition as a leader that never processes
+        if cfg.exporters:
+            from zeebe_tpu.exporter import build_exporter
+
+            seen_ids = set()
+            for spec in cfg.exporters:
+                if spec.id in seen_ids:
+                    # shared replicated position entry: the faster
+                    # exporter's ack would mask the slower one's gap
+                    raise ValueError(f"duplicate exporter id {spec.id!r}")
+                seen_ids.add(spec.id)
+                build_exporter(spec)
         self._engine_factory = engine_factory
         self.node_id = cfg.cluster.node_id
         self.data_dir = data_dir
@@ -459,7 +572,16 @@ class ClusterBroker(Actor):
         self._cmd_dedup: Dict[str, ActorFuture] = {}
         # partition id → in-flight device due-probe (see _tick_engines)
         self._due_probes: Dict[int, object] = {}
-        self._next_request_id = 0
+        # request ids are stamped INTO replicated records and responses
+        # are matched by id alone on whichever broker processes the
+        # record — so the id space must not collide across brokers (a
+        # failover can make broker B emit the response for a command
+        # broker A appended, and a sequential id starting at 0 on every
+        # broker then completes an UNRELATED pending request on B with
+        # it: a deploy response surfacing from create_instance). A random
+        # 47-bit base per broker incarnation makes overlap negligible
+        # and also covers ids replayed across a restart.
+        self._next_request_id = random.getrandbits(47)
         self._push_listeners: Dict[int, Callable[[int, Record], None]] = {}
         self._request_lock = threading.Lock()
         # bounded cache for chunked snapshot serving (avoids re-reading
@@ -1733,8 +1855,21 @@ class ClusterBroker(Actor):
         subscription command resend loop) — topology may lag an election."""
         server = self.partitions.get(target_partition)
         if server is not None and server.is_leader:
-            server.raft.append([record])  # local fast path
+            # local fast path — but raft.append reports "not leader"
+            # through the FUTURE, never by raising here. A stale
+            # is_leader (step-down racing this send) used to lose the
+            # command forever with the retry loop never started: a
+            # cross-partition subscription OPEN vanishing means the
+            # waiting instance never correlates
+            future = server.raft.append([record])
+            future.on_complete(lambda f: (
+                self._retry_subscription_send(target_partition, record)
+                if getattr(f, "_exception", None) is not None else None
+            ))
             return
+        self._retry_subscription_send(target_partition, record)
+
+    def _retry_subscription_send(self, target_partition: int, record: Record) -> None:
         request = msgpack.pack(
             {
                 "t": "subscription-cmd",
@@ -1748,11 +1883,16 @@ class ClusterBroker(Actor):
 
             deadline = _time.monotonic() + 30.0
             while _time.monotonic() < deadline and not self._closing:
-                # leadership may have landed here meanwhile
+                # leadership may have landed here meanwhile; join the
+                # append so a deposed leader's failure keeps retrying
+                # instead of silently dropping the command
                 local = self.partitions.get(target_partition)
                 if local is not None and local.is_leader:
-                    local.raft.append([record])
-                    return
+                    try:
+                        local.raft.append([record]).join(3)
+                        return
+                    except Exception:  # noqa: BLE001 - deposed mid-append
+                        pass
                 addr = self.topology.leader_subscription_address(target_partition)
                 if addr is not None:
                     try:
@@ -1764,6 +1904,15 @@ class ClusterBroker(Actor):
                     except Exception:  # noqa: BLE001 - retry through outages
                         pass
                 _time.sleep(0.1)
+            count_event(
+                "subscription_send_expired",
+                "Cross-partition subscription commands dropped after the "
+                "retry deadline (no leader accepted them)",
+            )
+            logger.error(
+                "cross-partition subscription command to partition %d "
+                "dropped after 30s of retries", target_partition,
+            )
 
         threading.Thread(target=retry_loop, daemon=True).start()
 

@@ -128,6 +128,19 @@ class TopicCfg:
 
 
 @dataclasses.dataclass
+class ExporterCfg:
+    """One ``[[exporters]]`` entry (reference: the exporters section of
+    zeebe.cfg.toml — id + className + per-exporter args). ``type`` is a
+    built-in name (``jsonl``, ``metrics``, ``memory``) or a
+    ``package.module:Class`` path; ``args`` passes through to
+    ``Exporter.configure`` verbatim."""
+
+    id: str = ""
+    type: str = ""
+    args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
 class BrokerCfg:
     network: NetworkCfg = dataclasses.field(default_factory=NetworkCfg)
     data: DataCfg = dataclasses.field(default_factory=DataCfg)
@@ -138,6 +151,7 @@ class BrokerCfg:
     raft: RaftCfg = dataclasses.field(default_factory=RaftCfg)
     engine: EngineCfg = dataclasses.field(default_factory=EngineCfg)
     topics: List[TopicCfg] = dataclasses.field(default_factory=list)
+    exporters: List[ExporterCfg] = dataclasses.field(default_factory=list)
 
 
 _SECTION_KEYS = {
@@ -219,6 +233,24 @@ def load_config(
                 topic = TopicCfg()
                 _apply_section(topic, entry, "topics")
                 cfg.topics.append(topic)
+            continue
+        if section == "exporters":
+            for entry in table:
+                exporter = ExporterCfg()
+                _apply_section(exporter, entry, "exporters")
+                if not exporter.id or not exporter.type:
+                    raise ValueError(
+                        "[[exporters]] entries need both 'id' and 'type'"
+                    )
+                if any(e.id == exporter.id for e in cfg.exporters):
+                    # two exporters sharing an id would share one
+                    # replicated position entry — the faster one's ack
+                    # overwrites the slower one's real progress and a
+                    # restart silently skips the difference
+                    raise ValueError(
+                        f"duplicate exporter id {exporter.id!r}"
+                    )
+                cfg.exporters.append(exporter)
             continue
         target_cls = _SECTION_KEYS.get(section)
         if target_cls is None:

@@ -12,6 +12,7 @@ scheduler internals.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -47,12 +48,74 @@ class Metric:
         return self._value
 
 
+class Histogram:
+    """A cumulative-bucket histogram with fixed labels (prometheus
+    `histogram` type: `_bucket{le=...}`, `_sum`, `_count` series). Used by
+    the metrics exporter for per-ValueType/intent export latencies."""
+
+    DEFAULT_BUCKETS = (1, 5, 10, 25, 50, 100, 250, 500, 1000, 5000, 30000)
+
+    __slots__ = ("name", "labels", "buckets", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(
+        self,
+        name: str,
+        labels: Tuple[Tuple[str, str], ...],
+        buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+    ):
+        self.name = name
+        self.labels = labels
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * len(self.buckets)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            for i, le in enumerate(self.buckets):
+                if value <= le:
+                    self._counts[i] += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def render(self, prefix: str, ts: int) -> List[str]:
+        base = ",".join(f'{k}="{v}"' for k, v in self.labels)
+        sep = "," if base else ""
+        lines = []
+        with self._lock:
+            # _counts are cumulative already (observe bumps every bucket
+            # whose bound covers the value) — prometheus `le` semantics
+            for le, n in zip(self.buckets, self._counts):
+                lines.append(
+                    f'{prefix}{self.name}_bucket{{{base}{sep}le="{le:g}"}} '
+                    f"{n} {ts}"
+                )
+            lines.append(
+                f'{prefix}{self.name}_bucket{{{base}{sep}le="+Inf"}} '
+                f"{self._count} {ts}"
+            )
+            suffix = f"{{{base}}}" if base else ""
+            lines.append(f"{prefix}{self.name}_sum{suffix} {self._sum:g} {ts}")
+            lines.append(f"{prefix}{self.name}_count{suffix} {self._count} {ts}")
+        return lines
+
+
 class MetricsRegistry:
     """Reference MetricsManager: allocate once, render many."""
 
     def __init__(self, prefix: str = "zb_"):
         self.prefix = prefix
         self._metrics: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Metric] = {}
+        self._histograms: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Histogram] = {}
         self._help: Dict[str, str] = {}
         self._lock = threading.Lock()
 
@@ -61,6 +124,35 @@ class MetricsRegistry:
 
     def gauge(self, name: str, help_text: str = "", **labels: str) -> Metric:
         return self._allocate(name, "gauge", help_text, labels)
+
+    def histogram(
+        self,
+        name: str,
+        help_text: str = "",
+        buckets: Tuple[float, ...] = Histogram.DEFAULT_BUCKETS,
+        **labels: str,
+    ) -> Histogram:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None:
+                h = Histogram(name, key[1], buckets)
+                self._histograms[key] = h
+            elif h.buckets != tuple(sorted(buckets)):
+                # allocate-once semantics: the first caller's buckets win
+                # for the process lifetime (observations already landed in
+                # them) — silently dropping a DIFFERENT buckets arg would
+                # let an operator believe a changed latency_buckets config
+                # took effect when it did not
+                logging.getLogger(__name__).warning(
+                    "histogram %r already allocated with buckets %s; "
+                    "ignoring different buckets %s (restart the process "
+                    "to change histogram buckets)",
+                    name, h.buckets, tuple(sorted(buckets)),
+                )
+            if help_text:
+                self._help[name] = help_text
+            return h
 
     def _allocate(self, name: str, kind: str, help_text: str, labels: Dict[str, str]) -> Metric:
         key = (name, tuple(sorted(labels.items())))
@@ -78,9 +170,12 @@ class MetricsRegistry:
         `name{label="v",...} value timestamp`)."""
         ts = now_ms if now_ms is not None else int(time.time() * 1000)
         by_name: Dict[str, List[Metric]] = {}
+        hists_by_name: Dict[str, List[Histogram]] = {}
         with self._lock:
             for metric in self._metrics.values():
                 by_name.setdefault(metric.name, []).append(metric)
+            for hist in self._histograms.values():
+                hists_by_name.setdefault(hist.name, []).append(hist)
         lines: List[str] = []
         for name in sorted(by_name):
             full = self.prefix + name
@@ -93,6 +188,13 @@ class MetricsRegistry:
                     lines.append(f"{full}{{{label_str}}} {metric.value:g} {ts}")
                 else:
                     lines.append(f"{full} {metric.value:g} {ts}")
+        for name in sorted(hists_by_name):
+            full = self.prefix + name
+            if name in self._help:
+                lines.append(f"# HELP {full} {self._help[name]}")
+            lines.append(f"# TYPE {full} histogram")
+            for hist in hists_by_name[name]:
+                lines.extend(hist.render(self.prefix, ts))
         return "\n".join(lines) + "\n"
 
 
@@ -104,12 +206,21 @@ class MetricsRegistry:
 # instead, merged into every /metrics dump and metrics-file flush via
 # ``render_with_global``. Names used today: raft_elections_started,
 # raft_elections_won, transport_reconnects, transport_pending_expired,
-# log_torn_tail_truncations, snapshot_salvage_events.
+# log_torn_tail_truncations, snapshot_salvage_events; exporter plane:
+# exporter_lag (gauge, per exporter/partition), exporter_records_exported,
+# exporter_export_failures, exporter_floor_stalls, exporter_open_failures,
+# exporter_skipped_compacted.
 GLOBAL_REGISTRY = MetricsRegistry()
 
 
 def global_counter(name: str, help_text: str = "", **labels: str) -> Metric:
     return GLOBAL_REGISTRY.counter(name, help_text, **labels)
+
+
+def global_gauge(name: str, help_text: str = "", **labels: str) -> Metric:
+    """Labeled process-global gauge (exporter lag per exporter/partition,
+    etc.) — merged into every /metrics dump via ``render_with_global``."""
+    return GLOBAL_REGISTRY.gauge(name, help_text, **labels)
 
 
 def count_event(name: str, help_text: str = "", delta: float = 1.0) -> None:

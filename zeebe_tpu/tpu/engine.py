@@ -217,6 +217,10 @@ class TpuPartitionEngine:
     def topic_sub_acks(self):
         return self._host.topic_sub_acks
 
+    @property
+    def exporter_positions(self):
+        return self._host.exporter_positions
+
     # -- deployment → graph recompile -------------------------------------
     def _recompile(self, extra_variables=None) -> None:
         """Split the deployed set: device-compatible workflows compile into
@@ -1684,8 +1688,15 @@ class TpuPartitionEngine:
     ) -> List[ProcessingResult]:
         results = [ProcessingResult() for _ in records]
         # Job-incident bookkeeping lives in the host engine (incident records
-        # are host-processed); mirror the oracle's _incident_on_job_event
-        # markers when the corresponding JOB events flow through the device.
+        # are host-processed); run the oracle's _incident_on_job_event for
+        # the corresponding JOB events flowing through the device. For
+        # FAILED-with-no-retries the HOST emits the follow-up — either the
+        # incident CREATE (stamped with the failure event's position) or,
+        # when the failure event was re-written by an incident RESOLVE
+        # (metadata.incident_key set), the RESOLVE_FAILED event. The
+        # kernel's own unconditional incident-CREATE emission for these
+        # rows is suppressed below (it cannot see the incident_key).
+        suppress_incident_create: set = set()
         for i, record in enumerate(records):
             md = record.metadata
             if int(md.value_type) != int(ValueType.JOB) or int(
@@ -1694,9 +1705,8 @@ class TpuPartitionEngine:
                 continue
             intent = int(md.intent)
             if intent == int(JI.FAILED) and record.value.retries <= 0:
-                # NON_PERSISTENT_INCIDENT marker; the device emits the
-                # incident CREATE command itself
-                self._host.incident_by_failed_job[record.key] = -2
+                self._host._incident_on_job_event(record, results[i])
+                suppress_incident_create.add(i)
             elif intent in (int(JI.RETRIES_UPDATED), int(JI.CANCELED)):
                 self._host._incident_on_job_event(record, results[i])
         # CREATE commands with unknown workflows are rejected host-side,
@@ -1754,7 +1764,10 @@ class TpuPartitionEngine:
             raise RuntimeError(
                 "device table overflow — raise TpuPartitionEngine capacity"
             )
-        self._emit_records(out, [positions[i] for i in live], results, live)
+        self._emit_records(
+            out, [positions[i] for i in live], results, live,
+            suppress_incident_create,
+        )
         return results
 
     def _next_wf_key_host(self) -> int:
@@ -1774,8 +1787,10 @@ class TpuPartitionEngine:
         src_positions: List[int],
         results: List[ProcessingResult],
         live_rows: List[int],
+        suppress_incident_create: "set | None" = None,
     ) -> None:
         from zeebe_tpu.protocol.intents import (
+            IncidentIntent,
             MessageSubscriptionIntent as MS,
             WorkflowInstanceSubscriptionIntent as WS,
         )
@@ -1811,6 +1826,32 @@ class TpuPartitionEngine:
                 record.source_record_position = -1
                 res.sends.append((int(o["wf"][r]), record))
                 continue
+            if (
+                rt == int(RecordType.COMMAND)
+                and vt == int(ValueType.INCIDENT)
+                and intent == int(IncidentIntent.CREATE)
+            ):
+                if (
+                    suppress_incident_create
+                    and 0 <= src < len(live_rows)
+                    and live_rows[src] in suppress_incident_create
+                ):
+                    # job incidents are host-emitted (see _process_device:
+                    # the host branches on metadata.incident_key, which
+                    # the kernel cannot see) — drop the kernel's copy
+                    continue
+                if (
+                    record.value is not None
+                    and record.value.failure_event_position < 0
+                ):
+                    # the oracle stamps the failing event's position into
+                    # the CREATE command (it re-reads that record on
+                    # RESOLVE and compaction pins it); the kernel only
+                    # ships an error code, but the failing event IS this
+                    # emission's source record
+                    record.value.failure_event_position = (
+                        record.source_record_position
+                    )
             res.written.append(record)
             if o["resp"][r] and int(o["req"][r]) >= 0:
                 res.responses.append(record)
