@@ -353,6 +353,18 @@ def run_serving_path(n_instances=2048, engine="tpu", threads=8,
             request_timeout_ms=300_000,
         )
         try:
+            from zeebe_tpu.runtime.metrics import GLOBAL_REGISTRY
+
+            def wave_snapshot():
+                c = GLOBAL_REGISTRY.counter
+                return {
+                    "waves": c("serving_waves_total").value,
+                    "records": c("serving_wave_records_total").value,
+                    "host_s": c("serving_host_seconds_total").value,
+                    "device_s": c("serving_device_seconds_total").value,
+                    "fsyncs": c("log_fsyncs").value,
+                }
+
             model = (
                 Bpmn.create_process("serve-bench")
                 .start_event()
@@ -361,17 +373,30 @@ def run_serving_path(n_instances=2048, engine="tpu", threads=8,
                 .done()
             )
             client.deploy_model(model)
-            done = []
+            # completion times keyed by workflow instance (end-to-end
+            # instance latency = create call → job completion push);
+            # condition-variable wakeups instead of 50ms polls — at sub-
+            # second instance times the fixed poll was a latency floor
+            done_cond = _threading.Condition()
+            done_at: dict = {}
+            completed = [0]
+
+            def on_job(pid, rec):
+                with done_cond:
+                    done_at[rec.value.headers.workflow_instance_key] = (
+                        _time.perf_counter()
+                    )
+                    completed[0] += 1
+                    done_cond.notify_all()
+                return {}
+
             worker = client.open_job_worker(
-                "payment-service",
-                lambda pid, rec: done.append(rec.key) or {},
-                credits=256,
+                "payment-service", on_job, credits=256,
             )
             # warm the kernel compile outside the timed window
             client.create_instance("serve-bench", payload={"w": 1})
-            t_w = _time.time() + 240
-            while _time.time() < t_w and not done:
-                _time.sleep(0.05)
+            with done_cond:
+                done_cond.wait_for(lambda: completed[0] > 0, timeout=240)
 
             # timed window excludes the warm-up instance and its records:
             # snapshot the log position and completed count at t0 and report
@@ -381,20 +406,26 @@ def run_serving_path(n_instances=2048, engine="tpu", threads=8,
             # the config reports whatever throughput the window sustained
             # (never an exception; round-4's serving config died with
             # 'request timed out' in a pump thread and reported nothing)
-            warm_done = len(done)
+            warm_done = completed[0]
             records_at_t0 = int(broker.partitions[0].log.next_position)
+            waves_at_t0 = wave_snapshot()
             duration = duration_sec or (90 if engine == "tpu" else 30)
             stop = _threading.Event()
             errors: list = []
             created = [0] * threads
+            starts: dict = {}
             t0 = _time.perf_counter()
 
             def pump(k):
                 for _ in range(n_instances // threads):
                     if stop.is_set():
                         return
+                    t_send = _time.perf_counter()
                     try:
-                        client.create_instance("serve-bench", payload={"k": k})
+                        rsp = client.create_instance(
+                            "serve-bench", payload={"k": k}
+                        )
+                        starts[rsp.value.workflow_instance_key] = t_send
                         created[k] += 1
                     except Exception as e:  # noqa: BLE001 - report, don't crash
                         errors.append(str(e)[:120])
@@ -413,21 +444,51 @@ def run_serving_path(n_instances=2048, engine="tpu", threads=8,
                 t.join(duration + 120)
             stopper.cancel()
             total = sum(created)
-            t_done = _time.time() + min(120, duration)
-            while _time.time() < t_done and len(done) - warm_done < total:
-                _time.sleep(0.05)
+            with done_cond:
+                done_cond.wait_for(
+                    lambda: completed[0] - warm_done >= total,
+                    timeout=min(120, duration),
+                )
             elapsed = _time.perf_counter() - t0
             worker.close()
             records = int(broker.partitions[0].log.next_position) - records_at_t0
+            waves_now = wave_snapshot()
+            d_waves = waves_now["waves"] - waves_at_t0["waves"]
+            d_recs = waves_now["records"] - waves_at_t0["records"]
+            host_s = waves_now["host_s"] - waves_at_t0["host_s"]
+            device_s = waves_now["device_s"] - waves_at_t0["device_s"]
+            latencies = sorted(
+                done_at[key] - t_send
+                for key, t_send in starts.items()
+                if key in done_at
+            )
+
+            def pct(p):
+                if not latencies:
+                    return None
+                idx = min(len(latencies) - 1, int(len(latencies) * p))
+                return round(latencies[idx] * 1000.0, 1)
+
             return {
                 "config": "serving-path-1-service-task",
                 "engine": engine,
                 "instances": total,
-                "completed_jobs": len(done) - warm_done,
+                "completed_jobs": completed[0] - warm_done,
                 "records": records,
                 "elapsed_sec": round(elapsed, 3),
                 "transitions_per_sec": round(records / max(elapsed, 1e-9), 1),
                 "instances_per_sec": round(total / max(elapsed, 1e-9), 1),
+                # end-to-end instance latency (create call → completion
+                # push) and the pipeline-health numbers that localize a
+                # serving regression without a profiler: mean records per
+                # engine dispatch, and where the wall time went
+                "p50_instance_latency_ms": pct(0.50),
+                "p99_instance_latency_ms": pct(0.99),
+                "mean_wave_fill": round(d_recs / d_waves, 2) if d_waves else 0.0,
+                "waves": int(d_waves),
+                "host_seconds": round(host_s, 3),
+                "device_seconds": round(device_s, 3),
+                "fsyncs": int(waves_now["fsyncs"] - waves_at_t0["fsyncs"]),
                 **({"errors": len(errors), "first_error": errors[0]}
                    if errors else {}),
             }

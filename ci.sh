@@ -40,6 +40,9 @@ python tools/exporter_smoke.py
 echo "== full test suite (tier-1; run './ci.sh slow' for the slow tier) =="
 python -m pytest tests/ -x -q -m "not slow" --ignore=tests/test_chaos.py --ignore=tests/test_exporters.py
 
+echo "== op-census budget gate (lowered step program gather/scatter) =="
+python tools/census_gate.py
+
 echo "== pallas ops + mega-pass parity (skips without a TPU) =="
 python benchmarks/pallas_ops_check.py
 

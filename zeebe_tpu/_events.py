@@ -13,8 +13,8 @@ import from inside ``log`` would re-enter that cycle half-built.
 from __future__ import annotations
 
 
-def count_event(name: str) -> None:
+def count_event(name: str, help_text: str = "", delta: float = 1.0) -> None:
     """Bump a process-global event counter (allocate-on-first-use)."""
     from zeebe_tpu.runtime.metrics import count_event as _impl
 
-    _impl(name)
+    _impl(name, help_text, delta)

@@ -311,6 +311,18 @@ class ProcessingResult:
     # job pushes to subscribers: (subscriber_key, record)
     pushes: List[Tuple[int, Record]] = dataclasses.field(default_factory=list)
 
+    @classmethod
+    def merged(cls, results) -> "ProcessingResult":
+        """Record-major merge of per-record results (every output channel;
+        the ONE place to extend when ProcessingResult grows a field)."""
+        out = cls()
+        for res in results:
+            out.written.extend(res.written)
+            out.responses.extend(res.responses)
+            out.sends.extend(res.sends)
+            out.pushes.extend(res.pushes)
+        return out
+
 
 def _record(
     record_type: RecordType,
@@ -533,21 +545,34 @@ class PartitionEngine:
         note: handlers fail deterministically (pure functions of record +
         state), so replay re-raises at the same point and reconverges on the
         same partial mutations; the skip is replay-stable."""
+        return ProcessingResult.merged(self.process_wave(records))
+
+    def process_wave(self, records: List[Record]) -> List[ProcessingResult]:
+        """One drained wave → PER-RECORD results (source-stamped). The
+        in-process broker applies each record's sends/appends in record
+        order, so a wave-drained log stays byte-identical to
+        record-at-a-time processing even when sends target the local
+        partition; the device engine overrides this with one SIMD dispatch
+        per wave. Failure containment is per record (see process_batch)."""
+        import time as _time
+
         from zeebe_tpu.protocol.records import stamp_source_positions
 
-        merged = ProcessingResult()
+        t0 = _time.perf_counter()
+        results: List[ProcessingResult] = []
         for record in records:
             try:
                 res = self.process(record)
             except Exception as e:  # noqa: BLE001 - poison-record isolation
-                self._contain_processing_failure(record, e, merged)
-                continue
-            stamp_source_positions(res.written, record.position)
-            merged.written.extend(res.written)
-            merged.responses.extend(res.responses)
-            merged.sends.extend(res.sends)
-            merged.pushes.extend(res.pushes)
-        return merged
+                res = ProcessingResult()
+                self._contain_processing_failure(record, e, res)
+            else:
+                stamp_source_positions(res.written, record.position)
+            results.append(res)
+        # (host_seconds, device_seconds) of the last wave — the serving
+        # metrics' time-split source; pure host engine ⇒ device share 0
+        self.last_wave_seconds = (_time.perf_counter() - t0, 0.0)
+        return results
 
     def _contain_processing_failure(
         self, record: Record, exc: Exception, merged: ProcessingResult

@@ -42,6 +42,29 @@ logger = logging.getLogger(__name__)
 _subscriber_keys = itertools.count(1_000)
 
 
+class _AdaptiveBackoff:
+    """Poll pacing for leader-discovery/await loops: start at 1ms and
+    double per miss up to ``cap``. The old fixed 50ms poll was both a
+    measurable tax and a latency floor at sub-second end-to-end instance
+    times; the 30ms default cap keeps the fast first probes while bounding
+    the refresh_topology RPC load these loops generate during a leaderless
+    window (each miss is a topology round trip — a 1-20ms steady rate
+    from many client threads would hammer exactly the brokers trying to
+    finish the election). ``reset()`` after any progress."""
+
+    def __init__(self, base: float = 0.001, cap: float = 0.03):
+        self.base = base
+        self.cap = cap
+        self._cur = base
+
+    def sleep(self) -> None:
+        time.sleep(self._cur)
+        self._cur = min(self.cap, self._cur * 2)
+
+    def reset(self) -> None:
+        self._cur = self.base
+
+
 class ClusterClient:
     """Client bound to a cluster via one or more bootstrap broker client
     addresses."""
@@ -160,17 +183,22 @@ class ClusterClient:
         # the pause cap scales with the deadline so the budget genuinely
         # spans it (fast NOT_LEADER churn must not burn 32 retries while a
         # 60s-deadline caller's new leader is seconds away); floor 0.5s
-        # keeps short-deadline clients responsive
+        # keeps short-deadline clients responsive. The FIRST retry pauses
+        # 5ms, not 50: a transiently-busy leader (drain in progress) is
+        # usually back within milliseconds, and the serving path pays this
+        # pause on every contended command.
         pause_cap = max(0.5, self.request_timeout_ms / 1000.0 / self.retry_budget)
 
         def pause():
-            time.sleep(min(pause_cap, 0.05 * (1 << min(failures, 6))))
+            time.sleep(min(pause_cap, 0.005 * (1 << min(failures, 10))))
 
+        leader_wait = _AdaptiveBackoff()
         while time.monotonic() < deadline and failures < self.retry_budget:
             addr = self._leader_for(partition)
             if addr is None:
-                time.sleep(0.05)
+                leader_wait.sleep()
                 continue
+            leader_wait.reset()
             remaining_ms = max(100, int((deadline - time.monotonic()) * 1000))
             timeout_ms = min(attempt_ms, remaining_ms)
             try:
@@ -361,10 +389,11 @@ class ClusterClient:
     # newResourceRequest served by the system partition leader) ------------
     def _repository_request(self, body: dict) -> dict:
         deadline = time.monotonic() + 10
+        backoff = _AdaptiveBackoff()
         while time.monotonic() < deadline:
             addr = self._leader_for(0)
             if addr is None:
-                time.sleep(0.05)
+                backoff.sleep()
                 continue
             try:
                 rsp = msgpack.unpack(
@@ -374,13 +403,13 @@ class ClusterClient:
             except (TransportError, ValueError, TimeoutError):
                 with self._lock:
                     self._leaders.pop(0, None)
-                time.sleep(0.05)
+                backoff.sleep()
                 continue
             if rsp.get("t") == "ok":
                 return rsp
             if rsp.get("code") == "NOT_FOUND":
                 raise ClientException(0, "workflow not found")
-            time.sleep(0.05)
+            backoff.sleep()
         raise TransportError("repository request failed")
 
     def list_workflows(self, bpmn_process_id: str = "") -> List[dict]:
@@ -514,10 +543,11 @@ class _JobSubscriptionBase:
             }
         )
         deadline = time.monotonic() + 10
+        backoff = _AdaptiveBackoff()
         while time.monotonic() < deadline:
             addr = self.client._leader_for(partition)
             if addr is None:
-                time.sleep(0.05)
+                backoff.sleep()
                 continue
             try:
                 payload = self.client.transport.send_request(
@@ -530,7 +560,7 @@ class _JobSubscriptionBase:
                 pass
             with self.client._lock:
                 self.client._leaders.pop(partition, None)
-            time.sleep(0.05)
+            backoff.sleep()
         raise TransportError(f"could not subscribe on partition {partition}")
 
     def _return_credit(self, partition: int, n: int = 1) -> None:
@@ -748,10 +778,11 @@ class RemoteTopicSubscriber:
             "force_start": force_start,
             "epoch": self._epoch,
         }
+        backoff = _AdaptiveBackoff()
         while time.monotonic() < deadline and not self._closed:
             if self._request(body):
                 return
-            time.sleep(0.05)
+            backoff.sleep()
         self._epoch = prev_epoch
         if not self._closed:
             raise TransportError(f"could not open topic subscription {self.name!r}")

@@ -297,11 +297,18 @@ class LogStream:
             self._segment_first_pos.setdefault(
                 self.storage.segment_of(address), records[0].position
             )
-        offset = 0
-        for record, frame in zip(records, frames):
-            if record.position % BLOCK_INDEX_DENSITY == 0:
-                self._block_index.append((record.position, address + offset))
-            offset += len(frame)
+            # sparse block index: walk the frame offsets only when the
+            # appended position range actually crosses a density boundary
+            # (group-committed batches are the append hot path)
+            first, last = records[0].position, records[-1].position
+            if (last // BLOCK_INDEX_DENSITY) * BLOCK_INDEX_DENSITY >= first:
+                offset = 0
+                for record, frame in zip(records, frames):
+                    if record.position % BLOCK_INDEX_DENSITY == 0:
+                        self._block_index.append(
+                            (record.position, address + offset)
+                        )
+                    offset += len(frame)
         if commit:
             self.set_commit_position(self._next_position - 1)
         return self._next_position - 1

@@ -255,6 +255,9 @@ class SegmentedLogStorage:
         if self._current_file is not None:
             self._current_file.flush()
             os.fsync(self._current_file.fileno())
+            # fsync count vs log_group_commit_coalesced = how well the
+            # group-commit plane amortizes the durability round trip
+            _count_event("log_fsyncs")
 
     def read(self, address: int, length: int) -> bytes:
         segment_id = self.segment_of(address)

@@ -413,12 +413,23 @@ class Broker:
         return pushed
 
     # -- processing loop ----------------------------------------------------
+    # committed records drain in WAVES: one engine dispatch per wave (the
+    # device engine's SIMD unit — per-record process() calls round-trip
+    # the device once per record), but results apply PER RECORD in log
+    # order, so the appended log is byte-identical to record-at-a-time
+    # processing (tests/test_serving_wave.py pins this). Set to 1 to force
+    # the record-at-a-time baseline.
+    wave_size = 256
+
     def run_until_idle(self, max_iterations: int = 100_000) -> int:
         """Process all partitions until no backlog remains. Returns the number
         of records processed (the StreamProcessorController hot loop,
         StreamProcessorController.java:296-399, run to quiescence)."""
+        from zeebe_tpu.runtime.metrics import observe_wave
+
         processed = 0
         progress = True
+        wave_cap = max(1, self.wave_size)
         while progress:
             progress = False
             for partition in self.partitions:
@@ -427,9 +438,16 @@ class Broker:
                     records = reader.read_committed()
                     if not records:
                         break
-                    for record in records:
-                        self._process_one(partition, record)
-                        processed += 1
+                    for start in range(0, len(records), wave_cap):
+                        wave = records[start : start + wave_cap]
+                        results = partition.engine.process_wave(wave)
+                        for record, result in zip(wave, results):
+                            self._apply_result(partition, record, result)
+                        processed += len(wave)
+                        host_s, device_s = getattr(
+                            partition.engine, "last_wave_seconds", (0.0, 0.0)
+                        )
+                        observe_wave(len(wave), wave_cap, host_s, device_s)
                         if processed > max_iterations:
                             raise RuntimeError("broker did not reach quiescence")
                     progress = True
@@ -443,8 +461,11 @@ class Broker:
                 progress = True
         return processed
 
-    def _process_one(self, partition: Partition, record: Record) -> None:
-        result = partition.engine.process(record)
+    def _apply_result(self, partition: Partition, record: Record, result) -> None:
+        """Apply one processed record's outputs — sends, follow-up appends,
+        responses, pushes — exactly as the per-record loop did (the engine
+        already processed the whole wave; application stays record-major
+        so the log bytes don't depend on the wave size)."""
         partition.next_read_position = record.position + 1
         for target_pid, send in result.sends:
             # reference: subscription transport → command on the target log.

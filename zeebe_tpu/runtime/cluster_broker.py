@@ -315,49 +315,111 @@ class PartitionServer:
             return
         reader = self.log.reader(self.next_read_position)
         batch: list = []
+        pending = None  # dispatched-but-uncollected wave (device engine)
         parked = False
-        for record in reader.read_committed():
-            if self._needs_workflow_fetch(record):
-                # a DEPLOYMENT earlier in this very drain may provide the
-                # workflow: process the collected prefix FIRST, then
-                # re-check before parking (the per-record loop got this
-                # ordering for free)
-                if batch:
-                    self._process_chunk(batch)
-                    batch = []
+        try:
+            for record in reader.read_committed():
                 if self._needs_workflow_fetch(record):
-                    # park processing; resume once the workflow arrives
-                    # from the system partition (reference WorkflowCache
-                    # async fetch — EventLifecycleContext.async
-                    # restructured as pause/resume)
-                    self.broker.fetch_workflow(
-                        record.value.bpmn_process_id,
-                        record.value.workflow_key,
-                        on_done=self._schedule_processing_after_fetch,
-                    )
-                    parked = True
-                    break
-            # the one-fetch-per-parked-record latch applies to the record
-            # it parked on, not to later records swept into this drain
-            self._fetch_attempted = False
-            batch.append(record)
-            if len(batch) >= self._DRAIN_BATCH:
-                self._process_chunk(batch)
-                batch = []
-        if batch:
-            self._process_chunk(batch)
+                    # a DEPLOYMENT earlier in this very drain may provide
+                    # the workflow: process the collected prefix FIRST,
+                    # then re-check before parking (the per-record loop got
+                    # this ordering for free)
+                    if batch:
+                        prev, pending = pending, self._dispatch_chunk(batch)
+                        batch = []
+                        if prev is not None:
+                            self._collect_chunk(prev)
+                    if pending is not None:
+                        self._collect_chunk(pending)
+                        pending = None
+                    if self._needs_workflow_fetch(record):
+                        # park processing; resume once the workflow arrives
+                        # from the system partition (reference WorkflowCache
+                        # async fetch — EventLifecycleContext.async
+                        # restructured as pause/resume)
+                        self.broker.fetch_workflow(
+                            record.value.bpmn_process_id,
+                            record.value.workflow_key,
+                            on_done=self._schedule_processing_after_fetch,
+                        )
+                        parked = True
+                        break
+                # the one-fetch-per-parked-record latch applies to the record
+                # it parked on, not to later records swept into this drain
+                self._fetch_attempted = False
+                batch.append(record)
+                if len(batch) >= self._DRAIN_BATCH:
+                    # the swap happens BEFORE collecting the previous wave,
+                    # so even if that collect raises, the just-dispatched
+                    # wave (whose records the cursor already passed) is
+                    # still collected by the finally below — never lost
+                    prev, pending = pending, self._dispatch_chunk(batch)
+                    batch = []
+                    if prev is not None:
+                        self._collect_chunk(prev)
+            if batch:
+                prev, pending = pending, self._dispatch_chunk(batch)
+                if prev is not None:
+                    self._collect_chunk(prev)
+        finally:
+            # the in-flight wave's responses/appends must land even when a
+            # dispatch or an earlier collect raises — its records are
+            # already consumed into engine state and will not re-drain
+            if pending is not None:
+                self._collect_chunk(pending)
         if parked:
             return
         self.pump_topic_subscriptions()
 
-    def _process_chunk(self, records: list) -> None:
-        # NOTE on granularity: the chunk is the retry unit. If the engine
-        # raises mid-chunk (an engine bug — processing is non-throwing by
-        # contract), the whole chunk reprocesses on the next drain, same
-        # at-least-once hazard the per-record loop had, with a chunk-sized
-        # blast radius.
-        result = self.engine.process_batch(records)
+    def _dispatch_chunk(self, records: list):
+        """Process one drained chunk. Engines with the wave pipeline
+        (``dispatch_wave``/``collect_wave`` — the device engine) only
+        DISPATCH here and return the pending wave; the caller collects the
+        PREVIOUS wave while the device computes this one (host staging/
+        readback of waves N+1/N−1 overlap device compute of wave N — JAX
+        async dispatch chains the state dependency on device). Synchronous
+        engines process + apply inline and return None.
+
+        NOTE on granularity: the chunk is the retry unit. If the engine
+        raises mid-chunk (an engine bug — processing is non-throwing by
+        contract), the whole chunk reprocesses on the next drain, same
+        at-least-once hazard the per-record loop had, with a chunk-sized
+        blast radius.
+        """
+        from zeebe_tpu.runtime.metrics import observe_wave
+
+        dispatch = getattr(self.engine, "dispatch_wave", None)
+        if dispatch is None:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            result = self.engine.process_batch(records)
+            self.next_read_position = records[-1].position + 1
+            self._apply_chunk(records, result)
+            observe_wave(
+                len(records), self._DRAIN_BATCH,
+                host_seconds=_time.perf_counter() - t0,
+            )
+            return None
+        wave = dispatch(records)
+        # advance at dispatch: the records are consumed into device state
         self.next_read_position = records[-1].position + 1
+        return wave
+
+    def _collect_chunk(self, wave) -> None:
+        """Materialize a dispatched wave's outputs and apply them (appends,
+        responses, sends, pushes) in log order."""
+        from zeebe_tpu.engine.interpreter import ProcessingResult
+        from zeebe_tpu.runtime.metrics import observe_wave
+
+        merged = ProcessingResult.merged(self.engine.collect_wave(wave))
+        self._apply_chunk(wave.records, merged)
+        observe_wave(
+            len(wave.records), self._DRAIN_BATCH,
+            wave.host_seconds, wave.device_seconds,
+        )
+
+    def _apply_chunk(self, records: list, result) -> None:
         if result.written:
             # every follow-up was source-stamped per record by the engine;
             # positions are assigned on the raft actor at append time, and

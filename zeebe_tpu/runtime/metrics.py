@@ -233,6 +233,73 @@ def event_count(name: str) -> float:
     return GLOBAL_REGISTRY.counter(name).value
 
 
+# -- serving-plane wave instrumentation --------------------------------------
+# The pipelined batched drain (runtime/broker.run_until_idle waves,
+# cluster_broker.PartitionServer._process_committed chunks) reports each
+# dispatched wave here: fill + occupancy gauges localize "the pipeline is
+# running empty" vs "the device is the bottleneck" without a profiler, and
+# the host/device second counters give the time split the serving bench
+# prints. Handles are cached — this sits on the drain hot loop.
+_WAVE_HANDLES: dict = {}
+
+
+def _wave_handles() -> dict:
+    if not _WAVE_HANDLES:
+        g = GLOBAL_REGISTRY
+        _WAVE_HANDLES.update(
+            waves=g.counter(
+                "serving_waves_total",
+                "Committed-record drain waves dispatched to the engine",
+            ),
+            records=g.counter(
+                "serving_wave_records_total",
+                "Committed records drained through waves",
+            ),
+            fill=g.gauge(
+                "serving_wave_fill", "Records in the most recent drain wave"
+            ),
+            fill_mean=g.gauge(
+                "serving_wave_fill_mean",
+                "Mean records per drain wave since process start",
+            ),
+            occupancy=g.gauge(
+                "serving_wave_occupancy",
+                "Most recent wave's fill fraction of the drain-chunk capacity",
+            ),
+            host_s=g.counter(
+                "serving_host_seconds_total",
+                "Serving-path host seconds (staging, host-routed records, "
+                "emission materialization)",
+            ),
+            device_s=g.counter(
+                "serving_device_seconds_total",
+                "Serving-path seconds blocked on device outputs",
+            ),
+        )
+    return _WAVE_HANDLES
+
+
+def observe_wave(
+    records: int,
+    capacity: int,
+    host_seconds: float = 0.0,
+    device_seconds: float = 0.0,
+) -> None:
+    """Record one committed-record drain wave (process-global; shows up on
+    every /metrics dump and metrics file via ``render_with_global``)."""
+    h = _wave_handles()
+    h["waves"].inc()
+    h["records"].inc(records)
+    h["fill"].set(records)
+    h["fill_mean"].set(h["records"].value / max(h["waves"].value, 1.0))
+    if capacity > 0:
+        h["occupancy"].set(records / capacity)
+    if host_seconds > 0:
+        h["host_s"].inc(host_seconds)
+    if device_seconds > 0:
+        h["device_s"].inc(device_seconds)
+
+
 def render_with_global(registry: MetricsRegistry, now_ms: Optional[int] = None) -> str:
     """A registry's Prometheus dump with the global event counters appended
     (skipped when the registry IS the global one — no duplicate series)."""

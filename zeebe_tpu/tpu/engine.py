@@ -137,6 +137,35 @@ def _pow2(n: int) -> int:
     return p
 
 
+@dataclasses.dataclass
+class _PendingSegment:
+    """One dispatched (not yet collected) device segment of a wave."""
+
+    results: List[ProcessingResult]
+    positions: List[int]
+    live: List[int]           # indices (into the segment's records) staged
+    suppress: set             # segment-record indices with host-emitted
+                              # job-incident follow-ups (kernel copy drops)
+    rows: List[int] = dataclasses.field(default_factory=list)
+    out: Optional[RecordBatch] = None   # device emission batch (unfetched)
+    stats: Optional[dict] = None        # device stats (unfetched)
+
+
+@dataclasses.dataclass
+class PendingWave:
+    """A wave in flight: dispatched to the device, results not yet
+    materialized. The serving loop double-buffers on this — stage/dispatch
+    wave N+1 and materialize wave N−1 while the device computes wave N
+    (JAX async dispatch carries the state dependency device-side)."""
+
+    records: List[Record]
+    per_record: List[Optional[ProcessingResult]]
+    segments: List[_PendingSegment] = dataclasses.field(default_factory=list)
+    host_seconds: float = 0.0    # staging + host-routed records + readback
+    device_seconds: float = 0.0  # blocked on device outputs at collect
+    collected: Optional[List[ProcessingResult]] = None  # one-shot cache
+
+
 class TpuPartitionEngine:
     """Batched device stream processor for one partition."""
 
@@ -1244,18 +1273,40 @@ class TpuPartitionEngine:
     # ------------------------------------------------------------------
     def process(self, record: Record) -> ProcessingResult:
         """Single-record convenience (tests); real throughput uses
-        process_batch."""
+        process_batch / the dispatch_wave+collect_wave pipeline."""
         return self.process_batch([record])
 
     def process_batch(self, records: List[Record]) -> ProcessingResult:
+        """Synchronous wave: dispatch + collect, merged record-major (the
+        cluster drain's non-pipelined entry)."""
+        return ProcessingResult.merged(self.process_wave(records))
+
+    def process_wave(self, records: List[Record]) -> List[ProcessingResult]:
+        """Per-record results of one wave (same contract as the host
+        oracle's process_wave; one device dispatch per contiguous device
+        segment)."""
+        return self.collect_wave(self.dispatch_wave(records))
+
+    def dispatch_wave(self, records: List[Record]) -> PendingWave:
+        """Stage + launch a wave WITHOUT reading device outputs back.
+        Host-routed records process inline (they mutate host state in
+        strict log order); device segments dispatch through the kernel and
+        stay pending until ``collect_wave``. The caller may dispatch the
+        next wave before collecting this one — the state dependency chains
+        on device, so host staging of wave N+1 overlaps device compute of
+        wave N."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         for record in records:
             # records_by_position aliases the host oracle's cache (one
             # shared dict) — a single write covers both readers
             self.records_by_position[record.position] = record
 
-        per_record: List[ProcessingResult] = [None] * len(records)
+        per_record: List[Optional[ProcessingResult]] = [None] * len(records)
+        wave = PendingWave(records=records, per_record=per_record)
         # segment processing: device rows batch up, but whenever a
-        # host-routed record appears the pending device segment FLUSHES
+        # host-routed record appears the pending device segment DISPATCHES
         # through the kernel first — state mutates in strict log order,
         # exactly like the oracle's per-record loop (a host record may
         # depend on state a preceding device record writes, e.g. a job
@@ -1291,12 +1342,12 @@ class TpuPartitionEngine:
             if not pending:
                 return
             push_host_keys()  # device allocations continue after the host's
-            results = self._process_device(
+            seg = self._dispatch_device(
                 [records[i] for i in pending],
                 [records[i].position for i in pending],
             )
-            for i, res in zip(pending, results):
-                per_record[i] = res
+            seg.rows = list(pending)
+            wave.segments.append(seg)
             self.device_records_processed += len(pending)
             pending.clear()
             self._device_keys_dirty = True
@@ -1389,21 +1440,41 @@ class TpuPartitionEngine:
                     host_allocated[0] = True
         flush()
         push_host_keys()
+        if records:
+            self.last_processed_position = records[-1].position
+        wave.host_seconds += _time.perf_counter() - t0
+        return wave
+
+    def collect_wave(self, wave: PendingWave) -> List[ProcessingResult]:
+        """Materialize a dispatched wave: one bulk device fetch per
+        segment, columnar emission decode, per-record source stamping.
+        Returns per-record results in log order (a record with no output
+        yields an empty result)."""
+        import time as _time
 
         from zeebe_tpu.protocol.records import stamp_source_positions
 
-        merged = ProcessingResult()
-        for i, res in enumerate(per_record):
-            if res is None:
-                continue
-            stamp_source_positions(res.written, records[i].position)
-            merged.written.extend(res.written)
-            merged.responses.extend(res.responses)
-            merged.sends.extend(res.sends)
-            merged.pushes.extend(res.pushes)
-        if records:
-            self.last_processed_position = records[-1].position
-        return merged
+        if wave.collected is not None:  # collection is one-shot
+            return wave.collected
+        t0 = _time.perf_counter()
+        device_s = 0.0
+        for seg in wave.segments:
+            device_s += self._collect_device(seg)
+            for i, res in zip(seg.rows, seg.results):
+                wave.per_record[i] = res
+        results: List[ProcessingResult] = []
+        for record, res in zip(wave.records, wave.per_record):
+            if res is None:  # poisoned host record: contained, no output
+                res = ProcessingResult()
+            stamp_source_positions(res.written, record.position)
+            results.append(res)
+        wave.device_seconds += device_s
+        wave.host_seconds += (_time.perf_counter() - t0) - device_s
+        # (host, device) seconds of the last collected wave — read by the
+        # brokers' wave metrics (same attribute as the host oracle's)
+        self.last_wave_seconds = (wave.host_seconds, wave.device_seconds)
+        wave.collected = results
+        return results
 
     def _pull_device_keys_into_host(self) -> None:
         """Advance the embedded oracle's key generators past the device
@@ -1483,6 +1554,22 @@ class TpuPartitionEngine:
     # -- host record → batch row -------------------------------------------
     _TPU_BATCH = 512  # one canonical staged shape on TPU (= drain chunk)
 
+    # dtype families for the packed host→device transfer: one bulk
+    # device_put per family (6 total) instead of one per column (24) —
+    # each transfer is a round trip over a tunneled chip
+    _I64_COLS = ("key", "instance_key", "scope_key", "req", "aux_key",
+                 "aux2_key", "deadline")
+    _I32_COLS = ("rtype", "vtype", "intent", "elem", "wf", "req_stream",
+                 "type_id", "retries", "worker", "src", "rej")
+    _BOOL_COLS = ("valid", "resp", "push")
+    _COL_DEFAULTS = {
+        "valid": False, "rtype": 0, "vtype": 0, "intent": 0, "key": -1,
+        "elem": -1, "wf": -1, "instance_key": -1, "scope_key": -1,
+        "req": -1, "req_stream": -1, "aux_key": -1, "aux2_key": -1,
+        "type_id": 0, "retries": 0, "deadline": -1, "worker": 0,
+        "src": -1, "resp": False, "push": False, "rej": 0,
+    }
+
     def _stage(self, records: List[Record], pad_to: int = 0) -> RecordBatch:
         n = len(records)
         # on TPU every batch pads to ONE canonical shape: invalid rows are
@@ -1494,35 +1581,49 @@ class TpuPartitionEngine:
             pad_to = max(pad_to, self._TPU_BATCH)
         size = max(_pow2(n), pad_to)
         v = self.num_vars
-        cols: Dict[str, np.ndarray] = {
-            "valid": np.zeros(size, bool),
-            "rtype": np.zeros(size, np.int32),
-            "vtype": np.zeros(size, np.int32),
-            "intent": np.zeros(size, np.int32),
-            "key": np.full(size, -1, np.int64),
-            "elem": np.full(size, -1, np.int32),
-            "wf": np.full(size, -1, np.int32),
-            "instance_key": np.full(size, -1, np.int64),
-            "scope_key": np.full(size, -1, np.int64),
-            "v_vt": np.zeros((size, v), np.int8),
-            "v_num": np.zeros((size, v), np.float32),
-            "v_str": np.zeros((size, v), np.int32),
-            "req": np.full(size, -1, np.int64),
-            "req_stream": np.full(size, -1, np.int32),
-            "aux_key": np.full(size, -1, np.int64),
-            "aux2_key": np.full(size, -1, np.int64),
-            "type_id": np.zeros(size, np.int32),
-            "retries": np.zeros(size, np.int32),
-            "deadline": np.full(size, -1, np.int64),
-            "worker": np.zeros(size, np.int32),
-            "src": np.full(size, -1, np.int32),
-            "resp": np.zeros(size, bool),
-            "push": np.zeros(size, bool),
-            "rej": np.zeros(size, np.int32),
+        # columnar fill: scalar columns are plain Python lists (C-speed
+        # setitem per row, ONE numpy conversion per column at pack time)
+        # — per-element numpy scalar writes were the measured host cost of
+        # staging a serving wave. Payload matrices stay numpy: their rows
+        # assign vectorized.
+        cols: Dict[str, object] = {
+            name: [default] * size
+            for name, default in self._COL_DEFAULTS.items()
         }
+        cols["v_vt"] = np.zeros((size, v), np.int8)
+        cols["v_num"] = np.zeros((size, v), np.float32)
+        cols["v_str"] = np.zeros((size, v), np.int32)
         for i, record in enumerate(records):
             self._stage_row(cols, i, record)
-        return RecordBatch(**{k: jnp.asarray(a) for k, a in cols.items()})
+        return self._pack_batch(cols, size)
+
+    def _pack_batch(self, cols: Dict[str, object], size: int) -> RecordBatch:
+        """Scalar columns → one matrix per dtype family → one device_put
+        each; the batch's per-column views are device slices (safe: the
+        step program donates only the state argument, never the batch)."""
+        i64 = np.empty((size, len(self._I64_COLS)), np.int64)
+        for j, name in enumerate(self._I64_COLS):
+            i64[:, j] = cols[name]
+        i32 = np.empty((size, len(self._I32_COLS)), np.int32)
+        for j, name in enumerate(self._I32_COLS):
+            i32[:, j] = cols[name]
+        bools = np.empty((size, len(self._BOOL_COLS)), bool)
+        for j, name in enumerate(self._BOOL_COLS):
+            bools[:, j] = cols[name]
+        i64_dev = jnp.asarray(i64)
+        i32_dev = jnp.asarray(i32)
+        bool_dev = jnp.asarray(bools)
+        kw: Dict[str, jax.Array] = {}
+        for j, name in enumerate(self._I64_COLS):
+            kw[name] = i64_dev[:, j]
+        for j, name in enumerate(self._I32_COLS):
+            kw[name] = i32_dev[:, j]
+        for j, name in enumerate(self._BOOL_COLS):
+            kw[name] = bool_dev[:, j]
+        kw["v_vt"] = jnp.asarray(cols["v_vt"])
+        kw["v_num"] = jnp.asarray(cols["v_num"])
+        kw["v_str"] = jnp.asarray(cols["v_str"])
+        return RecordBatch(**kw)
 
     def warm(self, sizes=(512,)) -> None:
         """Pre-compile the step program for the hot batch shapes BEFORE the
@@ -1683,9 +1784,12 @@ class TpuPartitionEngine:
         return self.repository.latest(value.bpmn_process_id)
 
     # -- device round -------------------------------------------------------
-    def _process_device(
+    def _dispatch_device(
         self, records: List[Record], positions: List[int]
-    ) -> List[ProcessingResult]:
+    ) -> _PendingSegment:
+        """Host pre-work + staging + kernel launch for one device segment;
+        returns the pending segment WITHOUT synchronizing on the device
+        (overflow check and emission fetch happen in ``_collect_device``)."""
         results = [ProcessingResult() for _ in records]
         # Job-incident bookkeeping lives in the host engine (incident records
         # are host-processed); run the oracle's _incident_on_job_event for
@@ -1740,9 +1844,15 @@ class TpuPartitionEngine:
                 results[i].responses.append(rejection)
                 rejected.add(i)
 
-        live = [i for i in range(len(records)) if i not in rejected]
+        seg = _PendingSegment(
+            results=results,
+            positions=positions,
+            live=[i for i in range(len(records)) if i not in rejected],
+            suppress=suppress_incident_create,
+        )
+        live = seg.live
         if not live:
-            return results
+            return seg
         batch = self._stage([records[i] for i in live])
         now = jnp.asarray(self.clock(), jnp.int64)
         # re-derive the fallback maps before the key window can wrap past
@@ -1760,15 +1870,37 @@ class TpuPartitionEngine:
             self.graph, self.state, batch, now,
             partition_id=jnp.asarray(self.partition_id, jnp.int32),
         )
-        if bool(stats["overflow"]):
+        seg.out = out
+        seg.stats = stats
+        return seg
+
+    def _collect_device(self, seg: _PendingSegment) -> float:
+        """Synchronize on one dispatched segment: overflow check + ONE
+        bulk device→host fetch of the whole emission batch, then columnar
+        decode into the segment's per-record results. Returns the seconds
+        spent blocked on the device (the host/device time-split metric)."""
+        import time as _time
+
+        if seg.out is None:
+            return 0.0
+        t0 = _time.perf_counter()
+        if bool(seg.stats["overflow"]):
             raise RuntimeError(
                 "device table overflow — raise TpuPartitionEngine capacity"
             )
+        o = jax.device_get(seg.out)
+        # collection is one-shot: clear the device refs BEFORE decoding so
+        # a re-collect of this wave (the drain's finally path after an
+        # exception elsewhere) can never append duplicate emissions into
+        # seg.results
+        seg.out = None
+        seg.stats = None
+        waited = _time.perf_counter() - t0
         self._emit_records(
-            out, [positions[i] for i in live], results, live,
-            suppress_incident_create,
+            o, [seg.positions[i] for i in seg.live], seg.results, seg.live,
+            seg.suppress,
         )
-        return results
+        return waited
 
     def _next_wf_key_host(self) -> int:
         """Allocate a workflow key host-side, keeping the device counter in
@@ -1789,6 +1921,10 @@ class TpuPartitionEngine:
         live_rows: List[int],
         suppress_incident_create: "set | None" = None,
     ) -> None:
+        """Decode one emission batch (``out``: np-array RecordBatch — the
+        caller's single bulk ``device_get``) into Record objects. Columnar:
+        scalar columns convert to Python lists ONCE (`.tolist()`); rows
+        materialize lazily from those lists only up to the valid count."""
         from zeebe_tpu.protocol.intents import (
             IncidentIntent,
             MessageSubscriptionIntent as MS,
@@ -1797,10 +1933,17 @@ class TpuPartitionEngine:
 
         o = {f.name: np.asarray(getattr(out, f.name)) for f in dataclasses.fields(out)}
         count = int(o["valid"].sum())
+        if not count:
+            return
+        # per-row int(np_scalar) dominated readback CPU at serving wave
+        # sizes; one C-level tolist per column replaces them all
+        cols = {
+            k: v[:count].tolist() for k, v in o.items() if v.ndim == 1
+        }
         names = self.meta.varspace.names
         for r in range(count):
-            src = int(o["src"][r])
-            record = self._materialize(o, r, names)
+            src = cols["src"][r]
+            record = self._materialize(o, cols, r, names)
             record.source_record_position = (
                 src_positions[src] if 0 <= src < len(src_positions) else -1
             )
@@ -1808,9 +1951,9 @@ class TpuPartitionEngine:
             # cross-partition subscription commands are SENDS, not appended
             # records — exactly the oracle's out.sends channel
             # (SubscriptionCommandSender.java:96-108)
-            vt = int(o["vtype"][r])
-            rt = int(o["rtype"][r])
-            intent = int(o["intent"][r])
+            vt = cols["vtype"][r]
+            rt = cols["rtype"][r]
+            intent = cols["intent"][r]
             if rt == int(RecordType.COMMAND) and vt == int(
                 ValueType.MESSAGE_SUBSCRIPTION
             ) and intent in (int(MS.OPEN), int(MS.CLOSE)):
@@ -1824,7 +1967,7 @@ class TpuPartitionEngine:
                 ValueType.WORKFLOW_INSTANCE_SUBSCRIPTION
             ) and intent == int(WS.CORRELATE):
                 record.source_record_position = -1
-                res.sends.append((int(o["wf"][r]), record))
+                res.sends.append((cols["wf"][r], record))
                 continue
             if (
                 rt == int(RecordType.COMMAND)
@@ -1853,18 +1996,20 @@ class TpuPartitionEngine:
                         record.source_record_position
                     )
             res.written.append(record)
-            if o["resp"][r] and int(o["req"][r]) >= 0:
+            if cols["resp"][r] and cols["req"][r] >= 0:
                 res.responses.append(record)
-            if o["push"][r]:
-                res.pushes.append((int(o["req_stream"][r]), record))
+            if cols["push"][r]:
+                res.pushes.append((cols["req_stream"][r], record))
 
-    def _materialize(self, o, r, names) -> Record:
-        vt = int(o["vtype"][r])
-        rt = int(o["rtype"][r])
-        intent = int(o["intent"][r])
-        rej = int(o["rej"][r])
-        wf_slot = int(o["wf"][r])
-        elem = int(o["elem"][r])
+    def _materialize(self, o, cols, r, names) -> Record:
+        """One emission row → Record. ``cols`` holds the scalar columns as
+        Python lists (see _emit_records); ``o`` the 2D payload matrices."""
+        vt = cols["vtype"][r]
+        rt = cols["rtype"][r]
+        intent = cols["intent"][r]
+        rej = cols["rej"][r]
+        wf_slot = cols["wf"][r]
+        elem = cols["elem"][r]
         payload = rb.columns_to_payload(
             o["v_vt"][r], o["v_num"][r], o["v_str"][r], names, self.interns
         )
@@ -1883,8 +2028,8 @@ class TpuPartitionEngine:
             record_type=RecordType(rt),
             value_type=ValueType(vt),
             intent=intent,
-            request_id=int(o["req"][r]),
-            request_stream_id=int(o["req_stream"][r]),
+            request_id=cols["req"][r],
+            request_stream_id=cols["req_stream"][r],
         )
         if rt == int(RecordType.COMMAND_REJECTION):
             md.rejection_type = (
@@ -1899,26 +2044,26 @@ class TpuPartitionEngine:
                 bpmn_process_id=workflow.id if workflow else "",
                 version=workflow.version if workflow else -1,
                 workflow_key=workflow.key if workflow else -1,
-                workflow_instance_key=int(o["instance_key"][r]),
+                workflow_instance_key=cols["instance_key"][r],
                 activity_id=elem_id,
                 payload=payload,
-                scope_instance_key=int(o["scope_key"][r]),
+                scope_instance_key=cols["scope_key"][r],
             )
         elif vt == int(ValueType.JOB):
             value = JobRecord(
-                type=self.interns.string(int(o["type_id"][r])) or "",
-                retries=int(o["retries"][r]),
-                deadline=int(o["deadline"][r]),
-                worker=self.interns.string(int(o["worker"][r])) or "",
+                type=self.interns.string(cols["type_id"][r]) or "",
+                retries=cols["retries"][r],
+                deadline=cols["deadline"][r],
+                worker=self.interns.string(cols["worker"][r]) or "",
                 payload=payload,
                 custom_headers=dict(element.job_headers) if element else {},
                 headers=JobHeaders(
-                    workflow_instance_key=int(o["instance_key"][r]),
+                    workflow_instance_key=cols["instance_key"][r],
                     bpmn_process_id=workflow.id if workflow else "",
                     workflow_definition_version=workflow.version if workflow else -1,
                     workflow_key=workflow.key if workflow else -1,
                     activity_id=elem_id,
-                    activity_instance_key=int(o["aux_key"][r]),
+                    activity_instance_key=cols["aux_key"][r],
                 ),
             )
         elif vt == int(ValueType.INCIDENT):
@@ -1927,30 +2072,30 @@ class TpuPartitionEngine:
                 error_type=int(error_type),
                 error_message=message,
                 bpmn_process_id=workflow.id if workflow else "",
-                workflow_instance_key=int(o["instance_key"][r]),
+                workflow_instance_key=cols["instance_key"][r],
                 activity_id=elem_id,
-                activity_instance_key=int(o["aux_key"][r]),
-                job_key=int(o["aux2_key"][r]),
+                activity_instance_key=cols["aux_key"][r],
+                job_key=cols["aux2_key"][r],
                 payload=payload,
             )
         elif vt == int(ValueType.TIMER):
             value = TimerRecord(
-                workflow_instance_key=int(o["instance_key"][r]),
-                activity_instance_key=int(o["aux_key"][r]),
-                due_date=int(o["deadline"][r]),
+                workflow_instance_key=cols["instance_key"][r],
+                activity_instance_key=cols["aux_key"][r],
+                due_date=cols["deadline"][r],
                 handler_element_id=elem_id,
             )
         elif vt == int(ValueType.MESSAGE):
             from zeebe_tpu.protocol.records import MessageRecord
 
             value = MessageRecord(
-                name=self.interns.string(int(o["type_id"][r])) or "",
+                name=self.interns.string(cols["type_id"][r]) or "",
                 correlation_key=self._corr_string(
-                    int(o["retries"][r]), int(o["worker"][r])
+                    cols["retries"][r], cols["worker"][r]
                 ),
-                time_to_live=max(int(o["deadline"][r]), 0),
+                time_to_live=max(cols["deadline"][r], 0),
                 payload=payload,
-                message_id=self.interns.string(int(o["aux2_key"][r])) or "",
+                message_id=self.interns.string(cols["aux2_key"][r]) or "",
             )
             if rt == int(RecordType.COMMAND_REJECTION) and rej == rb.REJ_MSG_DUP:
                 md.rejection_type = RejectionType.BAD_VALUE
@@ -1961,12 +2106,12 @@ class TpuPartitionEngine:
             from zeebe_tpu.protocol.records import MessageSubscriptionRecord
 
             value = MessageSubscriptionRecord(
-                workflow_instance_partition_id=int(o["wf"][r]),
-                workflow_instance_key=int(o["instance_key"][r]),
-                activity_instance_key=int(o["aux_key"][r]),
-                message_name=self.interns.string(int(o["type_id"][r])) or "",
+                workflow_instance_partition_id=cols["wf"][r],
+                workflow_instance_key=cols["instance_key"][r],
+                activity_instance_key=cols["aux_key"][r],
+                message_name=self.interns.string(cols["type_id"][r]) or "",
                 correlation_key=self._corr_string(
-                    int(o["retries"][r]), int(o["worker"][r])
+                    cols["retries"][r], cols["worker"][r]
                 ),
             )
         elif vt == int(ValueType.WORKFLOW_INSTANCE_SUBSCRIPTION):
@@ -1975,18 +2120,18 @@ class TpuPartitionEngine:
             )
 
             value = WorkflowInstanceSubscriptionRecord(
-                workflow_instance_key=int(o["instance_key"][r]),
-                activity_instance_key=int(o["aux_key"][r]),
-                message_name=self.interns.string(int(o["type_id"][r])) or "",
+                workflow_instance_key=cols["instance_key"][r],
+                activity_instance_key=cols["aux_key"][r],
+                message_name=self.interns.string(cols["type_id"][r]) or "",
                 payload=payload,
-                message_partition_id=int(o["aux2_key"][r]),
+                message_partition_id=cols["aux2_key"][r],
                 correlation_key=self._corr_string(
-                    int(o["retries"][r]), int(o["worker"][r])
+                    cols["retries"][r], cols["worker"][r]
                 ),
             )
         else:
             value = None
-        return Record(key=int(o["key"][r]), metadata=md, value=value)
+        return Record(key=cols["key"][r], metadata=md, value=value)
 
     def _corr_string(self, cvt: int, cbits: int) -> str:
         """Correlation columns → the oracle's string form (numeric keys

@@ -135,6 +135,31 @@ def _last_writer(slots, mask, size):
     return mask & (best[jnp.clip(tgt, 0, size)] == rank)
 
 
+def _first_per_key(keys, mask):
+    """[B] bool: row i is the FIRST masked row carrying its key (row order
+    = log order; intra-batch duplicate commands on one entity serialize
+    to first-wins, matching the oracle's sequential pop-then-no-op).
+    Small batches (the serving wave) use an O(B²) comparison triangle —
+    cheap, no extra gathers/scatters; large drive-loop batches switch to
+    a stable two-key sort to avoid the B² blowup."""
+    b = keys.shape[0]
+    if b <= 2048:
+        earlier_same = (
+            (keys[:, None] == keys[None, :])
+            & mask[None, :]
+            & jnp.tril(jnp.ones((b, b), bool), -1)
+        )
+        return ~jnp.any(earlier_same, axis=1)
+    idx = jnp.arange(b, dtype=jnp.int64)
+    # unmasked rows get unique sentinels so they never collide
+    k = jnp.where(mask, keys, jnp.int64(-1) - idx)
+    k_sorted, idx_sorted = jax.lax.sort((k, idx), num_keys=2)
+    first_sorted = jnp.concatenate(
+        [jnp.ones((1,), bool), k_sorted[1:] != k_sorted[:-1]]
+    )
+    return jnp.zeros((b,), bool).at[idx_sorted].set(first_sorted)
+
+
 def _indexed_lookup(index, key_col, fallback_map, keys, want, cap):
     """key → (found, slot) via the direct-mapped index with hashmap
     fallback; both paths verify against the table's own key column, so
@@ -446,7 +471,14 @@ def step_kernel(
     m_tcreate = timer_cmd & (it == int(TI.CREATE))
     ttrig_ok = timer_cmd & (it == int(TI.TRIGGER)) & tm_found
     ttrig_rej = timer_cmd & (it == int(TI.TRIGGER)) & ~tm_found
-    tcan_ok = timer_cmd & (it == int(TI.CANCEL)) & tm_found
+    # two CANCELs for ONE timer key legitimately share a batch (the engine
+    # emits a disarm cancel AND a terminate-catch-scan cancel for the same
+    # armed timer; under the wave drain both land in one step). The oracle
+    # pops the timer on the first and the second is a silent no-op —
+    # tm_found alone sees the PRE-step table and would emit CANCELED
+    # twice, so only the FIRST cancel row per key stays eligible.
+    m_tcancel = timer_cmd & (it == int(TI.CANCEL))
+    tcan_ok = m_tcancel & tm_found & _first_per_key(batch.key, m_tcancel)
     # timer trigger resumes the catch event when still active
     ttrig_inst = ttrig_ok & aik_found & (
         jnp.where(aik_found, aik_rows[:, EI_STATE], -1) == int(WI.ELEMENT_ACTIVATED)
