@@ -594,6 +594,177 @@ def run_device_config(build_fn, label, total_instances, wave, progress,
     }
 
 
+def run_message_ttl_storm(n_messages=8192, ttl_ms=30_000, batch=512):
+    """ROADMAP-item-5 scenario storm 1: message-TTL storm. Publish a burst
+    of short-TTL messages with no matching subscriptions, then advance the
+    clock and let the TTL sweep expire every one of them — "handles the
+    scenario" is measured (publish + expiry throughput, store drained to
+    empty), not asserted. The chaos sweep twin (crash mid-storm) lives in
+    tests/test_snapshot_delta.py::TestScenarioStorms."""
+    import tempfile
+    import time as _time
+
+    from zeebe_tpu.protocol.intents import MessageIntent
+    from zeebe_tpu.protocol.records import MessageRecord
+    from zeebe_tpu.runtime import Broker, ControlledClock
+
+    clock = ControlledClock(start_ms=1_000_000)
+    broker = Broker(
+        num_partitions=1,
+        data_dir=tempfile.mkdtemp(prefix="zb-bench-ttl-"),
+        clock=clock,
+    )
+    try:
+        engine = broker.partitions[0].engine
+        t0 = _time.perf_counter()
+        for start in range(0, n_messages, batch):
+            for i in range(start, min(start + batch, n_messages)):
+                broker.write_command(
+                    0,
+                    MessageRecord(
+                        name="storm-evt",
+                        correlation_key=f"corr-{i}",
+                        time_to_live=ttl_ms,
+                        payload={"i": i},
+                    ),
+                    MessageIntent.PUBLISH,
+                    with_response=False,
+                )
+            broker.run_until_idle()
+        publish_sec = _time.perf_counter() - t0
+        stored = len(engine.messages)
+        assert stored == n_messages, (stored, n_messages)
+
+        # expire the storm: logical time jumps past every deadline, the
+        # periodic sweep emits DELETEs, processing drains the store
+        t0 = _time.perf_counter()
+        clock.advance(ttl_ms + 1_000)
+        sweeps = 0
+        while engine.messages and sweeps < 64:
+            broker.tick()
+            broker.run_until_idle()
+            sweeps += 1
+        expire_sec = _time.perf_counter() - t0
+        assert not engine.messages, f"{len(engine.messages)} messages leaked"
+        records = len(broker.records(0))
+        return {
+            "config": "6-message-ttl-storm",
+            "engine": "host-oracle",
+            "messages": n_messages,
+            "records": records,
+            "publish_sec": round(publish_sec, 3),
+            "expire_sec": round(expire_sec, 3),
+            "publish_per_sec": round(n_messages / max(publish_sec, 1e-9), 1),
+            "expire_per_sec": round(n_messages / max(expire_sec, 1e-9), 1),
+            "transitions_per_sec": round(
+                records / max(publish_sec + expire_sec, 1e-9), 1
+            ),
+        }
+    finally:
+        broker.close()
+
+
+def run_incident_storm(n_instances=1024, batch=128):
+    """Scenario storm 2: incident create/resolve. Every instance raises a
+    CONDITION_ERROR incident (missing gateway variable); the storm then
+    resolves all of them via payload updates and completes every instance.
+    Measures create→incident and resolve→complete throughput. Chaos twin:
+    tests/test_snapshot_delta.py::TestScenarioStorms (crash under open
+    incidents)."""
+    import tempfile
+    import time as _time
+
+    from zeebe_tpu.gateway import JobWorker, ZeebeClient
+    from zeebe_tpu.models.bpmn.builder import Bpmn
+    from zeebe_tpu.protocol.enums import RecordType, ValueType
+    from zeebe_tpu.protocol.intents import (
+        IncidentIntent,
+        WorkflowInstanceIntent,
+    )
+    from zeebe_tpu.protocol.records import WorkflowInstanceRecord
+    from zeebe_tpu.runtime import Broker, ControlledClock
+
+    b = Bpmn.create_process("storm-flow").start_event("s").exclusive_gateway("split")
+    b.branch("$.orderValue >= 100").service_task(
+        "insured", type="insured-t").end_event("e1")
+    b.branch(default=True).service_task("plain", type="plain-t").end_event("e2")
+    model = b.done()
+
+    clock = ControlledClock(start_ms=1_000_000)
+    broker = Broker(
+        num_partitions=1,
+        data_dir=tempfile.mkdtemp(prefix="zb-bench-incident-"),
+        clock=clock,
+    )
+    try:
+        client = ZeebeClient(broker)
+        client.deploy_model(model)
+        completed = []
+        JobWorker(broker, "insured-t", lambda ctx: completed.append(1) or {})
+        JobWorker(broker, "plain-t", lambda ctx: completed.append(1) or {})
+
+        t0 = _time.perf_counter()
+        for start in range(0, n_instances, batch):
+            for _ in range(start, min(start + batch, n_instances)):
+                broker.write_command(
+                    0,
+                    WorkflowInstanceRecord(
+                        bpmn_process_id="storm-flow", payload={}
+                    ),
+                    WorkflowInstanceIntent.CREATE,
+                    with_response=False,
+                )
+            broker.run_until_idle()
+        create_sec = _time.perf_counter() - t0
+        incidents = [
+            r for r in broker.records(0)
+            if r.metadata.value_type == ValueType.INCIDENT
+            and r.metadata.record_type == RecordType.EVENT
+            and r.metadata.intent == int(IncidentIntent.CREATED)
+        ]
+        assert len(incidents) == n_instances, (len(incidents), n_instances)
+
+        t0 = _time.perf_counter()
+        for start in range(0, len(incidents), batch):
+            for inc in incidents[start:start + batch]:
+                broker.write_command(
+                    0,
+                    WorkflowInstanceRecord(
+                        workflow_instance_key=inc.value.workflow_instance_key,
+                        payload={"orderValue": 500},
+                    ),
+                    WorkflowInstanceIntent.UPDATE_PAYLOAD,
+                    key=inc.value.activity_instance_key,
+                    with_response=False,
+                )
+            broker.run_until_idle()
+        resolve_sec = _time.perf_counter() - t0
+        assert len(completed) == n_instances, (len(completed), n_instances)
+        resolved = sum(
+            1 for r in broker.records(0)
+            if r.metadata.value_type == ValueType.INCIDENT
+            and r.metadata.intent == int(IncidentIntent.RESOLVED)
+        )
+        assert resolved == n_instances, (resolved, n_instances)
+        records = len(broker.records(0))
+        return {
+            "config": "7-incident-storm",
+            "engine": "host-oracle",
+            "instances": n_instances,
+            "incidents": len(incidents),
+            "records": records,
+            "create_sec": round(create_sec, 3),
+            "resolve_sec": round(resolve_sec, 3),
+            "create_per_sec": round(n_instances / max(create_sec, 1e-9), 1),
+            "resolve_per_sec": round(n_instances / max(resolve_sec, 1e-9), 1),
+            "transitions_per_sec": round(
+                records / max(create_sec + resolve_sec, 1e-9), 1
+            ),
+        }
+    finally:
+        broker.close()
+
+
 def _probe_backend(timeout_sec=180):
     """Probe the accelerator in a SUBPROCESS with a hard timeout.
 
@@ -857,6 +1028,21 @@ def main():
                 lambda: run_serving_path(
                     n_instances=4096 if accel else 1024, engine="tpu",
                     threads=32,
+                ),
+            ),
+            # ROADMAP-item-5 scenario storms: message-TTL expiry sweep and
+            # incident create/resolve, measured (not asserted) — the chaos
+            # sweeps for the same scenarios run in tier-1/slow tests
+            (
+                "6-message-ttl-storm",
+                lambda: run_message_ttl_storm(
+                    n_messages=8192 if accel else 2048
+                ),
+            ),
+            (
+                "7-incident-storm",
+                lambda: run_incident_storm(
+                    n_instances=1024 if accel else 256
                 ),
             ),
         ]

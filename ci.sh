@@ -37,6 +37,9 @@ python -m pytest tests/test_exporters.py -q -m "not slow"
 echo "== JSONL exporter smoke (boot broker, run a workflow, replay audit) =="
 python tools/exporter_smoke.py
 
+echo "== state lifecycle smoke (delta takes, crash-restore, replay parity) =="
+python tools/state_smoke.py
+
 echo "== full test suite (tier-1; run './ci.sh slow' for the slow tier) =="
 python -m pytest tests/ -x -q -m "not slow" --ignore=tests/test_chaos.py --ignore=tests/test_exporters.py
 

@@ -18,3 +18,11 @@ def count_event(name: str, help_text: str = "", delta: float = 1.0) -> None:
     from zeebe_tpu.runtime.metrics import count_event as _impl
 
     _impl(name, help_text, delta)
+
+
+def set_gauge(name: str, value: float, help_text: str = "", **labels: str) -> None:
+    """Set a process-global gauge (allocate-on-first-use); same shim rules
+    as :func:`count_event` — merged into every /metrics dump."""
+    from zeebe_tpu.runtime.metrics import global_gauge
+
+    global_gauge(name, help_text, **labels).set(value)

@@ -205,10 +205,16 @@ class WorkflowRepository:
     def __init__(self):
         self.by_key: Dict[int, ExecutableWorkflow] = {}
         self.versions: Dict[str, List[ExecutableWorkflow]] = {}
+        # monotonic mutation counter: the repository is SHARED across
+        # partitions (and mutated by workflow fetches outside any record),
+        # so snapshot dirty tracking compares this instead of guessing
+        # from processed value types
+        self.version = 0
 
     def put(self, workflow: ExecutableWorkflow) -> None:
         self.by_key[workflow.key] = workflow
         self.versions.setdefault(workflow.id, []).append(workflow)
+        self.version += 1
 
     def next_version(self, process_id: str) -> int:
         return len(self.versions.get(process_id, [])) + 1
@@ -440,6 +446,12 @@ class PartitionEngine:
 
         self.last_processed_position = -1
 
+        # delta-snapshot dirty tracking: families ("h/<family>") mutated
+        # since the last snapshot_mark_clean(); None = tracking cold
+        # (everything assumed dirty — fresh or restored engine)
+        self._dirty_families: Optional[set] = None
+        self._repo_version_at_clean: Optional[int] = None
+
     # -- partition routing (reference SubscriptionCommandSender:96-108) ----
     def partition_for_correlation_key(self, correlation_key: str) -> int:
         return _correlation_hash(correlation_key) % self.num_partitions
@@ -447,6 +459,87 @@ class PartitionEngine:
     # -- snapshot support (reference: ComposedSnapshot of the processor's
     # state resources — ElementInstanceIndex SerializableWrapper, job RocksDB
     # checkpoint, incident/message maps; SURVEY.md §5 checkpoint/resume) ----
+
+    # Dirty-family tracking for delta snapshots: which state families
+    # (log/stateser.py HOST_FAMILIES, "h/" namespace) a record of a given
+    # value type may mutate. CONSERVATIVE supersets derived from the
+    # handler dispatch — over-marking merely re-encodes a clean family;
+    # under-marking would silently corrupt delta takes (the chaos
+    # delta-vs-full invariant is the regression net). "h/control" appears
+    # everywhere because every processed record advances
+    # last_processed_position; "h/workflows" is tracked separately via
+    # WorkflowRepository.version (the repository is shared and mutated by
+    # fetches outside record processing).
+    _VT_DIRTY_FAMILIES = {
+        int(ValueType.DEPLOYMENT): frozenset({"h/control"}),
+        int(ValueType.WORKFLOW_INSTANCE): frozenset(
+            {"h/instances", "h/incidents", "h/control"}),
+        int(ValueType.JOB): frozenset(
+            {"h/jobs", "h/instances", "h/incidents", "h/control"}),
+        # RESOLVE re-writes the failure event via _write_wi_followup,
+        # which mutates the element-instance index directly
+        int(ValueType.INCIDENT): frozenset(
+            {"h/incidents", "h/instances", "h/control"}),
+        int(ValueType.MESSAGE): frozenset({"h/messages", "h/control"}),
+        int(ValueType.MESSAGE_SUBSCRIPTION): frozenset(
+            {"h/messages", "h/control"}),
+        int(ValueType.WORKFLOW_INSTANCE_SUBSCRIPTION): frozenset(
+            {"h/instances", "h/messages", "h/control"}),
+        int(ValueType.TIMER): frozenset(
+            {"h/timers", "h/instances", "h/control"}),
+        int(ValueType.SUBSCRIBER): frozenset({"h/control"}),
+        int(ValueType.SUBSCRIPTION): frozenset({"h/control"}),
+        int(ValueType.EXPORTER): frozenset({"h/control"}),
+        int(ValueType.TOPIC): frozenset({"h/control"}),
+        int(ValueType.NOOP): frozenset({"h/control"}),
+        int(ValueType.RAFT): frozenset({"h/control"}),
+    }
+
+    def snapshot_dirty_families(self):
+        """Families mutated since the last ``snapshot_mark_clean`` (the
+        ``"h/<family>"`` names of log/stateser.HOST_FAMILIES), or None when
+        tracking is cold (fresh/restored engine) — the controller takes a
+        full snapshot then."""
+        if self._dirty_families is None:
+            return None
+        dirty = set(self._dirty_families)
+        if (
+            self._repo_version_at_clean is None
+            or self.repository.version != self._repo_version_at_clean
+        ):
+            dirty.add("h/workflows")
+        return frozenset(dirty)
+
+    def snapshot_mark_clean(self) -> None:
+        """Reset tracking at a capture fence: mutations from now on belong
+        to the NEXT snapshot."""
+        self._dirty_families = set()
+        self._repo_version_at_clean = self.repository.version
+
+    def snapshot_mark_dirty(self, families=None) -> None:
+        """Re-mark families dirty (None = everything) — used when a take
+        fails after its capture fence already reset the tracking."""
+        if families is None:
+            self._dirty_families = None
+            self._repo_version_at_clean = None
+            return
+        if "h/workflows" in families:
+            self._repo_version_at_clean = None
+        if self._dirty_families is not None:
+            self._dirty_families.update(families)
+
+    def _mark_dirty_for_record(self, value_type) -> None:
+        if self._dirty_families is None:
+            return
+        families = self._VT_DIRTY_FAMILIES.get(int(value_type))
+        if families is None:
+            # unknown value type: assume everything mutated (safety over
+            # delta efficiency)
+            self._dirty_families = None
+            self._repo_version_at_clean = None
+            return
+        self._dirty_families.update(families)
+
     def compaction_floor(self) -> int:
         """Highest log position below which records may be compacted away
         (exclusive). Open incidents re-read their failure event from the
@@ -472,10 +565,16 @@ class PartitionEngine:
             floor = min(floor, acked + 1)
         return floor
 
-    def snapshot_state(self) -> dict:
+    def snapshot_state(self, families=None) -> dict:
         """All log-derived state. Excludes transient client-session state
         (job subscriptions re-register after failover, as in the reference)
-        and the position→record cache (rebuilt from the log on recovery)."""
+        and the position→record cache (rebuilt from the log on recovery).
+
+        ``families`` (a dirty-family set from ``snapshot_dirty_families``)
+        is accepted for interface parity with the device engine, where a
+        partial capture skips device→host readback; host state is plain
+        references, so the dict is cheap either way — the delta filtering
+        happens at encode time (``stateser.encode_state_parts_delta``)."""
         return {
             "wf_keys": self.wf_keys,
             "job_keys": self.job_keys,
@@ -505,6 +604,8 @@ class PartitionEngine:
         }
 
     def restore_state(self, state: dict) -> None:
+        # a restored engine's tracking is cold: the next take is full
+        self.snapshot_mark_dirty(None)
         self.wf_keys = state["wf_keys"]
         self.job_keys = state["job_keys"]
         self.incident_keys = state["incident_keys"]
@@ -608,6 +709,7 @@ class PartitionEngine:
 
     def process(self, record: Record) -> ProcessingResult:
         self.records_by_position[record.position] = record
+        self._mark_dirty_for_record(record.metadata.value_type)
         out = ProcessingResult()
         vt = record.metadata.value_type
         rt = record.metadata.record_type
@@ -1866,6 +1968,9 @@ class PartitionEngine:
         a 10k-instance run converged at ~34% because returned credits
         never revisited the backlog)."""
         out: List[Record] = []
+        if self._dirty_families is not None and self._awaiting_jobs:
+            # drains the awaiting index and stamps activation deadlines
+            self._dirty_families.add("h/jobs")
         activatable = (
             int(JobIntent.CREATED), int(JobIntent.TIMED_OUT),
             int(JobIntent.FAILED), int(JobIntent.RETRIES_UPDATED),

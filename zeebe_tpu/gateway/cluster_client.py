@@ -290,6 +290,26 @@ class ClusterClient:
             partition_id, value, WorkflowInstanceIntent.CANCEL, key=workflow_instance_key
         )
 
+    def update_payload(
+        self,
+        partition_id: int,
+        workflow_instance_key: int,
+        payload: Dict[str, Any],
+        activity_instance_key: Optional[int] = None,
+    ) -> Record:
+        """Update the instance payload (same contract as the in-process
+        client: for incident resolution pass the failed token's key as
+        ``activity_instance_key`` — the reference client keys the command
+        by the activity instance event)."""
+        value = WorkflowInstanceRecord(
+            workflow_instance_key=workflow_instance_key, payload=dict(payload)
+        )
+        return self.send_command(
+            partition_id, value, WorkflowInstanceIntent.UPDATE_PAYLOAD,
+            key=activity_instance_key if activity_instance_key is not None
+            else workflow_instance_key,
+        )
+
     def publish_message(
         self,
         name: str,

@@ -28,9 +28,15 @@ import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
-from zeebe_tpu._events import count_event as _count_event
+from zeebe_tpu._events import count_event as _count_event, set_gauge as _set_gauge
 
 logger = logging.getLogger(__name__)
+
+
+class SnapshotPartError(Exception):
+    """A committed snapshot's parts cannot be read back (missing/corrupt
+    segment or manifest) — recovery skips the snapshot and tries an older
+    one."""
 
 _SNAPSHOT_DIR_RE = re.compile(r"^snapshot_(-?\d+)_(-?\d+)_(-?\d+)$")
 _STATE_FILE = "state.bin"
@@ -232,6 +238,28 @@ class SnapshotStorage:
         except OSError:
             return None
 
+    @staticmethod
+    def verify_segment(
+        h: str, compressed: bytes, length: int, exact: bool = True
+    ) -> Optional[bytes]:
+        """THE segment verification: bounded decompress + length +
+        content-hash check, shared by local reads, follower installs and
+        the replication fetch path (one implementation, so a future
+        hardening cannot miss a copy). Returns the decompressed bytes or
+        None; ``exact=False`` treats ``length`` as an upper bound."""
+        try:
+            d = zlib.decompressobj()
+            data = d.decompress(compressed, length + 1)
+            if d.unconsumed_tail or (
+                len(data) != length if exact else len(data) > length
+            ):
+                return None
+        except zlib.error:
+            return None
+        if part_hash(data) != h:
+            return None
+        return data
+
     def install_segment(
         self, h: str, compressed: bytes, max_len: int
     ) -> Optional[bytes]:
@@ -239,14 +267,8 @@ class SnapshotStorage:
         bytes (so the caller need not decompress again) or None on any
         violation. The content address makes the transfer self-verifying:
         the decompressed bytes must hash to ``h``."""
-        try:
-            d = zlib.decompressobj()
-            data = d.decompress(compressed, max_len + 1)
-            if d.unconsumed_tail or len(data) > max_len:
-                return None
-        except zlib.error:
-            return None
-        if part_hash(data) != h:
+        data = self.verify_segment(h, compressed, max_len, exact=False)
+        if data is None:
             return None
         self._write_segment(h, compressed)
         return data
@@ -268,8 +290,23 @@ class SnapshotStorage:
         """Commit a manifest snapshot; returns write-cost stats
         (``new_bytes`` is the incremental cost — bytes whose content hash
         was not already in the segment store)."""
+        return self.write_parts_delta(metadata, parts, [])[0]
+
+    def write_parts_delta(
+        self,
+        metadata: SnapshotMetadata,
+        parts: List[Tuple[str, bytes]],
+        reused: List[dict],
+    ) -> Tuple[Dict[str, int], List[dict]]:
+        """Commit a manifest snapshot from freshly encoded ``parts`` plus
+        ``reused`` manifest entries (``{"n","h","l"}``) carried over from a
+        previous take whose families did not change — those parts were
+        never re-read, re-encoded or re-hashed; their segments are already
+        in the store. Returns ``(stats, entries)`` with the committed
+        manifest entries (the next take's delta base)."""
         stats = {"total_bytes": 0, "new_bytes": 0,
-                 "parts": len(parts), "new_segments": 0}
+                 "parts": len(parts) + len(reused), "new_segments": 0,
+                 "reused_parts": len(reused)}
         entries = []
         for name, data in parts:
             h = part_hash(data)
@@ -279,8 +316,49 @@ class SnapshotStorage:
                 stats["new_bytes"] += len(data)
                 stats["new_segments"] += 1
             entries.append({"n": name, "h": h, "l": len(data)})
+        for e in reused:
+            stats["total_bytes"] += int(e["l"])
+            entries.append({"n": str(e["n"]), "h": str(e["h"]), "l": int(e["l"])})
+        # canonical manifest order: sorted by part name, which puts the
+        # "_root" part first ("_" < "a" < "h") — the streaming restore
+        # relies on reading the root before any family part, and a delta
+        # take's manifest is byte-identical to a full take's of the same
+        # state regardless of which families were re-encoded
+        entries.sort(key=lambda e: e["n"])
         self._commit_manifest(metadata, _pack_manifest(entries))
-        return stats
+        return stats, entries
+
+    def iter_parts(self, metadata: SnapshotMetadata):
+        """Stream a snapshot's ``(name, payload)`` parts in manifest order,
+        verifying each segment as it is read (one decompressed part in
+        memory at a time — the restore-side analogue of the wave pipeline's
+        per-family readback). Raises :class:`SnapshotPartError` on a
+        missing/corrupt manifest or segment; legacy single-blob snapshots
+        yield one ``("state", payload)`` part."""
+        path = os.path.join(self.root, metadata.dirname)
+        if os.path.exists(os.path.join(path, _STATE_FILE)):
+            payload = self.read(metadata)
+            if payload is None:
+                raise SnapshotPartError(f"{metadata.dirname}: corrupt state blob")
+            yield "state", payload
+            return
+        entries = self.manifest(metadata)
+        if entries is None:
+            raise SnapshotPartError(f"{metadata.dirname}: missing/corrupt manifest")
+        for e in entries:
+            name, h, length = str(e["n"]), str(e["h"]), int(e["l"])
+            compressed = self.read_segment(h)
+            if compressed is None:
+                raise SnapshotPartError(
+                    f"{metadata.dirname}: segment {h} of part {name!r} missing"
+                )
+            data = self.verify_segment(h, compressed, length)
+            if data is None:
+                raise SnapshotPartError(
+                    f"{metadata.dirname}: segment {h} of part {name!r} "
+                    "failed verification (corrupt/truncated/hash mismatch)"
+                )
+            yield name, data
 
     def _commit_manifest(self, metadata: SnapshotMetadata, manifest: bytes) -> None:
         """Atomic manifest commit: fsync'd tmp dir, rename = commit point."""
@@ -328,31 +406,10 @@ class SnapshotStorage:
     def read_parts(self, metadata: SnapshotMetadata) -> Optional[Dict[str, bytes]]:
         """Named part payloads of a snapshot (legacy single-blob snapshots
         come back as ``{"state": payload}``); None if missing/corrupt."""
-        path = os.path.join(self.root, metadata.dirname)
-        if os.path.exists(os.path.join(path, _STATE_FILE)):
-            payload = self.read(metadata)
-            return None if payload is None else {"state": payload}
-        entries = self.manifest(metadata)
-        if entries is None:
+        try:
+            return dict(self.iter_parts(metadata))
+        except SnapshotPartError:
             return None
-        out: Dict[str, bytes] = {}
-        for e in entries:
-            h = str(e["h"])
-            length = int(e["l"])
-            compressed = self.read_segment(h)
-            if compressed is None:
-                return None
-            try:
-                d = zlib.decompressobj()
-                data = d.decompress(compressed, length + 1)
-                if d.unconsumed_tail or len(data) != length:
-                    return None
-            except zlib.error:
-                return None
-            if part_hash(data) != h:
-                return None
-            out[str(e["n"])] = data
-        return out
 
     def gc_segments(self) -> int:
         """Delete segments referenced by no committed manifest (with a
@@ -416,6 +473,27 @@ def _unpack_manifest(raw: bytes) -> Optional[List[dict]]:
     return out
 
 
+@dataclasses.dataclass
+class PendingSnapshot:
+    """A fenced capture awaiting its (possibly off-thread) commit: the
+    dirty families' freshly encoded parts plus the previous manifest's
+    entries for the clean ones. Produced by ``SnapshotController.capture``
+    on the processing thread; ``commit`` does the hash/compress/fsync work
+    and may run anywhere (it touches only this object and the storage)."""
+
+    metadata: SnapshotMetadata
+    parts: List[Tuple[str, bytes]]
+    reused: List[dict]
+    # families captured (None = full take); on commit failure the caller
+    # re-marks these dirty so the next take re-captures them
+    dirty: Optional[frozenset]
+    capture_seconds: float = 0.0
+    # set by cluster callers at capture time (engine state is unsafe to
+    # read off-actor)
+    compaction_floor: Optional[int] = None
+    engine: Any = None
+
+
 class SnapshotController:
     """Takes/recovers engine-state snapshots for one stream processor.
 
@@ -423,6 +501,13 @@ class SnapshotController:
     ``restore_state(obj)`` (the engine's analogue of the reference's
     ``SnapshotSupport`` composition: ComposedSnapshot over ZbMapSnapshotSupport
     / SerializableWrapper, FsSnapshotController.java).
+
+    Engines that track dirty state families (``snapshot_dirty_families`` /
+    ``snapshot_mark_clean`` / ``snapshot_mark_dirty``) get DELTA takes:
+    ``capture`` encodes only dirty families and reuses the previous
+    manifest's entries for clean ones — no device→host readback, no
+    re-encode, no re-hash for unchanged state. The first take of a
+    controller incarnation is always full (no delta base yet).
 
     Payloads are encoded with the explicit data-only codec
     (``zeebe_tpu.log.stateser``), never pickle: snapshots are fetched from
@@ -434,14 +519,137 @@ class SnapshotController:
     def __init__(self, storage: SnapshotStorage):
         self.storage = storage
         # write-cost stats of the last take(): {"total_bytes", "new_bytes",
-        # "parts", "new_segments"} — new_bytes is the incremental cost
+        # "parts", "new_segments", "reused_parts"} — new_bytes is the
+        # incremental cost
         self.last_take_stats: Optional[Dict[str, int]] = None
+        # name → manifest entry of the newest take committed by THIS
+        # controller incarnation; the delta base. None forces a full take
+        # (fresh boot, failed commit, or legacy-layout predecessor).
+        self._delta_base: Optional[Dict[str, dict]] = None
 
     def take(self, state: Any, metadata: SnapshotMetadata) -> None:
+        """Full take from an already-materialized state (legacy entry;
+        engines with dirty tracking go through take_engine/capture)."""
         from zeebe_tpu.log import stateser
 
         parts = stateser.encode_state_parts(state)
-        self.last_take_stats = self.storage.write_parts(metadata, parts)
+        stats, entries = self.storage.write_parts_delta(metadata, parts, [])
+        self._finish_take(metadata, stats, entries)
+
+    def take_engine(self, engine: Any, metadata: SnapshotMetadata) -> Dict[str, int]:
+        """Capture + commit in one call (single-threaded brokers). Cluster
+        brokers split the two so commit runs off the partition actor."""
+        pending = self.capture(engine, metadata)
+        try:
+            return self.commit(pending)
+        except BaseException:
+            remark = getattr(engine, "snapshot_mark_dirty", None)
+            if remark is not None:
+                remark(pending.dirty)
+            raise
+
+    # -- capture (on the processing thread, at a wave boundary) ------------
+    def capture(self, engine: Any, metadata: SnapshotMetadata) -> PendingSnapshot:
+        """Fenced capture: grab + encode ONLY the dirty state families
+        (full state when the engine has no tracking or no delta base
+        exists). Resets the engine's dirty tracking — mutations from the
+        moment capture returns belong to the next take. The pause this
+        imposes on serving is the capture time, reported as the
+        ``snapshot_capture_pause_seconds`` gauge; the expensive
+        hash/compress/fsync work happens in :meth:`commit`."""
+        from zeebe_tpu.log import stateser
+
+        t0 = time.perf_counter()
+        dirty = None
+        if self._delta_base is not None:
+            dirty = getattr(engine, "snapshot_dirty_families", lambda: None)()
+        reused: List[dict] = []
+        if dirty is not None:
+            reusable = self._reusable_entries(dirty)
+            if reusable is None:
+                dirty = None  # base segment vanished: full take
+            else:
+                reused = reusable
+        parts: List[Tuple[str, bytes]] = []
+        if dirty is not None:
+            state = engine.snapshot_state(families=dirty)
+            parts, clean = stateser.encode_state_parts_delta(state, dirty)
+            if set(clean) != {e["n"] for e in reused}:
+                # part layout drifted from the delta base (should not
+                # happen mid-run) — take a full snapshot instead
+                dirty = None
+                reused = []
+        if dirty is None:
+            state = engine.snapshot_state()
+            parts = stateser.encode_state_parts(state)
+        mark_clean = getattr(engine, "snapshot_mark_clean", None)
+        if mark_clean is not None:
+            mark_clean()  # the capture fence: later mutations → next take
+        capture_seconds = time.perf_counter() - t0
+        _set_gauge(
+            "snapshot_capture_pause_seconds", capture_seconds,
+            "Serving pause imposed by the last snapshot capture (encode of "
+            "dirty families only; commit runs off the serving path)",
+        )
+        return PendingSnapshot(
+            metadata=metadata, parts=parts, reused=reused,
+            dirty=dirty, capture_seconds=capture_seconds, engine=engine,
+        )
+
+    def _reusable_entries(self, dirty: frozenset) -> Optional[List[dict]]:
+        """Delta-base entries of clean families, verified present in the
+        segment store; None when any is gone (forces a full take)."""
+        from zeebe_tpu.log import stateser
+
+        out: List[dict] = []
+        for name, e in self._delta_base.items():
+            family = stateser.part_family(name)
+            if family is None or family in dirty:
+                continue  # re-encoded on every take / captured as dirty
+            if not self.storage.has_segment(str(e["h"])):
+                return None
+            out.append({"n": name, "h": str(e["h"]), "l": int(e["l"])})
+        return out
+
+    # -- commit (anywhere; touches only the pending capture + storage) -----
+    def commit(self, pending: PendingSnapshot) -> Dict[str, int]:
+        t0 = time.perf_counter()
+        try:
+            stats, entries = self.storage.write_parts_delta(
+                pending.metadata, pending.parts, pending.reused
+            )
+        except BaseException:
+            # on-disk state unknown: never build a delta on it
+            self._delta_base = None
+            raise
+        self._finish_take(pending.metadata, stats, entries)
+        _count_event(
+            "snapshot_delta_takes" if pending.dirty is not None
+            else "snapshot_full_takes",
+        )
+        _set_gauge(
+            "snapshot_take_seconds",
+            pending.capture_seconds + (time.perf_counter() - t0),
+            "Duration of the last snapshot take (capture + commit)",
+        )
+        return stats
+
+    def _finish_take(
+        self, metadata: SnapshotMetadata, stats: Dict[str, int], entries: List[dict]
+    ) -> None:
+        self._delta_base = {
+            str(e["n"]): {"h": str(e["h"]), "l": int(e["l"])} for e in entries
+        }
+        self.last_take_stats = stats
+        _set_gauge(
+            "snapshot_last_new_bytes", stats["new_bytes"],
+            "Bytes of the last take not already in the segment store (the "
+            "delta cost)",
+        )
+        _set_gauge(
+            "snapshot_last_total_bytes", stats["total_bytes"],
+            "Total uncompressed state bytes referenced by the last take",
+        )
         self.storage.purge_older_than(metadata)
 
     def recover(self, log_last_position: int):
@@ -450,18 +658,41 @@ class SnapshotController:
         Returns (state, metadata) or (None, None). Invalid/corrupt/
         unparseable snapshots are skipped (and the next older one is tried),
         mirroring ``StateSnapshotController.recover`` trying metadata
-        candidates.
-        """
+        candidates — each skip logs a warning naming the snapshot and
+        counts into ``snapshot_recover_skipped``: every skip moves recovery
+        one snapshot closer to a full-log replay, and operators should see
+        that drift. Parts stream per family (one decompressed part in
+        memory at a time) and the decode time reports as the
+        ``snapshot_restore_seconds`` gauge."""
         from zeebe_tpu.log import stateser
 
+        t0 = time.perf_counter()
         for meta in self.storage.list():
             if meta.last_written_position > log_last_position:
+                self._skip(meta, f"written position past log end {log_last_position}")
                 continue  # log was truncated past this snapshot: stale
-            parts = self.storage.read_parts(meta)
-            if parts is None:
-                continue
             try:
-                return stateser.decode_state_parts(parts), meta
-            except stateser.SnapshotFormatError:
+                state = stateser.decode_state_parts_stream(
+                    self.storage.iter_parts(meta)
+                )
+            except (SnapshotPartError, stateser.SnapshotFormatError) as e:
+                self._skip(meta, str(e))
                 continue
+            _set_gauge(
+                "snapshot_restore_seconds", time.perf_counter() - t0,
+                "Duration of the last snapshot recovery (read + streamed "
+                "per-family decode; excludes log replay)",
+            )
+            return state, meta
         return None, None
+
+    def _skip(self, meta: SnapshotMetadata, reason: str) -> None:
+        logger.warning(
+            "recovery in %s skipped snapshot %s (%s); falling back to an "
+            "older snapshot or full-log replay",
+            self.storage.root, meta.dirname, reason,
+        )
+        _count_event(
+            "snapshot_recover_skipped",
+            "Snapshots skipped during recovery (stale/corrupt/unreadable)",
+        )

@@ -23,7 +23,7 @@ RocksDB checkpoints as raw byte streams.
 from __future__ import annotations
 
 import zlib
-from typing import Any, Dict, List
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
@@ -204,68 +204,138 @@ def _dec_workflows(items: List[Any]):
     return out
 
 
+# Per-key encoders: each top-level key of the host snapshot doc has one
+# explicit encoder so a DELTA take can encode a single state family
+# without walking the clean ones (the family split below groups keys the
+# engine dirties together).
+_HOST_KEY_ENCODERS: Dict[str, Any] = {
+    "wf_keys": lambda s: _enc_keygen(s["wf_keys"]),
+    "job_keys": lambda s: _enc_keygen(s["job_keys"]),
+    "incident_keys": lambda s: _enc_keygen(s["incident_keys"]),
+    "deployment_keys": lambda s: _enc_keygen(s["deployment_keys"]),
+    "element_instances": lambda s: _enc_instances(s["element_instances"]),
+    "jobs": lambda s: {
+        k: {"s": js.state, "d": js.deadline, "r": js.record.to_document()}
+        for k, js in s["jobs"].items()
+    },
+    "incidents": lambda s: {
+        k: {"s": i.state, "ie": i.incident_event_position,
+            "fe": i.failure_event_position}
+        for k, i in s["incidents"].items()
+    },
+    "incident_by_activity": lambda s: dict(s["incident_by_activity"]),
+    "incident_by_failed_job": lambda s: dict(s["incident_by_failed_job"]),
+    "resolving_events": lambda s: dict(s["resolving_events"]),
+    "incident_records": lambda s: {
+        k: r.to_document() for k, r in s["incident_records"].items()
+    },
+    "messages": lambda s: {
+        k: {"k": m.key, "n": m.name, "c": m.correlation_key,
+            "ttl": m.time_to_live, "p": m.payload, "id": m.message_id,
+            "dl": m.deadline}
+        for k, m in s["messages"].items()
+    },
+    "message_subscriptions": lambda s: [
+        {"n": sub.message_name, "c": sub.correlation_key,
+         "pp": sub.workflow_instance_partition_id,
+         "wk": sub.workflow_instance_key, "ak": sub.activity_instance_key}
+        for sub in s["message_subscriptions"]
+    ],
+    "timers": lambda s: {
+        k: {"d": t.due_date, "a": t.activity_instance_key,
+            "r": t.record.to_document()}
+        for k, t in s["timers"].items()
+    },
+    "pending_boundary": lambda s: {
+        k: [bid, dict(payload)]
+        for k, (bid, payload) in s.get("pending_boundary", {}).items()
+    },
+    # jobs that became activatable during a credit drought (the
+    # engine's _awaiting_jobs backlog index, Dict[type, ordered key
+    # set]); dropping it strands drought-backlogged jobs on a
+    # snapshot-restored leader — backlog_activations would never
+    # revisit them
+    "awaiting_jobs": lambda s: {
+        job_type: list(keys)
+        for job_type, keys in s.get("awaiting_jobs", {}).items()
+    },
+    "topic_sub_acks": lambda s: dict(s["topic_sub_acks"]),
+    # per-exporter acked positions; absent in pre-exporter snapshots
+    "exporter_positions": lambda s: dict(s.get("exporter_positions", {})),
+    "topics": lambda s: {k: dict(v) for k, v in s["topics"].items()},
+    "next_partition_id": lambda s: s["next_partition_id"],
+    "last_processed_position": lambda s: s["last_processed_position"],
+    "workflows": lambda s: _enc_workflows(s["workflows"]),
+}
+
+# Host state families: the unit of dirty tracking and of per-part delta
+# encoding. Each family becomes its own snapshot part ("h/<family>"), so a
+# take re-encodes and re-hashes only families the engine marked dirty.
+# "control" is small and includes last_processed_position, so it is dirty
+# on effectively every take; the bulk families (instances, jobs, messages)
+# only pay when their state actually changed.
+HOST_FAMILIES: Dict[str, Tuple[str, ...]] = {
+    "workflows": ("workflows",),
+    "instances": ("element_instances", "pending_boundary"),
+    "jobs": ("jobs", "awaiting_jobs"),
+    "incidents": ("incidents", "incident_by_activity",
+                  "incident_by_failed_job", "resolving_events",
+                  "incident_records"),
+    "messages": ("messages", "message_subscriptions"),
+    "timers": ("timers",),
+    "control": ("wf_keys", "job_keys", "incident_keys", "deployment_keys",
+                "topic_sub_acks", "exporter_positions", "topics",
+                "next_partition_id", "last_processed_position"),
+}
+
+# Device SoA arrays group into dtype/table families (the wave staging
+# transfer unit); clean families skip the device→host readback entirely.
+_DEVICE_FAMILY_PREFIXES: Tuple[Tuple[str, str], ...] = (
+    ("free_ei", "ei"), ("free_job", "job"), ("ei", "ei"), ("job", "job"),
+    ("join", "join"), ("timer", "timer"), ("msub", "msub"), ("msg", "msg"),
+    ("sub", "sub"), ("next", "keys"),
+)
+
+DEVICE_ARRAY_FAMILIES = tuple(sorted({f for _, f in _DEVICE_FAMILY_PREFIXES}))
+
+
+def device_array_family(field: str) -> str:
+    """Dirty-tracking family of a device state field (or hashtable
+    ``<field>.keys``/``.vals`` part name)."""
+    base = field.split(".", 1)[0]
+    for prefix, family in _DEVICE_FAMILY_PREFIXES:
+        if base == prefix or base.startswith(prefix + "_"):
+            return family
+    return "other"
+
+
+def part_family(name: str) -> Optional[str]:
+    """Dirty-tracking family of a snapshot part name, or None for parts
+    that are re-encoded on every take (the small ``_root``, legacy
+    single-blob ``state``)."""
+    if name.startswith("h/"):
+        return name
+    if name.startswith("a/"):
+        return "d/" + device_array_family(name[2:])
+    return None
+
+
+def _enc_host_family(state: Dict[str, Any], family: str) -> bytes:
+    doc: Dict[str, Any] = {}
+    if family == "control":
+        # the fmt marker rides in the always-dirty control family so the
+        # merged doc of a family-split snapshot still self-identifies
+        doc["fmt"] = FORMAT_HOST_V1
+    for key in HOST_FAMILIES[family]:
+        doc[key] = _HOST_KEY_ENCODERS[key](state)
+    return msgpack.pack(doc)
+
+
 def encode_host_state(state: Dict[str, Any]) -> bytes:
     """Encode ``PartitionEngine.snapshot_state()`` output to safe bytes."""
-    doc = {
-        "fmt": FORMAT_HOST_V1,
-        "wf_keys": _enc_keygen(state["wf_keys"]),
-        "job_keys": _enc_keygen(state["job_keys"]),
-        "incident_keys": _enc_keygen(state["incident_keys"]),
-        "deployment_keys": _enc_keygen(state["deployment_keys"]),
-        "element_instances": _enc_instances(state["element_instances"]),
-        "jobs": {
-            k: {"s": js.state, "d": js.deadline, "r": js.record.to_document()}
-            for k, js in state["jobs"].items()
-        },
-        "incidents": {
-            k: {"s": i.state, "ie": i.incident_event_position,
-                "fe": i.failure_event_position}
-            for k, i in state["incidents"].items()
-        },
-        "incident_by_activity": dict(state["incident_by_activity"]),
-        "incident_by_failed_job": dict(state["incident_by_failed_job"]),
-        "resolving_events": dict(state["resolving_events"]),
-        "incident_records": {
-            k: r.to_document() for k, r in state["incident_records"].items()
-        },
-        "messages": {
-            k: {"k": m.key, "n": m.name, "c": m.correlation_key,
-                "ttl": m.time_to_live, "p": m.payload, "id": m.message_id,
-                "dl": m.deadline}
-            for k, m in state["messages"].items()
-        },
-        "message_subscriptions": [
-            {"n": s.message_name, "c": s.correlation_key,
-             "pp": s.workflow_instance_partition_id,
-             "wk": s.workflow_instance_key, "ak": s.activity_instance_key}
-            for s in state["message_subscriptions"]
-        ],
-        "timers": {
-            k: {"d": t.due_date, "a": t.activity_instance_key,
-                "r": t.record.to_document()}
-            for k, t in state["timers"].items()
-        },
-        "pending_boundary": {
-            k: [bid, dict(payload)]
-            for k, (bid, payload) in state.get("pending_boundary", {}).items()
-        },
-        # jobs that became activatable during a credit drought (the
-        # engine's _awaiting_jobs backlog index, Dict[type, ordered key
-        # set]); dropping it strands drought-backlogged jobs on a
-        # snapshot-restored leader — backlog_activations would never
-        # revisit them
-        "awaiting_jobs": {
-            job_type: list(keys)
-            for job_type, keys in state.get("awaiting_jobs", {}).items()
-        },
-        "topic_sub_acks": dict(state["topic_sub_acks"]),
-        # per-exporter acked positions; absent in pre-exporter snapshots
-        "exporter_positions": dict(state.get("exporter_positions", {})),
-        "topics": {k: dict(v) for k, v in state["topics"].items()},
-        "next_partition_id": state["next_partition_id"],
-        "last_processed_position": state["last_processed_position"],
-        "workflows": _enc_workflows(state["workflows"]),
-    }
+    doc: Dict[str, Any] = {"fmt": FORMAT_HOST_V1}
+    for key, enc in _HOST_KEY_ENCODERS.items():
+        doc[key] = enc(state)
     return msgpack.pack(doc)
 
 
@@ -449,13 +519,32 @@ def encode_state_parts(state: Any) -> List[tuple]:
     - device state: one part per SoA table array (fixed-capacity tables
       that did not change between checkpoints hash identically), plus the
       embedded host-oracle state and a small root part;
-    - host state: deployed workflow resources (static after deployment)
-      split from the mutable remainder;
+    - host state: one part per state family (``HOST_FAMILIES``) so the
+      stable bulk — deployed workflow resources, quiescent instance or
+      message tables — dedupes across checkpoints;
     - anything else: a single legacy-encoded part.
 
     Returns ``[(name, bytes), ...]``; decode with ``decode_state_parts``.
     """
+    return encode_state_parts_delta(state, None)[0]
+
+
+def encode_state_parts_delta(
+    state: Any, dirty: Optional[Iterable[str]]
+) -> Tuple[List[tuple], List[str]]:
+    """Delta variant of :func:`encode_state_parts`: with ``dirty`` a set of
+    family names (``"h/<family>"`` / ``"d/<family>"``), only parts of dirty
+    families are encoded; parts of clean families come back by NAME in the
+    second element, for the caller to resolve against the previous take's
+    manifest. ``dirty=None`` encodes everything (full take). The tiny
+    ``_root`` part is always re-encoded.
+
+    For the device engine, a clean family's array values may be ``None``
+    in ``state["arrays"]`` (readback skipped); only names are required.
+    """
+    dirty_set = None if dirty is None else set(dirty)
     if isinstance(state, dict) and state.get("fmt") == FORMAT_DEVICE_V1:
+        names = sorted(state.get("arrays", {}).keys())
         parts = [
             (
                 "_root",
@@ -463,52 +552,55 @@ def encode_state_parts(state: Any) -> List[tuple]:
                     {
                         "fmt": FORMAT_DEVICE_V1,
                         "meta": state.get("meta", {}),
-                        "arrays": sorted(state.get("arrays", {}).keys()),
+                        "arrays": names,
                     }
                 ),
             )
         ]
-        for name in sorted(state.get("arrays", {}).keys()):
+        clean: List[str] = []
+        for name in names:
+            family = "d/" + device_array_family(name)
+            if dirty_set is not None and family not in dirty_set:
+                clean.append("a/" + name)
+                continue
+            value = state["arrays"][name]
+            if value is None:
+                raise SnapshotFormatError(
+                    f"array {name!r} of dirty family {family!r} was not "
+                    "materialized by the engine"
+                )
             parts.append(
-                ("a/" + name,
-                 msgpack.pack(pack_ndarray(np.asarray(state["arrays"][name]))))
+                ("a/" + name, msgpack.pack(pack_ndarray(np.asarray(value))))
             )
         if state.get("host") is not None:
-            parts.extend(
-                ("h/" + n, b) for n, b in _host_state_parts(state["host"])
-            )
-        return parts
+            hp, hc = _host_state_parts(state["host"], dirty_set)
+            parts.extend(("h/" + n, b) for n, b in hp)
+            clean.extend("h/" + n for n in hc)
+        return parts, clean
     if isinstance(state, dict) and isinstance(state.get("wf_keys"), KeyGenerator):
-        return [("_root", msgpack.pack({"fmt": FORMAT_HOST_V1}))] + [
-            ("h/" + n, b) for n, b in _host_state_parts(state)
-        ]
-    return [("state", encode_state(state))]
+        hp, hc = _host_state_parts(state, dirty_set)
+        return (
+            [("_root", msgpack.pack({"fmt": FORMAT_HOST_V1}))]
+            + [("h/" + n, b) for n, b in hp],
+            ["h/" + n for n in hc],
+        )
+    # legacy raw states have no family structure: always a full take
+    return [("state", encode_state(state))], []
 
 
-def _host_state_parts(state: Dict[str, Any]) -> List[tuple]:
-    """Host engine state as (workflows, rest) parts: deployed resources are
-    immutable after deployment, so the (often large) workflow part dedupes
-    across every subsequent checkpoint."""
-    doc = msgpack.unpack(encode_host_state(state))
-    workflows = doc.pop("workflows", [])
-    return [
-        ("workflows", msgpack.pack({"workflows": workflows})),
-        ("rest", msgpack.pack(doc)),
-    ]
-
-
-def _host_state_from_parts(parts: Dict[str, bytes], prefix: str) -> Dict[str, Any]:
-    try:
-        doc = msgpack.unpack(parts[prefix + "rest"])
-        wf_doc = msgpack.unpack(parts[prefix + "workflows"])
-        doc["workflows"] = wf_doc.get("workflows", [])
-    except KeyError as e:
-        raise SnapshotFormatError(f"snapshot part missing: {e}") from None
-    except Exception as e:
-        raise SnapshotFormatError(f"malformed snapshot part: {e}") from None
-    if not isinstance(doc, dict) or doc.get("fmt") != FORMAT_HOST_V1:
-        raise SnapshotFormatError("malformed host snapshot parts")
-    return _decode_host_doc(doc)
+def _host_state_parts(
+    state: Dict[str, Any], dirty: Optional[set] = None
+) -> Tuple[List[tuple], List[str]]:
+    """Host engine state as one part per family (``HOST_FAMILIES``); with
+    ``dirty``, clean families are skipped and returned by name."""
+    parts: List[tuple] = []
+    clean: List[str] = []
+    for family in HOST_FAMILIES:
+        if dirty is not None and ("h/" + family) not in dirty:
+            clean.append(family)
+            continue
+        parts.append((family, _enc_host_family(state, family)))
+    return parts, clean
 
 
 def decode_state_parts(parts: Dict[str, bytes]) -> Any:
@@ -517,42 +609,101 @@ def decode_state_parts(parts: Dict[str, bytes]) -> Any:
         raise SnapshotFormatError("snapshot parts too large")
     if set(parts) == {"state"}:
         return decode_state(parts["state"])
+    if "_root" not in parts:
+        raise SnapshotFormatError("snapshot root part missing")
+    return decode_state_parts_stream(
+        [("_root", parts["_root"])]
+        + [(n, b) for n, b in parts.items() if n != "_root"]
+    )
+
+
+def decode_state_parts_stream(part_iter: Iterable[tuple]) -> Any:
+    """Streaming reassembly of ``encode_state_parts`` output: consumes
+    ``(name, bytes)`` pairs in manifest order (``_root`` first — the
+    manifest's canonical sort guarantees it) and decodes each part as it
+    arrives, so restore memory is bounded by the decoded state plus ONE
+    in-flight part instead of all raw part bytes at once (the restore
+    analogue of the wave pipeline's per-family columnar readback)."""
+    it = iter(part_iter)
     try:
-        root = msgpack.unpack(parts["_root"])
-    except KeyError:
-        raise SnapshotFormatError("snapshot root part missing") from None
+        first_name, first_data = next(it)
+    except StopIteration:
+        raise SnapshotFormatError("empty snapshot") from None
+    if first_name == "state":
+        return decode_state(first_data)
+    if first_name != "_root":
+        raise SnapshotFormatError(
+            f"snapshot stream must start with _root, got {first_name!r}"
+        )
+    try:
+        root = msgpack.unpack(first_data)
     except Exception as e:
         raise SnapshotFormatError(f"malformed snapshot root: {e}") from None
     if not isinstance(root, dict):
         raise SnapshotFormatError("malformed snapshot root")
     fmt = root.get("fmt")
+    if fmt not in (FORMAT_HOST_V1, FORMAT_DEVICE_V1):
+        raise SnapshotFormatError(f"unknown snapshot parts format {fmt!r}")
+
+    total = len(first_data)
+    arrays: Dict[str, np.ndarray] = {}
+    # host family parts decode AS THEY ARRIVE into one merged doc (the
+    # legacy two-part layout merges through the same path: its
+    # "workflows" part has the family shape and "rest" is the remainder
+    # incl. the fmt marker), so raw part bytes never accumulate
+    host_doc: Dict[str, Any] = {}
+    saw_host = False
+    for name, data in it:
+        total += len(data)
+        if total > MAX_SNAPSHOT_BYTES:
+            raise SnapshotFormatError("snapshot parts too large")
+        if name.startswith("a/"):
+            try:
+                arrays[name[2:]] = unpack_ndarray(msgpack.unpack(data))
+            except SnapshotFormatError:
+                raise
+            except Exception as e:
+                raise SnapshotFormatError(
+                    f"malformed snapshot part {name!r}: {e}"
+                ) from None
+        elif name.startswith("h/"):
+            saw_host = True
+            try:
+                sub = msgpack.unpack(data)
+            except Exception as e:
+                raise SnapshotFormatError(
+                    f"malformed snapshot part {name!r}: {e}"
+                ) from None
+            if not isinstance(sub, dict):
+                raise SnapshotFormatError(
+                    f"malformed snapshot part {name!r}"
+                )
+            host_doc.update(sub)
+        else:
+            raise SnapshotFormatError(f"unexpected snapshot part {name!r}")
+
+    host = None
+    if saw_host:
+        if host_doc.get("fmt") != FORMAT_HOST_V1:
+            raise SnapshotFormatError("malformed host snapshot parts")
+        host = _decode_host_doc(host_doc)
     if fmt == FORMAT_HOST_V1:
-        return _host_state_from_parts(parts, "h/")
-    if fmt == FORMAT_DEVICE_V1:
-        arrays: Dict[str, np.ndarray] = {}
-        try:
-            names = [str(n) for n in root.get("arrays", [])]
-            for name in names:
-                arrays[name] = unpack_ndarray(msgpack.unpack(parts["a/" + name]))
-        except KeyError as e:
-            raise SnapshotFormatError(f"snapshot part missing: {e}") from None
-        except SnapshotFormatError:
-            raise
-        except Exception as e:
-            raise SnapshotFormatError(f"malformed snapshot part: {e}") from None
-        host = None
-        if any(n.startswith("h/") for n in parts):
-            host = _host_state_from_parts(parts, "h/")
-        meta = root.get("meta", {})
-        if not isinstance(meta, dict):
-            raise SnapshotFormatError("malformed snapshot meta")
-        return {
-            "fmt": FORMAT_DEVICE_V1,
-            "arrays": arrays,
-            "meta": meta,
-            "host": host,
-        }
-    raise SnapshotFormatError(f"unknown snapshot parts format {fmt!r}")
+        if host is None:
+            raise SnapshotFormatError("snapshot host parts missing")
+        return host
+    names = [str(n) for n in root.get("arrays", [])]
+    missing = [n for n in names if n not in arrays]
+    if missing:
+        raise SnapshotFormatError(f"snapshot part missing: 'a/{missing[0]}'")
+    meta = root.get("meta", {})
+    if not isinstance(meta, dict):
+        raise SnapshotFormatError("malformed snapshot meta")
+    return {
+        "fmt": FORMAT_DEVICE_V1,
+        "arrays": {n: arrays[n] for n in names},
+        "meta": meta,
+        "host": host,
+    }
 
 
 def encode_device_state(state: Dict[str, Any]) -> bytes:

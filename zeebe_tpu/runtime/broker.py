@@ -293,7 +293,10 @@ class Broker:
                 last_written_position=partition.log.next_position - 1,
                 term=partition.term,
             )
-            partition.snapshots.take(partition.engine.snapshot_state(), metadata)
+            # dirty-delta path: clean families reuse the previous take's
+            # manifest entries (no re-encode/re-hash; on the device engine
+            # no device→host readback either)
+            partition.snapshots.take_engine(partition.engine, metadata)
             # compaction: the snapshot covers everything below its
             # last-processed position — drop those records (bounded by the
             # engine's floor: open incidents still re-read their failure

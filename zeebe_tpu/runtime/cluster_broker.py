@@ -148,6 +148,10 @@ class PartitionServer:
         self.is_leader = False
         self._processing_scheduled = False
         self._fetch_attempted = False  # one fetch try per parked record
+        # snapshot-while-serving: at most ONE take in flight per partition
+        # (capture happens on the broker actor; commit on a worker thread)
+        self._snapshot_inflight = False
+        self._snapshot_thread: Optional[threading.Thread] = None
         self.raft.on_state_change(self._on_raft_state_change)
         self.log.on_commit(lambda _pos: self._schedule_processing())
 
@@ -165,12 +169,26 @@ class PartitionServer:
         # TPU device engine) is the broker's engine_factory's choice.
         self.engine = self.broker._new_engine(self.partition_id)
         # recovery: snapshot + replay of the committed log, side effects
-        # suppressed (same contract as the single-node broker)
+        # suppressed (same contract as the single-node broker). Parts are
+        # decoded + installed streamed per family; recover() reports the
+        # read+decode time as snapshot_restore_seconds, and this span —
+        # which additionally includes the engine state install — bounds
+        # what failover time the snapshot contributes (replay is separate).
+        import time as _time
+
+        t0 = _time.perf_counter()
         state, meta = self.snapshots.recover(self.log.next_position - 1)
         self.next_read_position = 0
         if state is not None:
             self.engine.restore_state(state)
             self.next_read_position = meta.last_processed_position + 1
+            from zeebe_tpu._events import set_gauge
+
+            set_gauge(
+                "snapshot_install_seconds", _time.perf_counter() - t0,
+                "Duration of the last snapshot recovery INCLUDING the "
+                "engine state install (excludes log replay)",
+            )
         last_source = -1
         for record in self.log.reader(0):
             self.engine.records_by_position[record.position] = record
@@ -507,28 +525,127 @@ class PartitionServer:
         self._fetch_attempted = True
         self._schedule_processing()
 
-    def snapshot(self) -> None:
+    def snapshot(self) -> Optional[threading.Thread]:
+        """Snapshot-while-serving: a brief fenced CAPTURE here on the
+        broker actor (serialized with the wave drain, so it lands exactly
+        at a wave boundary and grabs/encodes only the dirty state
+        families), then the expensive hash/compress/fsync COMMIT on a
+        worker thread — serving continues during it. At most one take is
+        in flight per partition (an overlapping period tick is skipped and
+        counted). Returns the commit thread, or None when nothing started.
+        """
         if not self.is_leader or self.engine is None:
-            return
+            return None
+        if self._snapshot_inflight:
+            count_event(
+                "snapshot_skipped_inflight",
+                "Snapshot ticks skipped because the partition's previous "
+                "take was still committing",
+            )
+            return None
         meta = SnapshotMetadata(
             last_processed_position=self.next_read_position - 1,
             last_written_position=self.log.next_position - 1,
             term=self.raft.term,
         )
-        self.snapshots.take(self.engine.snapshot_state(), meta)
-        # leader-side compaction below the snapshot (bounded by the
-        # engine's incident floor). Followers that fall below the new base
-        # catch up via snapshot replication + log fast-forward.
-        floor = min(
-            meta.last_processed_position + 1,
-            self.engine.compaction_floor(),
-        )
-        self.raft.actor.run(lambda: self.log.compact(floor))
+        try:
+            pending = self.snapshots.capture(self.engine, meta)
+        except Exception as e:  # noqa: BLE001 - a failing capture must not
+            # take down the snapshot loop for other partitions
+            count_event(
+                "snapshot_take_failures",
+                "Snapshot takes that raised (capture or commit)",
+            )
+            logger.error(
+                "snapshot capture failed on partition %d: %r",
+                self.partition_id, e,
+            )
+            return None
+        try:
+            # compaction floor reads engine state — compute it inside the
+            # fence, not on the worker thread
+            pending.compaction_floor = min(
+                meta.last_processed_position + 1,
+                self.engine.compaction_floor(),
+            )
+            self._snapshot_inflight = True
+            thread = threading.Thread(
+                target=self._commit_snapshot,
+                args=(pending,),
+                name=f"zb-snapshot-commit-{self.partition_id}",
+                daemon=True,
+            )
+            self._snapshot_thread = thread
+            thread.start()
+        except Exception as e:  # noqa: BLE001 - the capture fence already
+            # reset the dirty tracking: merge the captured families back so
+            # the next take re-captures them, and never leave the in-flight
+            # guard stuck (e.g. a thread-spawn failure under resource
+            # exhaustion would otherwise disable snapshots forever)
+            self._snapshot_inflight = False
+            count_event(
+                "snapshot_take_failures",
+                "Snapshot takes that raised (capture or commit)",
+            )
+            logger.error(
+                "snapshot start failed on partition %d: %r",
+                self.partition_id, e,
+            )
+            if self.engine is pending.engine and self.engine is not None:
+                self.engine.snapshot_mark_dirty(pending.dirty)
+            return None
+        return thread
+
+    def _commit_snapshot(self, pending) -> None:
+        """Off-actor snapshot commit (hash + compress + fsync + manifest
+        rename + purge). Touches only the captured parts and the snapshot
+        storage — never live engine state."""
+        try:
+            self.snapshots.commit(pending)
+        except Exception as e:  # noqa: BLE001 - isolate per partition
+            count_event(
+                "snapshot_take_failures",
+                "Snapshot takes that raised (capture or commit)",
+            )
+            logger.error(
+                "snapshot commit failed on partition %d (%s): %r",
+                self.partition_id, pending.metadata.dirname, e,
+            )
+            dirty = pending.dirty
+
+            def remark() -> None:
+                # the captured families were never committed: re-mark them
+                # so the next take re-captures (skip if the engine was
+                # replaced — a fresh engine starts with cold tracking)
+                if self.engine is not None and self.engine is pending.engine:
+                    self.engine.snapshot_mark_dirty(dirty)
+
+            try:
+                self.broker.actor_control.run(remark)
+            except Exception:  # noqa: BLE001 - broker closing
+                pass
+        else:
+            # leader-side compaction below the snapshot (bounded by the
+            # engine's incident/exporter floor, computed at capture).
+            # Followers that fall below the new base catch up via snapshot
+            # replication + log fast-forward.
+            floor = pending.compaction_floor
+            try:
+                self.raft.actor.run(lambda: self.log.compact(floor))
+            except Exception:  # noqa: BLE001 - broker closing
+                pass
+        finally:
+            self._snapshot_inflight = False
 
     def close(self) -> None:
         if self.exporter_director is not None:
             self.exporter_director.close()
             self.exporter_director = None
+        thread = self._snapshot_thread
+        if thread is not None and thread.is_alive():
+            # bounded: an in-flight commit interrupted here is exactly a
+            # crash mid-commit, which the storage's salvage sweep handles
+            thread.join(5)
         self.raft.close()
         self.storage.close()
 
@@ -1172,15 +1289,10 @@ class ClusterBroker(Actor):
                 if data is None:
                     return False
             else:
-                # local segment from a prior transfer: decompress for the
-                # pre-install decode check (bounded; hash verified at
-                # write time)
-                try:
-                    d = zlib.decompressobj()
-                    data = d.decompress(compressed, length + 1)
-                    if d.unconsumed_tail or len(data) != length:
-                        return False
-                except zlib.error:
+                # local segment from a prior transfer: re-verify through
+                # the shared check before the pre-install decode
+                data = storage.verify_segment(h, compressed, length)
+                if data is None:
                     return False
             if len(data) != length:
                 return False
@@ -2037,13 +2149,17 @@ class ClusterBroker(Actor):
 
     # -- periodic work -------------------------------------------------------
     def snapshot_all(self) -> None:
-        """Checkpoint every led partition. Safe from any thread: the work
-        runs on the broker actor, serialized with record processing — a
-        snapshot reads the same engine state processing mutates, and the
-        device engine additionally DONATES its buffers to XLA each step
-        (a concurrent read would hit deleted arrays)."""
+        """Checkpoint every led partition and WAIT for the commits (tests
+        and admin calls expect the snapshot durable on return; the periodic
+        tick uses _snapshot_all_on_actor directly and does not wait).
+        Safe from any thread: the CAPTURE runs on the broker actor,
+        serialized with record processing — a capture reads the same
+        engine state processing mutates, and the device engine
+        additionally DONATES its buffers to XLA each step (a concurrent
+        read would hit deleted arrays). The commit (hash/compress/fsync)
+        runs on worker threads off the serving path."""
         try:
-            self.actor.call(self._snapshot_all_on_actor).join(60)
+            threads = self.actor.call(self._snapshot_all_on_actor).join(60)
         except TimeoutError:
             # a silently-skipped checkpoint turns into an unexplainable
             # missing-snapshot failure much later (round-4 flake hunt);
@@ -2052,10 +2168,40 @@ class ClusterBroker(Actor):
                 "snapshot_all: broker actor did not run the checkpoint "
                 "within 60s (actor wedged or overloaded)"
             )
+        for thread in threads:
+            thread.join(60)
+            if thread.is_alive():
+                raise TimeoutError(
+                    "snapshot_all: a snapshot commit did not finish within "
+                    "60s (storage wedged?)"
+                )
 
-    def _snapshot_all_on_actor(self) -> None:
+    def _snapshot_all_on_actor(self) -> List[threading.Thread]:
+        """One capture per led partition, failures isolated per partition:
+        a raising take on one partition must not starve the rest of their
+        checkpoints (chaos break_fsync drives this path)."""
+        threads: List[threading.Thread] = []
         for server in self.partitions.values():
-            server.snapshot()
+            try:
+                thread = server.snapshot()
+            except Exception as e:  # noqa: BLE001 - per-partition isolation
+                count_event(
+                    "snapshot_take_failures",
+                    "Snapshot takes that raised (capture or commit)",
+                )
+                logger.error(
+                    "snapshot failed on partition %d: %r",
+                    server.partition_id, e,
+                )
+                continue
+            if thread is None:
+                # a periodic-tick take may already be committing: hand its
+                # thread to snapshot_all so the durable-on-return contract
+                # holds (the in-flight take is at most one tick old)
+                thread = server._snapshot_thread
+            if thread is not None and thread.is_alive():
+                threads.append(thread)
+        return threads
 
     def _tick_engines(self) -> None:
         """Timer/TTL sweeps on leader partitions (reference periodic actor

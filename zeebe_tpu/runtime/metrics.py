@@ -209,7 +209,12 @@ class MetricsRegistry:
 # log_torn_tail_truncations, snapshot_salvage_events; exporter plane:
 # exporter_lag (gauge, per exporter/partition), exporter_records_exported,
 # exporter_export_failures, exporter_floor_stalls, exporter_open_failures,
-# exporter_skipped_compacted.
+# exporter_skipped_compacted; snapshot lifecycle (docs/STATE.md):
+# snapshot_last_new_bytes / snapshot_last_total_bytes /
+# snapshot_take_seconds / snapshot_capture_pause_seconds /
+# snapshot_restore_seconds (gauges), snapshot_full_takes,
+# snapshot_delta_takes, snapshot_take_failures, snapshot_skipped_inflight,
+# snapshot_recover_skipped.
 GLOBAL_REGISTRY = MetricsRegistry()
 
 

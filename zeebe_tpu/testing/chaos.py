@@ -25,14 +25,18 @@ run in-process and deterministically):
   contract of ``StreamProcessorController`` reprocessing): the parity
   baseline for the "replay reconstructs the same state" invariant.
 
-The four invariants chaos runs assert (see ``tests/test_chaos.py`` and
-``docs/CHAOS.md``):
+The six invariants chaos runs assert (see ``tests/test_chaos.py``,
+``tests/test_snapshot_delta.py`` and ``docs/CHAOS.md``):
 
 1. no acked (committed) append is ever lost,
 2. at most one raft leader per term,
 3. replay of the surviving committed log is bit-identical across
    independent oracle replays and structurally equal to the live engine,
-4. snapshot-restore after a mid-commit crash converges to the same state.
+4. snapshot-restore after a mid-commit crash converges to the same state,
+5. a delta-chain snapshot restores bit-identically to a from-scratch
+   full take of the same state,
+6. a crash mid-delta-commit never orphans the previous snapshot's
+   referenced segments (it stays restorable across salvage sweep + GC).
 """
 
 from __future__ import annotations
@@ -272,6 +276,12 @@ class DiskFaults:
     CRASH_OLD_ASIDE = "old-aside"        # old final moved aside, tmp not in
     CRASH_SWAPPED = "swapped"            # new final in, set-aside not deleted
 
+    # additional crash point for MANIFEST (delta) snapshots: the new
+    # segments are durable in segments/ but the manifest commit never ran —
+    # they are orphans until GC'd, and the PREVIOUS snapshot must stay
+    # fully restorable
+    CRASH_SEGMENTS_WRITTEN = "segments-written"
+
     @classmethod
     def crash_snapshot_commit(
         cls, storage, metadata, payload: bytes, point: str
@@ -285,6 +295,51 @@ class DiskFaults:
         # the real writer populates the tmp dir (same files, same fsyncs) —
         # only the commit renames are simulated here
         storage.populate_blob_dir(tmp, payload)
+        cls._crash_commit_renames(tmp, final, point)
+
+    @classmethod
+    def crash_manifest_commit(
+        cls, storage, metadata, parts, reused, point: str
+    ) -> None:
+        """Replay ``SnapshotStorage.write_parts_delta`` (a delta/manifest
+        snapshot take) but crash at ``point``: after the new segments are
+        durable (``CRASH_SEGMENTS_WRITTEN``) or inside the manifest dir's
+        two-rename commit. The previous snapshot's referenced segments must
+        survive the crash AND the subsequent open+GC."""
+        from zeebe_tpu.log.snapshot import _pack_manifest, part_hash
+        import zlib as _zlib
+
+        entries = []
+        for name, data in parts:
+            h = part_hash(data)
+            if not storage.has_segment(h):
+                storage._write_segment(h, _zlib.compress(data, 1))
+            entries.append({"n": name, "h": h, "l": len(data)})
+        for e in reused:
+            entries.append({"n": str(e["n"]), "h": str(e["h"]), "l": int(e["l"])})
+        if point == cls.CRASH_SEGMENTS_WRITTEN:
+            return
+        entries.sort(key=lambda e: e["n"])
+        manifest = _pack_manifest(entries)
+        tmp = os.path.join(storage.root, metadata.dirname + ".tmp")
+        final = os.path.join(storage.root, metadata.dirname)
+        if os.path.exists(tmp):
+            import shutil as _shutil
+
+            _shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        with open(os.path.join(tmp, "manifest.bin"), "wb") as f:
+            f.write(manifest)
+            f.flush()
+            os.fsync(f.fileno())
+        with open(os.path.join(tmp, "checksum.crc32"), "w") as f:
+            f.write(str(_zlib.crc32(manifest)))
+            f.flush()
+            os.fsync(f.fileno())
+        cls._crash_commit_renames(tmp, final, point)
+
+    @classmethod
+    def _crash_commit_renames(cls, tmp: str, final: str, point: str) -> None:
         if point == cls.CRASH_TMP_WRITTEN:
             return
         aside = final + ".aside"

@@ -230,6 +230,18 @@ class TpuPartitionEngine:
         # resolution reads it (reference TypedStreamReader by position)
         self.records_by_position: Dict[int, Record] = self._host.records_by_position
         self.last_processed_position = -1
+        # delta-snapshot dirty tracking over the device table families
+        # (log/stateser.DEVICE_ARRAY_FAMILIES); None = cold (everything
+        # dirty). Marking is conservative at wave granularity: one kernel
+        # step may write any table (a job COMPLETE activates follow-on
+        # elements), so a dispatched device segment dirties every family —
+        # the win is that an idle partition's takes skip ALL device→host
+        # readback, and host-side control traffic (subscriptions, acks,
+        # ticks) dirties only the families it touches.
+        self._dirty_device: Optional[set] = None
+        # array part names materialized (device→host) by the last
+        # snapshot_state call — the zero-readback proof for tests
+        self.last_snapshot_readback: List[str] = []
 
     # -- routing ----------------------------------------------------------
     def partition_for_correlation_key(self, correlation_key: str) -> int:
@@ -313,6 +325,8 @@ class TpuPartitionEngine:
         host = self._host
         if not host.messages and not host.message_subscriptions:
             return
+        self._mark_device_dirty("msg", "msub")
+        host.snapshot_mark_dirty(("h/messages",))
         s = self.state
 
         def corr_cols(value) -> tuple:
@@ -422,6 +436,8 @@ class TpuPartitionEngine:
         from zeebe_tpu.engine.interpreter import StoredMessage, StoredSubscription
         from zeebe_tpu.tpu import hashmap as hm
 
+        self._mark_device_dirty("msg", "msub")
+        self._host.snapshot_mark_dirty(("h/messages",))
         s = self.state
         names = self.meta.varspace.names if self.meta else []
         corr_value = self._corr_string
@@ -498,6 +514,10 @@ class TpuPartitionEngine:
         IS the semantics."""
         from zeebe_tpu.tpu import hashmap
 
+        # demotion rewrites device tables AND inserts instances/jobs/timers
+        # straight into the oracle's maps (outside any record dispatch)
+        self._mark_device_dirty()
+        self._host.snapshot_mark_dirty(None)
         s = self.state
         ei_i32 = np.asarray(s.ei_i32)
         ei_i64 = np.asarray(s.ei_i64)
@@ -848,6 +868,7 @@ class TpuPartitionEngine:
             )
             credits -= 1
 
+        self._mark_device_dirty("sub")
         self.state = dataclasses.replace(
             s,
             sub_key=s.sub_key.at[free].set(sub.subscriber_key),
@@ -863,6 +884,7 @@ class TpuPartitionEngine:
 
     def remove_job_subscription(self, subscriber_key: int) -> None:
         self._host.remove_job_subscription(subscriber_key)
+        self._mark_device_dirty("sub")
         s = self.state
         match = np.asarray(s.sub_key) == subscriber_key
         self.state = dataclasses.replace(
@@ -871,6 +893,7 @@ class TpuPartitionEngine:
 
     def increase_job_credits(self, subscriber_key: int, credits: int) -> None:
         self._host.increase_job_credits(subscriber_key, credits)
+        self._mark_device_dirty("sub")
         s = self.state
         match = jnp.asarray(np.asarray(s.sub_key) == subscriber_key)
         self.state = dataclasses.replace(
@@ -964,6 +987,7 @@ class TpuPartitionEngine:
                 )
             )
         if out:  # rr only advances on an assignment, which also appends
+            self._mark_device_dirty("sub")
             self.state = dataclasses.replace(
                 s, sub_credits=jnp.asarray(sub_credits),
                 sub_rr=jnp.asarray(rr, jnp.int32),
@@ -1126,17 +1150,77 @@ class TpuPartitionEngine:
     # state. Restore + replay is the same contract as the host engine:
     # the broker replays committed records after last_processed_position
     # with side effects suppressed.) --------------------------------------
-    def snapshot_state(self) -> dict:
+    # every device table family (kept in sync with
+    # stateser.DEVICE_ARRAY_FAMILIES; pinned by a test) — module-local so
+    # the per-wave mark pays no import lookup
+    _ALL_DEVICE_FAMILIES = (
+        "ei", "job", "join", "keys", "msg", "msub", "sub", "timer",
+    )
+
+    def _mark_device_dirty(self, *families: str) -> None:
+        """Record device-table mutations for delta snapshots; no args =
+        every device family (a kernel step may write any table) — host
+        family tracking stays live, so clean host parts (e.g. workflows)
+        still reuse their previous segments on the next take."""
+        if self._dirty_device is None:
+            return
+        self._dirty_device.update(families or self._ALL_DEVICE_FAMILIES)
+
+    def snapshot_dirty_families(self):
+        """Union of device ("d/<family>") and embedded-oracle ("h/...")
+        dirty families since the last mark_clean; None when either side's
+        tracking is cold (forces a full take)."""
+        host = self._host.snapshot_dirty_families()
+        if self._dirty_device is None or host is None:
+            return None
+        return frozenset({"d/" + f for f in self._dirty_device} | set(host))
+
+    def snapshot_mark_clean(self) -> None:
+        self._dirty_device = set()
+        self._host.snapshot_mark_clean()
+
+    def snapshot_mark_dirty(self, families=None) -> None:
+        if families is None:
+            self._dirty_device = None
+            self._host.snapshot_mark_dirty(None)
+            return
+        dev = [f[2:] for f in families if f.startswith("d/")]
+        if dev:  # empty would mean mark-ALL in _mark_device_dirty's varargs
+            self._mark_device_dirty(*dev)
+        host = [f for f in families if f.startswith("h/")]
+        if host:
+            self._host.snapshot_mark_dirty(host)
+
+    def snapshot_state(self, families=None) -> dict:
         from zeebe_tpu.log import stateser
 
-        arrays: Dict[str, np.ndarray] = {}
+        dirty_dev = None
+        if families is not None:
+            dirty_dev = {f[2:] for f in families if f.startswith("d/")}
+        arrays: Dict[str, Optional[np.ndarray]] = {}
+        read: List[str] = []
+
+        def put(name: str, value, skip: bool) -> None:
+            if skip:
+                # clean family: the caller reuses the previous manifest's
+                # segment — NO device→host transfer, no encode, no hash
+                arrays[name] = None
+            else:
+                arrays[name] = np.asarray(value)
+                read.append(name)
+
         for f in dataclasses.fields(self.state):
+            skip = (
+                dirty_dev is not None
+                and stateser.device_array_family(f.name) not in dirty_dev
+            )
             v = getattr(self.state, f.name)
             if hasattr(v, "keys") and hasattr(v, "vals"):  # HashTable
-                arrays[f.name + ".keys"] = np.asarray(v.keys)
-                arrays[f.name + ".vals"] = np.asarray(v.vals)
+                put(f.name + ".keys", v.keys, skip)
+                put(f.name + ".vals", v.vals, skip)
             else:
-                arrays[f.name] = np.asarray(v)
+                put(f.name, v, skip)
+        self.last_snapshot_readback = read
         return {
             "fmt": stateser.FORMAT_DEVICE_V1,
             "arrays": arrays,
@@ -1159,6 +1243,7 @@ class TpuPartitionEngine:
 
         if snap.get("fmt") != stateser.FORMAT_DEVICE_V1:
             raise ValueError("not a device-engine snapshot")
+        self._dirty_device = None  # restored engine: next take is full
         # host oracle first: restores the shared repository (workflows) and
         # the control-plane state families
         self._host.restore_state(snap["host"])
@@ -1324,6 +1409,7 @@ class TpuPartitionEngine:
         def push_host_keys() -> None:
             if not host_allocated[0]:
                 return
+            self._mark_device_dirty("keys")
             # device-side maxima: no host↔device round trip
             self.state = dataclasses.replace(
                 self.state,
@@ -1486,6 +1572,8 @@ class TpuPartitionEngine:
         # ndim>0 array is deprecated NumPy behavior that will hard-error
         dev_wf = int(np.asarray(self.state.next_wf_key).item())
         dev_job = int(np.asarray(self.state.next_job_key).item())
+        if self._host.wf_keys.peek < dev_wf or self._host.job_keys.peek < dev_job:
+            self._host.snapshot_mark_dirty(("h/control",))
         if self._host.wf_keys.peek < dev_wf:
             self._host.wf_keys.set_key(dev_wf - keyspace.STEP_SIZE)
         if self._host.job_keys.peek < dev_job:
@@ -1809,9 +1897,12 @@ class TpuPartitionEngine:
                 continue
             intent = int(md.intent)
             if intent == int(JI.FAILED) and record.value.retries <= 0:
+                # mutates the oracle's incident maps outside host.process
+                self._host.snapshot_mark_dirty(("h/incidents", "h/control"))
                 self._host._incident_on_job_event(record, results[i])
                 suppress_incident_create.add(i)
             elif intent in (int(JI.RETRIES_UPDATED), int(JI.CANCELED)):
+                self._host.snapshot_mark_dirty(("h/incidents", "h/control"))
                 self._host._incident_on_job_event(record, results[i])
         # CREATE commands with unknown workflows are rejected host-side,
         # mirroring CreateWorkflowInstanceEventProcessor's rejection
@@ -1866,6 +1957,7 @@ class TpuPartitionEngine:
         if self._keys_at_rebuild > self.state.ei_index.shape[0] // 4:
             self.state = state_mod.rebuild_lookup_state(self.state)
             self._keys_at_rebuild = 0
+        self._mark_device_dirty()  # a kernel step may write any table
         self.state, out, stats = kernel.step_jit(
             self.graph, self.state, batch, now,
             partition_id=jnp.asarray(self.partition_id, jnp.int32),
@@ -1906,6 +1998,7 @@ class TpuPartitionEngine:
         """Allocate a workflow key host-side, keeping the device counter in
         sync (rejections consume a key in the oracle too)."""
         key = int(np.asarray(self.state.next_wf_key))
+        self._mark_device_dirty("keys")
         self.state = dataclasses.replace(
             self.state,
             next_wf_key=self.state.next_wf_key + 5,
