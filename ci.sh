@@ -40,6 +40,10 @@ python tools/exporter_smoke.py
 echo "== state lifecycle smoke (delta takes, crash-restore, replay parity) =="
 python tools/state_smoke.py
 
+echo "== host-path bench smoke (columnar plane: stage counts match, codec"
+echo "   bit-identity, zero lazy-row materializations; non-timing asserts) =="
+JAX_PLATFORMS=cpu python bench.py --host-path --smoke > /dev/null
+
 echo "== full test suite (tier-1; run './ci.sh slow' for the slow tier) =="
 python -m pytest tests/ -x -q -m "not slow" --ignore=tests/test_chaos.py --ignore=tests/test_exporters.py
 

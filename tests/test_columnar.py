@@ -1,0 +1,275 @@
+"""Columnar record plane: batch codec bit-identity, lazy row views, and
+the zero-materialization proof metric.
+
+The wave is the currency from readback to log/exporter/gateway (ROADMAP
+item 4); the log is the contract — so the batch codec is pinned
+bit-identical to per-record encoding, and the pure host wave path is
+pinned to ZERO lazy row materializations
+(``serving_rows_materialized_total``)."""
+
+import pytest
+
+from zeebe_tpu.protocol import codec, msgpack
+from zeebe_tpu.protocol.columnar import (
+    ColumnarBatch,
+    RecordsView,
+    rows_materialized_total,
+)
+from zeebe_tpu.protocol.enums import ErrorType, RecordType, RejectionType, ValueType
+from zeebe_tpu.protocol.metadata import RecordMetadata
+from zeebe_tpu.protocol.records import (
+    DeployedWorkflowMeta,
+    DeploymentRecord,
+    DeploymentResource,
+    IncidentRecord,
+    JobHeaders,
+    JobRecord,
+    MessageRecord,
+    Record,
+    TimerRecord,
+    WorkflowInstanceRecord,
+)
+
+
+def _assorted_records():
+    """One record per interesting shape: every value class family, unicode
+    rejection reasons, binary resources, nested headers, empty values."""
+    return [
+        Record(
+            position=5, key=7, timestamp=123, raft_term=2, producer_id=3,
+            source_record_position=4,
+            metadata=RecordMetadata(
+                record_type=RecordType.COMMAND,
+                value_type=ValueType.WORKFLOW_INSTANCE,
+                intent=0, request_id=9, request_stream_id=1, incident_key=11,
+            ),
+            value=WorkflowInstanceRecord(
+                bpmn_process_id="p", payload={
+                    "a": 1, "s": "héllo", "n": -1.5, "b": True, "z": None,
+                    "big": 2 ** 40, "neg": -77, "lst": [1, "x"],
+                },
+            ),
+        ),
+        Record(
+            position=6,
+            metadata=RecordMetadata(
+                record_type=RecordType.COMMAND_REJECTION,
+                value_type=ValueType.JOB, intent=3,
+                rejection_type=RejectionType.BAD_VALUE,
+                rejection_reason="bad ünicode reason " + "x" * 100,
+            ),
+            value=JobRecord(
+                type="t" * 40, retries=-3,
+                headers=JobHeaders(workflow_instance_key=5),
+                custom_headers={"h": "v"},
+            ),
+        ),
+        Record(
+            position=7,
+            metadata=RecordMetadata(value_type=ValueType.DEPLOYMENT),
+            value=DeploymentRecord(
+                topic_name="x",
+                resources=[DeploymentResource(resource=b"\x00\xffbin" * 100)],
+                deployed_workflows=[
+                    DeployedWorkflowMeta(bpmn_process_id="p", version=1, key=2)
+                ],
+            ),
+        ),
+        Record(
+            position=8,
+            metadata=RecordMetadata(value_type=ValueType.INCIDENT),
+            value=IncidentRecord(
+                error_type=int(ErrorType.UNKNOWN), error_message="m" * 300
+            ),
+        ),
+        Record(
+            position=9,
+            metadata=RecordMetadata(value_type=ValueType.MESSAGE),
+            value=MessageRecord(name="n", correlation_key="ck"),
+        ),
+        Record(
+            position=10,
+            metadata=RecordMetadata(value_type=ValueType.TIMER),
+            value=TimerRecord(due_date=-5),
+        ),
+        Record(position=11),  # no value → EMPTY_DOCUMENT
+    ]
+
+
+class TestBatchCodec:
+    def test_encode_records_bit_identical_to_per_record(self):
+        records = _assorted_records()
+        buf, offsets = codec.encode_records(records)
+        reference = b"".join(codec.encode_record(r) for r in records)
+        assert bytes(buf) == reference
+        # offsets point exactly at each frame start
+        for record, off in zip(records, offsets):
+            decoded, _ = codec.decode_record(bytes(buf), off)
+            assert codec.encode_record(decoded) == codec.encode_record(record)
+
+    def test_encode_columnar_bit_identical(self):
+        records = _assorted_records()
+        reference = b"".join(codec.encode_record(r) for r in records)
+        batch = ColumnarBatch.from_records(records)
+        assert bytes(codec.encode_columnar(batch)[0]) == reference
+        view = RecordsView(list(records))
+        assert bytes(codec.encode_columnar(view)[0]) == reference
+
+    def test_fused_value_encode_matches_document_pack(self):
+        for record in _assorted_records():
+            if record.value is None:
+                continue
+            assert record.value.encode() == msgpack.pack(
+                record.value.to_document()
+            )
+
+    def test_value_copy_is_deep(self):
+        value = WorkflowInstanceRecord(
+            bpmn_process_id="p", payload={"a": [1, {"b": 2}], "c": "x"}
+        )
+        clone = value.copy()
+        clone.payload["a"][1]["b"] = 99
+        clone.payload["c"] = "y"
+        assert value.payload == {"a": [1, {"b": 2}], "c": "x"}
+
+
+class TestLazyRows:
+    def test_from_records_rows_precached_no_materializations(self):
+        before = rows_materialized_total()
+        records = _assorted_records()
+        batch = ColumnarBatch.from_records(records)
+        # column reads AND row reads: everything is pre-cached
+        assert batch.positions() == [r.position for r in records]
+        assert batch.value_types() == [
+            int(r.metadata.value_type) for r in records
+        ]
+        assert list(batch) == records
+        assert batch[0] is records[0]
+        assert rows_materialized_total() == before
+
+    def test_lazy_batch_materializes_on_row_access_and_counts(self):
+        records = _assorted_records()
+        built = []
+
+        def materializer(i):
+            built.append(i)
+            return records[i].copy()
+
+        batch = ColumnarBatch(
+            len(records),
+            {
+                "position": [r.position for r in records],
+                "value_type": [int(r.metadata.value_type) for r in records],
+            },
+            materializer=materializer,
+        )
+        before = rows_materialized_total()
+        # column access never materializes
+        assert batch.value_types() == [
+            int(r.metadata.value_type) for r in records
+        ]
+        assert rows_materialized_total() == before
+        assert built == []
+        # row access materializes ONCE per row (cached) and counts
+        row = batch.row(2)
+        assert batch.row(2) is row
+        assert built == [2]
+        assert rows_materialized_total() == before + 1
+
+    def test_records_view_columns_from_lazy_entries(self):
+        records = _assorted_records()
+        batch = ColumnarBatch(
+            len(records),
+            {
+                "position": [r.position for r in records],
+                "value_type": [int(r.metadata.value_type) for r in records],
+            },
+            materializer=lambda i: records[i].copy(),
+        )
+        view = RecordsView(batch.log_entries())
+        before = rows_materialized_total()
+        assert view.positions() == [r.position for r in records]
+        assert view.value_types() == [
+            int(r.metadata.value_type) for r in records
+        ]
+        sub = view.select([0, 2])
+        assert sub.positions() == [records[0].position, records[2].position]
+        assert rows_materialized_total() == before  # columns stayed lazy
+        # iteration materializes (and shares row identity with the batch)
+        rows = list(sub)
+        assert rows[0] is batch.row(0)
+        assert rows_materialized_total() > before
+
+
+class TestColumnarLogAppend:
+    def test_columnar_append_bit_identical_and_lazy(self, tmp_path):
+        from zeebe_tpu.log import LogStream, SegmentedLogStorage
+
+        def command(i):
+            return Record(
+                key=i,
+                metadata=RecordMetadata(
+                    record_type=RecordType.COMMAND,
+                    value_type=ValueType.WORKFLOW_INSTANCE, intent=0,
+                ),
+                value=WorkflowInstanceRecord(
+                    bpmn_process_id="p", payload={"i": i}
+                ),
+            )
+
+        # reference log: plain record appends
+        s1 = SegmentedLogStorage(str(tmp_path / "a"))
+        log1 = LogStream(s1, clock=lambda: 42)
+        log1.append([command(i) for i in range(10)])
+
+        # columnar log: lazy batch (rows built only through the batch)
+        template = [command(i) for i in range(10)]
+        batch = ColumnarBatch(
+            10,
+            {
+                "key": [r.key for r in template],
+                "record_type": [int(r.metadata.record_type) for r in template],
+                "value_type": [int(r.metadata.value_type) for r in template],
+                "intent": [0] * 10,
+            },
+            materializer=lambda i: template[i],
+        )
+        s2 = SegmentedLogStorage(str(tmp_path / "b"))
+        log2 = LogStream(s2, clock=lambda: 42)
+        before = rows_materialized_total()
+        log2.append(batch)
+        # the append itself had to encode values (template rows), counted
+        # as materializations only for rows the batch had to build
+        a = [codec.encode_record(r) for r in log1.reader(0).read_committed()]
+        b = [codec.encode_record(r) for r in log2.reader(0).read_committed()]
+        assert a == b
+        # reopen: recovery decodes the same bytes
+        s2.close()
+        s3 = SegmentedLogStorage(str(tmp_path / "b"))
+        log3 = LogStream(s3, clock=lambda: 42)
+        assert [
+            codec.encode_record(r) for r in log3.reader(0).read_committed()
+        ] == a
+        s1.close()
+        s3.close()
+        assert rows_materialized_total() >= before
+
+    def test_committed_view_reads_columns_without_lock_per_record(self, tmp_path):
+        from zeebe_tpu.log import LogStream, SegmentedLogStorage
+
+        storage = SegmentedLogStorage(str(tmp_path))
+        log = LogStream(storage, clock=lambda: 1)
+        records = _assorted_records()
+        for r in records:
+            r.position = -1
+        log.append(records)
+        view = log.committed_view(0)
+        assert len(view) == len(records)
+        assert view.positions() == list(range(len(records)))
+        assert view.value_types() == [
+            int(r.metadata.value_type) for r in records
+        ]
+        # bounded reads
+        assert len(log.committed_view(2, 3)) == 3
+        assert log.committed_view(2, 3).positions() == [2, 3, 4]
+        storage.close()

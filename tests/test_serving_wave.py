@@ -130,6 +130,49 @@ class TestWaveDrainParity:
         assert len(frames_wave) > 100
         assert frames_wave == frames_one
 
+    def test_pure_wave_drain_materializes_zero_rows(self, tmp_path):
+        """The columnar-plane proof metric: a pure host wave drain —
+        client commands → codec → append → interpreter wave → exporter
+        egress → responses — materializes ZERO lazy rows from columnar
+        views (``serving_rows_materialized_total``). Rows on this path
+        are engine-built ``Record`` objects; only a columnar batch whose
+        rows were never Records (device readback) may count."""
+        import os
+
+        from zeebe_tpu.exporter import InMemoryExporter
+        from zeebe_tpu.gateway import workers as workers_mod
+        from zeebe_tpu.protocol.columnar import rows_materialized_total
+        from zeebe_tpu.runtime.config import ExporterCfg
+        import itertools
+
+        InMemoryExporter.reset()
+        workers_mod._subscriber_keys = itertools.count(1)
+        clock = ControlledClock(start_ms=1_000_000)
+        audit_dir = os.path.join(str(tmp_path), "audit")
+        broker = Broker(
+            num_partitions=1, data_dir=str(tmp_path / "d"), clock=clock,
+            exporters=[
+                ExporterCfg(id="audit", type="jsonl",
+                            args={"path": audit_dir}),
+                ExporterCfg(id="metrics", type="metrics", args={}),
+            ],
+        )
+        broker.wave_size = 256
+        before = rows_materialized_total()
+        try:
+            client = ZeebeClient(broker)
+            client.deploy_model(order_model())
+            JobWorker(broker, "payment-service", lambda ctx: {"paid": True})
+            for i in range(16):
+                client.create_instance("order-process", {"orderId": i})
+            clock.advance(1_000)
+            broker.tick()
+            broker.run_until_idle()
+        finally:
+            broker.close()
+        assert rows_materialized_total() - before == 0
+        InMemoryExporter.reset()
+
     def test_wave_metrics_observed(self, tmp_path):
         from zeebe_tpu.runtime.metrics import GLOBAL_REGISTRY
 

@@ -643,17 +643,14 @@ class Raft(Actor):
             return
         prev_pos = next_pos - 1
         prev_term = self.log.term_at(prev_pos) if prev_pos >= 0 else -1
-        frames = b""
-        count = 0
-        for pos in range(
-            next_pos,
-            min(
-                self.log.next_position,
-                next_pos + self.config.replication_batch_records,
-            ),
-        ):
-            frames += codec.encode_record(self.log.record_at(pos))
-            count += 1
+        # one locked slice + ONE codec pass for the whole replication
+        # batch (was a per-record record_at lock + encode + bytes concat)
+        batch = self.log.slice_records(
+            next_pos, limit=self.config.replication_batch_records
+        )
+        buf, _offsets = codec.encode_records(batch)
+        frames = bytes(buf)
+        count = len(batch)
         request = msgpack.pack(
             {
                 "t": "append",

@@ -260,6 +260,13 @@ class RecordCache:
     def __init__(self, hot_capacity: int = 8192):
         self._hot: "OrderedDict[int, Record]" = OrderedDict()
         self._hot_capacity = hot_capacity
+        # position-addressed fallback (the partition's LOG, installed by
+        # the brokers): every cached record IS a log record, and the
+        # engine's compaction floor pins exactly the positions incident
+        # resolution re-reads — so with a log behind the cache, eviction
+        # needs NO spill copy at all. The KV spill (encoded frame per
+        # evicted record) was ~a third of the serving drain's host CPU.
+        self._log_lookup = None
         self._kv = None
         try:
             from zeebe_tpu import native as _native
@@ -269,10 +276,20 @@ class RecordCache:
         except Exception:  # noqa: BLE001 - cold store is an optimization
             self._kv = None
 
+    def set_log_lookup(self, lookup) -> None:
+        """Install ``lookup(position) -> Optional[Record]`` (the log's
+        ``record_at``); eviction stops paying the encode+KV spill."""
+        self._log_lookup = lookup
+
     def __setitem__(self, position: int, record: Record) -> None:
         self._hot[position] = record
         self._hot.move_to_end(position)
-        if self._kv is not None and len(self._hot) > self._hot_capacity:
+        if len(self._hot) <= self._hot_capacity:
+            return
+        if self._log_lookup is not None:
+            self._hot.popitem(last=False)  # the log serves old positions
+            return
+        if self._kv is not None:
             old_pos, old_rec = self._hot.popitem(last=False)
             try:
                 from zeebe_tpu.protocol import codec as _codec
@@ -289,6 +306,10 @@ class RecordCache:
         record = self._hot.get(position)
         if record is not None:
             return record
+        if self._log_lookup is not None:
+            record = self._log_lookup(position)
+            if record is not None:
+                return record
         if self._kv is not None:
             blob = self._kv.get(position.to_bytes(8, "little", signed=True))
             if blob is not None:
@@ -648,20 +669,59 @@ class PartitionEngine:
         same partial mutations; the skip is replay-stable."""
         return ProcessingResult.merged(self.process_wave(records))
 
-    def process_wave(self, records: List[Record]) -> List[ProcessingResult]:
+    # value types the wave fold handles WITHOUT the full per-record
+    # dispatch: pure state-fold records that never produce follow-ups and
+    # are never re-read by position (the plane's own admin traffic — on an
+    # exporter-heavy partition every dispatched batch appends an ack that
+    # flows back through here)
+    _FOLD_VTS = frozenset(
+        {int(ValueType.NOOP), int(ValueType.RAFT), int(ValueType.EXPORTER)}
+    )
+
+    def process_wave(self, records) -> List[ProcessingResult]:
         """One drained wave → PER-RECORD results (source-stamped). The
         in-process broker applies each record's sends/appends in record
         order, so a wave-drained log stays byte-identical to
         record-at-a-time processing even when sends target the local
         partition; the device engine overrides this with one SIMD dispatch
-        per wave. Failure containment is per record (see process_batch)."""
+        per wave. Failure containment is per record (see process_batch).
+
+        ``records`` may be a plain list or a columnar view
+        (``RecordsView``/``ColumnarBatch``); the wave FOLDS over the
+        value-type column for the plane's own admin records (NOOP / RAFT
+        no-ops, EXPORTER position acks) — no position-cache insert, no
+        handler dispatch — and runs the full per-record path for
+        everything else."""
         import time as _time
 
         from zeebe_tpu.protocol.records import stamp_source_positions
 
         t0 = _time.perf_counter()
         results: List[ProcessingResult] = []
+        fold_vts = self._FOLD_VTS
+        command = RecordType.COMMAND
         for record in records:
+            md = record.metadata
+            if int(md.value_type) in fold_vts:
+                # column fold: state-only admin record. EXPORTER acks fold
+                # into exporter_positions; NOOP/RAFT only advance the
+                # processed position. Both dirty h/control exactly like
+                # the dispatched path (_VT_DIRTY_FAMILIES) and emit no
+                # follow-ups, so skipping dispatch is byte-invisible.
+                out = ProcessingResult()
+                if self._dirty_families is not None:
+                    self._dirty_families.add("h/control")
+                if (
+                    int(md.value_type) == int(ValueType.EXPORTER)
+                    and md.record_type == command
+                ):
+                    try:
+                        self._process_exporter_ack(record, out)
+                    except Exception as e:  # noqa: BLE001 - poison isolation
+                        self._contain_processing_failure(record, e, out)
+                self.last_processed_position = record.position
+                results.append(out)
+                continue
             try:
                 res = self.process(record)
             except Exception as e:  # noqa: BLE001 - poison-record isolation
@@ -2059,47 +2119,56 @@ class PartitionEngine:
         """Reference JobTimeOutStreamProcessor: TIME_OUT commands for expired
         activated jobs; returned commands must be appended to the log."""
         now = self.clock()
-        commands = []
-        for key, job in sorted(self.jobs.items()):
-            if job.state == int(JobIntent.ACTIVATED) and 0 <= job.deadline <= now:
-                commands.append(
-                    _record(RecordType.COMMAND, job.record.copy(), JobIntent.TIME_OUT, key)
-                )
-        return commands
+        # filter THEN sort: the sweep runs every broker tick over the
+        # whole table — sorting only the due entries keeps the idle tick
+        # O(n) with no allocation instead of an O(n log n) sort of
+        # thousands of in-flight jobs (output order unchanged: due keys
+        # ascending)
+        activated = int(JobIntent.ACTIVATED)
+        due = [
+            (key, job) for key, job in self.jobs.items()
+            if job.state == activated and 0 <= job.deadline <= now
+        ]
+        return [
+            _record(RecordType.COMMAND, job.record.copy(), JobIntent.TIME_OUT, key)
+            for key, job in sorted(due)
+        ]
 
     def check_timer_deadlines(self) -> List[Record]:
         """TPU-native timer firing: TRIGGER commands for due timers."""
         now = self.clock()
-        commands = []
-        for key, timer in sorted(self.timers.items()):
-            if timer.due_date <= now:
-                commands.append(
-                    _record(RecordType.COMMAND, timer.record.copy(), TimerIntent.TRIGGER, key)
-                )
-        return commands
+        due = [
+            (key, timer) for key, timer in self.timers.items()
+            if timer.due_date <= now
+        ]
+        return [
+            _record(RecordType.COMMAND, timer.record.copy(), TimerIntent.TRIGGER, key)
+            for key, timer in sorted(due)
+        ]
 
     def check_message_ttls(self) -> List[Record]:
         """Reference MessageTimeToLiveChecker: DELETE commands for expired
         messages."""
         now = self.clock()
-        commands = []
-        for key, message in sorted(self.messages.items()):
-            if message.deadline <= now:
-                commands.append(
-                    _record(
-                        RecordType.COMMAND,
-                        MessageRecord(
-                            name=message.name,
-                            correlation_key=message.correlation_key,
-                            time_to_live=message.time_to_live,
-                            payload=dict(message.payload),
-                            message_id=message.message_id,
-                        ),
-                        MessageIntent.DELETE,
-                        key,
-                    )
-                )
-        return commands
+        due = [
+            (key, message) for key, message in self.messages.items()
+            if message.deadline <= now
+        ]
+        return [
+            _record(
+                RecordType.COMMAND,
+                MessageRecord(
+                    name=message.name,
+                    correlation_key=message.correlation_key,
+                    time_to_live=message.time_to_live,
+                    payload=dict(message.payload),
+                    message_id=message.message_id,
+                ),
+                MessageIntent.DELETE,
+                key,
+            )
+            for key, message in sorted(due)
+        ]
 
     # ------------------------------------------------------------------
     # incident subsystem (reference IncidentStreamProcessor)

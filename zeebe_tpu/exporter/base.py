@@ -103,10 +103,17 @@ class Exporter:
     def open(self, controller: ExporterController) -> None:  # noqa: B027
         """Acquire resources. Called once per leadership install."""
 
-    def export_batch(self, records: List[Record]) -> None:
-        """Handle an ordered batch of committed records. Raising keeps the
-        position where it was; the director retries the same batch with
-        backoff."""
+    def export_batch(self, records) -> None:
+        """Handle an ordered batch of committed records. ``records`` is a
+        sequence of ``Record`` objects — on the hot path a COLUMNAR view
+        (``protocol.columnar.RecordsView``): iterating/indexing yields
+        ``Record`` rows, while the column accessors (``positions()``,
+        ``value_types()``, ``record_types()``, ``intents()``,
+        ``timestamps()``) read scalar columns without materializing any
+        row (the metrics exporter needs nothing else; a file sink can
+        dedup by the position column before touching rows). Raising keeps
+        the position where it was; the director retries the same batch
+        with backoff."""
         raise NotImplementedError
 
     def close(self) -> None:  # noqa: B027

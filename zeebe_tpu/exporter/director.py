@@ -351,22 +351,37 @@ class ExporterDirector:
             )
             handle.cursor = base
         progress = False
+        view_fn = getattr(self.log, "committed_view", None)
         while handle.cursor <= commit:
-            batch: List[Record] = []
-            pos = handle.cursor
-            while pos <= commit and len(batch) < self.BATCH_SIZE:
-                record = self.log.record_at(pos)
-                if record is None:
+            if view_fn is not None:
+                # columnar read: ONE lock acquisition for the whole batch,
+                # hidden-record filtering over the value-type COLUMN — no
+                # row materialization before the sink edge
+                batch = view_fn(handle.cursor, self.BATCH_SIZE)
+                if not len(batch):
                     break
-                batch.append(record)
-                pos += 1
-            if not batch:
-                break
-            visible = [
-                r for r in batch
-                if int(r.metadata.value_type) not in _HIDDEN_VALUE_TYPES
-            ]
-            if visible:
+                vts = batch.value_types()
+                pos = handle.cursor + len(batch)
+                visible = batch.select([
+                    i for i, vt in enumerate(vts)
+                    if vt not in _HIDDEN_VALUE_TYPES
+                ])
+            else:  # plain-log fallback (test doubles without the view API)
+                plain: List[Record] = []
+                pos = handle.cursor
+                while pos <= commit and len(plain) < self.BATCH_SIZE:
+                    record = self.log.record_at(pos)
+                    if record is None:
+                        break
+                    plain.append(record)
+                    pos += 1
+                if not plain:
+                    break
+                visible = [
+                    r for r in plain
+                    if int(r.metadata.value_type) not in _HIDDEN_VALUE_TYPES
+                ]
+            if len(visible):
                 try:
                     handle.exporter.export_batch(visible)
                 except Exception as e:  # noqa: BLE001 - isolate + backoff
@@ -387,7 +402,7 @@ class ExporterDirector:
                     logger.warning(
                         "exporter %r partition %d failed at position %d "
                         "(retry in %dms, attempt %d): %r",
-                        handle.id, self.partition_id, batch[0].position,
+                        handle.id, self.partition_id, handle.cursor,
                         backoff, handle.failures, e,
                     )
                     return progress
@@ -418,8 +433,7 @@ class ExporterDirector:
             progress = True
         return progress
 
-    def _ack_target(self, handle: ExporterHandle,
-                    visible: List[Record]) -> int:
+    def _ack_target(self, handle: ExporterHandle, visible) -> int:
         if handle.exporter.MANUAL_ACK:
             return handle.manual_position
         # auto-ack: a successful batch acks its last VISIBLE record, never
@@ -428,8 +442,12 @@ class ExporterDirector:
         # recovered tail against the ack on open, and an ack sitting on a
         # hidden record would false-report an audit hole after restart).
         # An admin-only batch advances the cursor without an ack (an ack
-        # record acking only ack records would ping-pong forever)
-        if visible:
+        # record acking only ack records would ping-pong forever). The
+        # position comes from the view's COLUMN — no row materializes.
+        if len(visible):
+            positions = getattr(visible, "positions", None)
+            if positions is not None:
+                return positions()[-1]
             return visible[-1].position
         return handle.position
 

@@ -70,9 +70,13 @@ class InMemoryExporter(Exporter):
     def export_batch(self, records) -> None:
         if self.fail:
             raise RuntimeError(f"injected failure in exporter {self.exporter_id!r}")
-        self.records.extend(records)
+        # debug sink = an API edge: iterating the (possibly columnar)
+        # view materializes rows here, deliberately — the asserts need
+        # real Record objects
+        rows = list(records)
+        self.records.extend(rows)
         with self._LOCK:
-            self._SINKS.setdefault(self.exporter_id, []).extend(records)
+            self._SINKS.setdefault(self.exporter_id, []).extend(rows)
 
     def close(self) -> None:
         self.closed = True

@@ -42,10 +42,23 @@ class MetricsExporter(Exporter):
         from zeebe_tpu.runtime.metrics import Histogram
 
         now = self.clock() if self.clock is not None else None
-        for record in records:
-            vt = int(record.metadata.value_type)
-            rt = int(record.metadata.record_type)
-            intent = int(record.metadata.intent)
+        # columnar egress: this sink needs only the metadata scalar
+        # columns — it never materializes a single Record object from a
+        # columnar view (the wave stays the currency through this edge)
+        if hasattr(records, "value_types"):
+            vts = records.value_types()
+            rts = records.record_types()
+            intents = records.intents()
+            timestamps = records.timestamps()
+        else:  # plain record lists (tests, custom drivers)
+            vts = [int(r.metadata.value_type) for r in records]
+            rts = [int(r.metadata.record_type) for r in records]
+            intents = [int(r.metadata.intent) for r in records]
+            timestamps = [r.timestamp for r in records]
+        for row in range(len(vts)):
+            vt = vts[row]
+            rt = rts[row]
+            intent = intents[row]
             key = (rt, vt, intent)
             counter = self._counters.get(key)
             if counter is None:
@@ -73,5 +86,6 @@ class MetricsExporter(Exporter):
                     **labels,
                 )
             counter.inc()
-            if now is not None and record.timestamp >= 0:
-                self._hists[key].observe(max(0, now - record.timestamp))
+            ts = timestamps[row]
+            if now is not None and ts >= 0:
+                self._hists[key].observe(max(0, now - ts))

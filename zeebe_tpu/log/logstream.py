@@ -20,6 +20,7 @@ from typing import Callable, Iterator, List, Optional
 
 from zeebe_tpu.log.storage import SegmentedLogStorage
 from zeebe_tpu.protocol import codec
+from zeebe_tpu.protocol.columnar import ColumnarBatch, RecordsView
 from zeebe_tpu.protocol.records import Record
 
 BLOCK_INDEX_DENSITY = 256  # record a (position → address) entry every N records
@@ -178,12 +179,65 @@ class LogStream:
     def record_at(self, position: int) -> Optional[Record]:
         """Record by position, None when compacted away or not yet
         appended — the supported random-access API (raft replication and
-        readers must not reach into the private list)."""
+        readers must not reach into the private list). Columnar-appended
+        entries materialize here, once (the backing batch caches the row,
+        so every reader sees one object identity per position)."""
         with self._view_lock:
             idx = position - self._base_position
             if idx < 0 or idx >= len(self._records):
                 return None
-            return self._records[idx]
+            entry = self._records[idx]
+            if type(entry) is tuple:  # lazy (batch, row) columnar ref
+                entry = entry[0].row(entry[1])
+                self._records[idx] = entry
+            return entry
+
+    def slice_records(
+        self,
+        start: int,
+        limit: Optional[int] = None,
+        committed_only: bool = False,
+    ) -> List[Record]:
+        """Materialized records from ``start`` under ONE lock acquisition
+        (the drain loops used to pay a lock round-trip per record via
+        ``record_at``). Clamps to the live window; ``committed_only``
+        bounds at the commit position (the wave-drain read)."""
+        with self._view_lock:
+            hi = self._next_position - 1
+            if committed_only:
+                hi = min(hi, self._commit_position)
+            lo = max(start, self._base_position)
+            if lo > hi:
+                return []
+            i0 = lo - self._base_position
+            i1 = hi - self._base_position + 1
+            if limit is not None:
+                i1 = min(i1, i0 + limit)
+            out = self._records[i0:i1]
+            for k, entry in enumerate(out):
+                if type(entry) is tuple:
+                    entry = entry[0].row(entry[1])
+                    self._records[i0 + k] = entry
+                    out[k] = entry
+            return out
+
+    def committed_view(
+        self, start: int, limit: Optional[int] = None
+    ) -> RecordsView:
+        """Committed records from ``start`` as a :class:`RecordsView` —
+        one lock acquisition, NO row materialization (lazy columnar
+        entries stay lazy; column reads come from the backing batch).
+        The exporter plane's read API."""
+        with self._view_lock:
+            hi = self._commit_position
+            lo = max(start, self._base_position)
+            if lo > hi:
+                return RecordsView([])
+            i0 = lo - self._base_position
+            i1 = hi - self._base_position + 1
+            if limit is not None:
+                i1 = min(i1, i0 + limit)
+            return RecordsView(self._records[i0:i1])
 
     def term_at(self, position: int) -> int:
         """Raft term at ``position``. For the position just below the
@@ -280,35 +334,60 @@ class LogStream:
     def commit_position(self) -> int:
         return self._commit_position
 
-    def append(self, records: List[Record], commit: bool = True) -> int:
-        """Atomically append a batch (reference LogStreamBatchWriter). Assigns
-        positions + timestamps; returns the last assigned position."""
+    def append(self, records, commit: bool = True) -> int:
+        """Atomically append a batch (reference LogStreamBatchWriter).
+        Assigns positions + timestamps; returns the last assigned position.
+
+        ``records`` is a list of ``Record`` objects or a
+        :class:`ColumnarBatch` — either way the whole wave encodes in ONE
+        codec pass into a single buffer, appends as one storage block, and
+        the block index derives from the pass's frame offsets (no
+        re-walk). A columnar batch's rows stay LAZY: the in-memory tail
+        holds ``(batch, row)`` refs that materialize on first read."""
         ts = self.clock()
-        frames = []
-        for record in records:
-            record.position = self._next_position
-            if record.timestamp < 0:
-                record.timestamp = ts
-            frames.append(codec.encode_record(record))
-            self._records.append(record)
-            self._next_position += 1
-        address = self.storage.append(b"".join(frames))
-        if records:
+        first_position = self._next_position
+        columnar = isinstance(records, ColumnarBatch)
+        if columnar:
+            n = len(records)
+            records.assign_positions(first_position, ts)
+            buf, offsets = codec.encode_columnar(records)
+            self._records.extend(records.log_entries())
+        else:
+            n = len(records)
+            for i, record in enumerate(records):
+                record.position = first_position + i
+                if record.timestamp < 0:
+                    record.timestamp = ts
+            buf, offsets = codec.encode_records(records)
+            self._records.extend(records)
+        self._next_position = first_position + n
+        address = self.storage.append(buf)
+        if n:
             self._segment_first_pos.setdefault(
-                self.storage.segment_of(address), records[0].position
+                self.storage.segment_of(address), first_position
             )
-            # sparse block index: walk the frame offsets only when the
-            # appended position range actually crosses a density boundary
-            # (group-committed batches are the append hot path)
-            first, last = records[0].position, records[-1].position
-            if (last // BLOCK_INDEX_DENSITY) * BLOCK_INDEX_DENSITY >= first:
-                offset = 0
-                for record, frame in zip(records, frames):
-                    if record.position % BLOCK_INDEX_DENSITY == 0:
+            # sparse block index: only when the appended position range
+            # actually crosses a density boundary (group-committed batches
+            # are the append hot path)
+            last = first_position + n - 1
+            if (last // BLOCK_INDEX_DENSITY) * BLOCK_INDEX_DENSITY >= first_position:
+                for i, offset in enumerate(offsets):
+                    if (first_position + i) % BLOCK_INDEX_DENSITY == 0:
                         self._block_index.append(
-                            (record.position, address + offset)
+                            (first_position + i, address + offset)
                         )
-                    offset += len(frame)
+            if not columnar:
+                # cache the just-encoded frame on response/push-relevant
+                # records: the cluster broker re-encodes exactly these for
+                # client response / push marshalling moments later
+                total = len(buf)
+                for i, record in enumerate(records):
+                    md = record.metadata
+                    if md.request_id >= 0 or md.request_stream_id >= 0:
+                        end = offsets[i + 1] if i + 1 < n else total
+                        record._frame = (
+                            record.position, bytes(buf[offsets[i]:end]),
+                        )
         if commit:
             self.set_commit_position(self._next_position - 1)
         return self._next_position - 1
@@ -445,19 +524,17 @@ class LogStreamReader:
             self._position = record.position + 1
             yield record
 
-    def read_committed(self) -> List[Record]:
+    def read_committed(self, limit: Optional[int] = None) -> List[Record]:
         """All records from the current position up to the commit position
-        (records past the commit position are not consumed)."""
-        commit = self.log.commit_position
-        out = []
-        if self._position < self.log.base_position:
+        (records past the commit position are not consumed). One lock
+        acquisition for the whole span — the wave drain's read path."""
+        out = self.log.slice_records(
+            self._position, limit=limit, committed_only=True
+        )
+        if out:
+            self._position = out[-1].position + 1
+        elif self._position < self.log.base_position:
             self._position = self.log.base_position
-        while self._position <= commit:
-            record = self.log.record_at(self._position)
-            if record is None:
-                break
-            out.append(record)
-            self._position = record.position + 1
         return out
 
 

@@ -254,7 +254,11 @@ class NativeLogStorage:
     def offset_of(address: int) -> int:
         return address & 0xFFFFFFFF
 
-    def append(self, block: bytes) -> int:
+    def append(self, block) -> int:
+        if not isinstance(block, bytes):
+            # the batch codec hands the wave's single bytearray straight
+            # through; the ctypes signature wants an immutable buffer
+            block = bytes(block)
         addr = self._lib.ls_append(self._h, block, len(block))
         if addr < 0:
             raise OSError("append failed")

@@ -20,6 +20,15 @@ from typing import Any, Tuple
 EMPTY_DOCUMENT = b"\x80"  # fixmap of size 0 (reference MsgPackHelper.EMTPY_OBJECT)
 
 
+_BH = struct.Struct(">BH")
+_BI = struct.Struct(">BI")
+_BQ = struct.Struct(">BQ")
+_Bh = struct.Struct(">Bh")
+_Bi = struct.Struct(">Bi")
+_Bq = struct.Struct(">Bq")
+_D = struct.Struct(">d")
+
+
 def pack(obj: Any) -> bytes:
     out = bytearray()
     _pack_into(out, obj)
@@ -27,6 +36,90 @@ def pack(obj: Any) -> bytes:
 
 
 def _pack_into(out: bytearray, obj: Any) -> None:
+    # exact-type dispatch first: this packer encodes every record value on
+    # the log-append hot path, and the common cases (str keys, small ints,
+    # flat dicts) must not wade through an isinstance chain. Subclasses
+    # (IntEnum, str subtypes) fall through to the general chain below —
+    # byte output is IDENTICAL either way.
+    t = type(obj)
+    if t is str:
+        data = obj.encode("utf-8")
+        n = len(data)
+        if n < 32:
+            out.append(0xA0 | n)
+        elif n < 256:
+            out.append(0xD9)
+            out.append(n)
+        elif n < 65536:
+            out += _BH.pack(0xDA, n)
+        else:
+            out += _BI.pack(0xDB, n)
+        out += data
+        return
+    if t is int:
+        if 0 <= obj < 128:
+            out.append(obj)
+        else:
+            _pack_int(out, obj)
+        return
+    if t is dict:
+        n = len(obj)
+        if n < 16:
+            out.append(0x80 | n)
+        elif n < 65536:
+            out += _BH.pack(0xDE, n)
+        else:
+            out += _BI.pack(0xDF, n)
+        for k, v in obj.items():
+            # the msgpack spec allows any key type; record documents use
+            # str keys (reference wire parity), engine-state snapshots
+            # (log/stateser.py) also use int keys (entity-key maps).
+            # Short str keys and scalar values pack INLINE — a record
+            # document is ~2 map entries per recursive call otherwise,
+            # and the call overhead dominated the append-path profile
+            tk = type(k)
+            if tk is str:
+                data = k.encode("utf-8")
+                kn = len(data)
+                if kn < 32:
+                    out.append(0xA0 | kn)
+                    out += data
+                else:
+                    _pack_into(out, k)
+            elif tk is int:
+                if 0 <= k < 128:
+                    out.append(k)
+                else:
+                    _pack_int(out, k)
+            else:
+                if not isinstance(k, (str, int)) or isinstance(k, bool):
+                    raise TypeError(
+                        f"map keys must be str or int, got {type(k)}"
+                    )
+                _pack_into(out, k)
+            tv = type(v)
+            if tv is str:
+                data = v.encode("utf-8")
+                vn = len(data)
+                if vn < 32:
+                    out.append(0xA0 | vn)
+                    out += data
+                else:
+                    _pack_into(out, v)
+            elif tv is int:
+                if -32 <= v < 128:  # both fixint ranges, one byte
+                    out.append(v & 0xFF)
+                else:
+                    _pack_int(out, v)
+            elif v is None:
+                out.append(0xC0)
+            elif v is True:
+                out.append(0xC3)
+            elif v is False:
+                out.append(0xC2)
+            else:
+                _pack_into(out, v)
+        return
     if obj is None:
         out.append(0xC0)
     elif obj is True:
@@ -37,37 +130,39 @@ def _pack_into(out: bytearray, obj: Any) -> None:
         _pack_int(out, obj)
     elif isinstance(obj, float):
         out.append(0xCB)
-        out += struct.pack(">d", obj)
+        out += _D.pack(obj)
     elif isinstance(obj, str):
         data = obj.encode("utf-8")
         n = len(data)
         if n < 32:
             out.append(0xA0 | n)
         elif n < 256:
-            out += struct.pack(">BB", 0xD9, n)
+            out.append(0xD9)
+            out.append(n)
         elif n < 65536:
-            out += struct.pack(">BH", 0xDA, n)
+            out += _BH.pack(0xDA, n)
         else:
-            out += struct.pack(">BI", 0xDB, n)
+            out += _BI.pack(0xDB, n)
         out += data
     elif isinstance(obj, (bytes, bytearray, memoryview)):
         data = bytes(obj)
         n = len(data)
         if n < 256:
-            out += struct.pack(">BB", 0xC4, n)
+            out.append(0xC4)
+            out.append(n)
         elif n < 65536:
-            out += struct.pack(">BH", 0xC5, n)
+            out += _BH.pack(0xC5, n)
         else:
-            out += struct.pack(">BI", 0xC6, n)
+            out += _BI.pack(0xC6, n)
         out += data
     elif isinstance(obj, (list, tuple)):
         n = len(obj)
         if n < 16:
             out.append(0x90 | n)
         elif n < 65536:
-            out += struct.pack(">BH", 0xDC, n)
+            out += _BH.pack(0xDC, n)
         else:
-            out += struct.pack(">BI", 0xDD, n)
+            out += _BI.pack(0xDD, n)
         for item in obj:
             _pack_into(out, item)
     elif isinstance(obj, dict):
@@ -75,13 +170,10 @@ def _pack_into(out: bytearray, obj: Any) -> None:
         if n < 16:
             out.append(0x80 | n)
         elif n < 65536:
-            out += struct.pack(">BH", 0xDE, n)
+            out += _BH.pack(0xDE, n)
         else:
-            out += struct.pack(">BI", 0xDF, n)
+            out += _BI.pack(0xDF, n)
         for k, v in obj.items():
-            # the msgpack spec allows any key type; record documents use
-            # str keys (reference wire parity), engine-state snapshots
-            # (log/stateser.py) also use int keys (entity-key maps)
             if not isinstance(k, (str, int)) or isinstance(k, bool):
                 raise TypeError(f"map keys must be str or int, got {type(k)}")
             _pack_into(out, k)
@@ -96,21 +188,23 @@ def _pack_int(out: bytearray, v: int) -> None:
     elif -32 <= v < 0:
         out.append(v & 0xFF)
     elif 0 <= v < 256:
-        out += struct.pack(">BB", 0xCC, v)
+        out.append(0xCC)
+        out.append(v)
     elif 0 <= v < 65536:
-        out += struct.pack(">BH", 0xCD, v)
+        out += _BH.pack(0xCD, v)
     elif 0 <= v < 2**32:
-        out += struct.pack(">BI", 0xCE, v)
+        out += _BI.pack(0xCE, v)
     elif 0 <= v < 2**64:
-        out += struct.pack(">BQ", 0xCF, v)
+        out += _BQ.pack(0xCF, v)
     elif -128 <= v < 0:
-        out += struct.pack(">Bb", 0xD0, v)
+        out.append(0xD0)
+        out.append(v & 0xFF)
     elif -32768 <= v < 0:
-        out += struct.pack(">Bh", 0xD1, v)
+        out += _Bh.pack(0xD1, v)
     elif -(2**31) <= v < 0:
-        out += struct.pack(">Bi", 0xD2, v)
+        out += _Bi.pack(0xD2, v)
     elif -(2**63) <= v < 0:
-        out += struct.pack(">Bq", 0xD3, v)
+        out += _Bq.pack(0xD3, v)
     else:
         raise OverflowError(f"int out of msgpack range: {v}")
 

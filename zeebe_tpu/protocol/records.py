@@ -30,42 +30,192 @@ class RecordValue:
 
     VALUE_TYPE: ClassVar[ValueType]
 
+    @classmethod
+    def _doc_spec(cls):
+        """(attr name, document key, maybe-nested, packed key bytes)
+        tuples, computed ONCE per class — ``to_document``/``encode`` sit
+        on the log-append hot path (every value encode) and must not
+        re-walk ``dataclasses.fields`` metadata (or re-encode the fixed
+        document keys) per record. Only fields declaring a nested value
+        class (``cls`` in the field metadata) pay the nested-document
+        checks."""
+        spec = cls.__dict__.get("_DOC_SPEC")
+        if spec is None:
+            spec = tuple(
+                (
+                    f.name,
+                    f.metadata.get("key", f.name),
+                    "cls" in f.metadata,
+                    msgpack.pack(f.metadata.get("key", f.name)),
+                )
+                for f in dataclasses.fields(cls)
+            )
+            # encode() emits a one-byte fixmap header; every record value
+            # class is well under the 16-field bound
+            assert len(spec) < 16, cls
+            cls._DOC_SPEC = spec
+        return spec
+
     def to_document(self) -> Dict[str, Any]:
+        values = self.__dict__
         doc = {}
-        for f in dataclasses.fields(self):
-            key = f.metadata.get("key", f.name)
-            v = getattr(self, f.name)
-            if dataclasses.is_dataclass(v):
-                v = v.to_document()
-            elif isinstance(v, list):
-                v = [x.to_document() if dataclasses.is_dataclass(x) else x for x in v]
+        for name, key, nested, _pkey in self._doc_spec():
+            v = values[name]
+            if nested:
+                if isinstance(v, RecordValue):
+                    v = v.to_document()
+                elif type(v) is list:
+                    v = [
+                        x.to_document() if isinstance(x, RecordValue) else x
+                        for x in v
+                    ]
             doc[key] = v
         return doc
 
     @classmethod
+    def _from_doc_spec(cls):
+        """(attr name, document key, nested value class) triples, computed
+        ONCE per class — decode sits on both wire edges (client response
+        unmarshalling, broker inbound commands)."""
+        spec = cls.__dict__.get("_FROM_DOC_SPEC")
+        if spec is None:
+            spec = tuple(
+                (f.name, f.metadata.get("key", f.name), f.metadata.get("cls"))
+                for f in dataclasses.fields(cls)
+            )
+            cls._FROM_DOC_SPEC = spec
+        return spec
+
+    @classmethod
     def from_document(cls, doc: Dict[str, Any]) -> "RecordValue":
         kwargs = {}
-        for f in dataclasses.fields(cls):
-            key = f.metadata.get("key", f.name)
+        for name, key, sub in cls._from_doc_spec():
             if key in doc:
                 v = doc[key]
-                sub = f.metadata.get("cls")
-                if sub is not None and isinstance(v, dict):
-                    v = sub.from_document(v)
-                elif sub is not None and isinstance(v, list):
-                    v = [sub.from_document(x) if isinstance(x, dict) else x for x in v]
-                kwargs[f.name] = v
+                if sub is not None:
+                    if isinstance(v, dict):
+                        v = sub.from_document(v)
+                    elif isinstance(v, list):
+                        v = [
+                            sub.from_document(x) if isinstance(x, dict) else x
+                            for x in v
+                        ]
+                kwargs[name] = v
         return cls(**kwargs)
 
     def encode(self) -> bytes:
-        return msgpack.pack(self.to_document())
+        """Msgpack document bytes, FUSED: fields pack straight into one
+        buffer with precomputed key bytes — no intermediate dict, no
+        per-record key encode. Byte-identical to
+        ``msgpack.pack(self.to_document())`` (field order IS document
+        order both ways)."""
+        out = bytearray()
+        self._encode_into(out)
+        return bytes(out)
+
+    def _encode_into(self, out: bytearray) -> None:
+        pack_into = msgpack._pack_into
+        spec = self._doc_spec()
+        out.append(0x80 | len(spec))  # fixmap header (len asserted < 16)
+        values = self.__dict__
+        for name, _key, nested, pkey in spec:
+            out += pkey
+            v = values[name]
+            tv = type(v)
+            if tv is str:
+                data = v.encode("utf-8")
+                n = len(data)
+                if n < 32:
+                    out.append(0xA0 | n)
+                    out += data
+                else:
+                    pack_into(out, v)
+            elif tv is int:
+                if -32 <= v < 128:
+                    out.append(v & 0xFF)
+                else:
+                    pack_into(out, v)
+            elif v is None:
+                out.append(0xC0)
+            elif v is True:
+                out.append(0xC3)
+            elif v is False:
+                out.append(0xC2)
+            elif nested and isinstance(v, RecordValue):
+                v._encode_into(out)
+            elif nested and tv is list:
+                n = len(v)
+                if n < 16:
+                    out.append(0x90 | n)
+                elif n < 65536:
+                    out += msgpack._BH.pack(0xDC, n)
+                else:
+                    out += msgpack._BI.pack(0xDD, n)
+                for item in v:
+                    if isinstance(item, RecordValue):
+                        item._encode_into(out)
+                    else:
+                        pack_into(out, item)
+            else:
+                pack_into(out, v)
 
     @classmethod
     def decode(cls, data: bytes) -> "RecordValue":
         return cls.from_document(msgpack.unpack(data))
 
     def copy(self):
-        return copy_module.deepcopy(self)
+        """Deep copy, hand-rolled: record values are dataclasses of
+        scalars, json-shaped dicts/lists and nested ``RecordValue``s —
+        ``copy.deepcopy``'s generic memo/reductor machinery was a visible
+        slice of the serving drain (handlers copy values on every
+        follow-up write)."""
+        cls = self.__class__
+        new = cls.__new__(cls)
+        d = new.__dict__
+        for name, v in self.__dict__.items():
+            tv = type(v)
+            if tv is dict:
+                d[name] = _copy_doc(v)
+            elif tv is list:
+                d[name] = [
+                    x.copy() if isinstance(x, RecordValue) else _copy_item(x)
+                    for x in v
+                ]
+            elif tv in (str, int, float, bool, bytes, type(None)):
+                d[name] = v  # immutable — share
+            elif isinstance(v, RecordValue):
+                d[name] = v.copy()
+            else:
+                d[name] = copy_module.deepcopy(v)
+        return new
+
+
+def _copy_item(v):
+    tv = type(v)
+    if tv is dict:
+        return _copy_doc(v)
+    if tv is list:
+        return [_copy_item(x) for x in v]
+    if tv in (str, int, float, bool, bytes, type(None)):
+        return v
+    return copy_module.deepcopy(v)  # exotic container: stay correct
+
+
+_SCALARS = (str, int, float, bool, bytes, type(None))
+
+
+def _copy_doc(doc: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in doc.items():
+        tv = type(v)
+        if tv is dict:
+            v = _copy_doc(v)
+        elif tv is list:
+            v = [_copy_item(x) for x in v]
+        elif tv not in _SCALARS:
+            v = copy_module.deepcopy(v)  # exotic value: stay correct
+        out[k] = v
+    return out
 
 
 def _f(key: str, default=None, **kw):

@@ -176,17 +176,24 @@ class Broker:
         system-partition-first ordering)."""
         boundaries = {}
         for partition in self.partitions:
+            # position-based re-reads (incident resolution, reference
+            # TypedStreamReader) serve from the LOG behind the engine's
+            # hot cache window — no spill copies, no cache pre-fill
+            cache = getattr(partition.engine, "records_by_position", None)
+            log_backed = hasattr(cache, "set_log_lookup")
+            if log_backed:
+                cache.set_log_lookup(partition.log.record_at)
             state, meta = partition.snapshots.recover(partition.log.next_position - 1)
             if state is not None:
                 partition.engine.restore_state(state)
                 partition.next_read_position = meta.last_processed_position + 1
-            # single pass over the log: rebuild the position→record cache
-            # (reference TypedStreamReader reads by position during incident
-            # resolution) and find the replay boundary
+            # single pass over the log to find the replay boundary
             last_source = -1
             for record in partition.log.reader(0):
-                partition.engine.records_by_position[record.position] = record
-                last_source = max(last_source, record.source_record_position)
+                if not log_backed:
+                    partition.engine.records_by_position[record.position] = record
+                if record.source_record_position > last_source:
+                    last_source = record.source_record_position
             boundaries[partition.partition_id] = last_source
         for partition in self.partitions:
             self._replay(partition, boundaries[partition.partition_id])

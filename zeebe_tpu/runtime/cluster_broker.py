@@ -168,6 +168,13 @@ class PartitionServer:
         # TypedStreamProcessors (:106-291). Which engine (host oracle or
         # TPU device engine) is the broker's engine_factory's choice.
         self.engine = self.broker._new_engine(self.partition_id)
+        # position-based re-reads (incident resolution) serve from the
+        # LOG behind the hot cache window — eviction then needs no spill
+        # copy, and recovery needs no cache pre-fill
+        cache = getattr(self.engine, "records_by_position", None)
+        log_backed = hasattr(cache, "set_log_lookup")
+        if log_backed:
+            cache.set_log_lookup(self.log.record_at)
         # recovery: snapshot + replay of the committed log, side effects
         # suppressed (same contract as the single-node broker). Parts are
         # decoded + installed streamed per family; recover() reports the
@@ -191,8 +198,10 @@ class PartitionServer:
             )
         last_source = -1
         for record in self.log.reader(0):
-            self.engine.records_by_position[record.position] = record
-            last_source = max(last_source, record.source_record_position)
+            if not log_backed:  # no log behind the cache: pre-fill it
+                self.engine.records_by_position[record.position] = record
+            if record.source_record_position > last_source:
+                last_source = record.source_record_position
         # replay bounded by the last source event position: tail records
         # (appended by the old leader but never processed) are handled by
         # the normal loop below, with side effects — else their follow-ups
@@ -1433,7 +1442,7 @@ class ClusterBroker(Actor):
                                 "partition": _pid,
                                 "subscriber_key": _key,
                                 "epoch": _epoch,
-                                "frame": codec.encode_record(record),
+                                "frame": self._record_frame(record),
                             }
                         )
                     )
@@ -1859,7 +1868,19 @@ class ClusterBroker(Actor):
         return msgpack.pack({"t": "topology-rsp", "leaders": leaders})
 
     @staticmethod
-    def _command_responder(result: ActorFuture):
+    def _record_frame(record) -> bytes:
+        """Wire frame for a response/push record, reusing the frame the
+        log append already encoded for it (``LogStream.append`` caches the
+        frame on request-relevant records) instead of paying a second
+        full encode + crc per response; columns → frame happens ONCE per
+        record."""
+        cached = getattr(record, "_frame", None)
+        if cached is not None and cached[0] == record.position:
+            return cached[1]
+        return codec.encode_record(record)
+
+    @classmethod
+    def _command_responder(cls, result: ActorFuture):
         def on_response(f: ActorFuture):
             if isinstance(f._exception, _AppendFailed):
                 result.complete(
@@ -1871,7 +1892,7 @@ class ClusterBroker(Actor):
                 )
             else:
                 result.complete(
-                    msgpack.pack({"t": "command-rsp", "frame": codec.encode_record(f._value)})
+                    msgpack.pack({"t": "command-rsp", "frame": cls._record_frame(f._value)})
                 )
 
         return on_response
@@ -1970,7 +1991,7 @@ class ClusterBroker(Actor):
                                 "t": "pushed-record",
                                 "partition": pid,
                                 "subscriber_key": subscriber_key,
-                                "frame": codec.encode_record(rec),
+                                "frame": self._record_frame(rec),
                             }
                         )
                     ),

@@ -214,7 +214,10 @@ class MetricsRegistry:
 # snapshot_take_seconds / snapshot_capture_pause_seconds /
 # snapshot_restore_seconds (gauges), snapshot_full_takes,
 # snapshot_delta_takes, snapshot_take_failures, snapshot_skipped_inflight,
-# snapshot_recover_skipped.
+# snapshot_recover_skipped; columnar record plane (docs/SERVING.md):
+# serving_rows_materialized_total — Record objects lazily materialized from
+# columnar batch views (protocol/columnar.py); 0 on the pure host wave
+# path, where every row is an engine-built Record already.
 GLOBAL_REGISTRY = MetricsRegistry()
 
 

@@ -2024,6 +2024,8 @@ class TpuPartitionEngine:
             WorkflowInstanceSubscriptionIntent as WS,
         )
 
+        from zeebe_tpu.protocol.columnar import ColumnarBatch
+
         o = {f.name: np.asarray(getattr(out, f.name)) for f in dataclasses.fields(out)}
         count = int(o["valid"].sum())
         if not count:
@@ -2034,9 +2036,29 @@ class TpuPartitionEngine:
             k: v[:count].tolist() for k, v in o.items() if v.ndim == 1
         }
         names = self.meta.varspace.names
+        # the readback decodes into a COLUMNAR batch: routing decisions
+        # read the scalar columns, while Record objects build through the
+        # batch's counted lazy row view. TODAY every emission row still
+        # materializes in the loop below (each written follow-up is
+        # immediately appended and re-staged by the drain), so the batch
+        # is the SEAM — the counter makes the remaining per-row cost
+        # visible, and pushing laziness through ProcessingResult is the
+        # next slice of ROADMAP item 4 (PERF_NOTES round 8).
+        emission = ColumnarBatch(
+            count,
+            {
+                "key": cols["key"],
+                "record_type": cols["rtype"],
+                "value_type": cols["vtype"],
+                "intent": cols["intent"],
+                "request_id": cols["req"],
+                "request_stream_id": cols["req_stream"],
+            },
+            materializer=lambda r: self._materialize(o, cols, r, names),
+        )
         for r in range(count):
             src = cols["src"][r]
-            record = self._materialize(o, cols, r, names)
+            record = emission.row(r)
             record.source_record_position = (
                 src_positions[src] if 0 <= src < len(src_positions) else -1
             )

@@ -97,6 +97,10 @@ class _Conn:
         self.lock = threading.Lock()
         self.open = True
         self.close_listeners: list = []
+        # write-interest request already posted/active: back-to-back sends
+        # on a busy connection skip the per-message post+wake round trip
+        # (a syscall per message on the serving firehose otherwise)
+        self.want_w_pending = False
 
     MAX_FRAME = 64 * 1024 * 1024
 
@@ -140,6 +144,11 @@ class _IoLoop:
         self.selector.register(self._wake_r, selectors.EVENT_READ, ("wake", None))
         self._running = True
         self._cmds: "deque" = deque()
+        # wake coalescing: one pending wake byte at a time — a burst of
+        # posts pays ONE socketpair syscall, not one per message (the
+        # flag clears on the IO thread before the command drain, so a
+        # post racing the clear just sends a fresh wake)
+        self._wake_pending = False
         self.thread = threading.Thread(target=self._run, name=name, daemon=True)
 
     def start(self):
@@ -147,6 +156,9 @@ class _IoLoop:
         return self
 
     def wake(self):
+        if self._wake_pending:
+            return
+        self._wake_pending = True
         try:
             self._wake_w.send(b"\x00")
         except OSError:
@@ -199,6 +211,14 @@ class _IoLoop:
                             self._wake_r.recv(4096)
                         except OSError:
                             pass
+                        # clear AFTER the recv: a post between a clear and
+                        # the recv would otherwise have its byte drained
+                        # with the flag left True — later posts would then
+                        # skip the wake and wait out a full select. A post
+                        # racing this clear either re-arms (flag seen
+                        # False → fresh byte) or was already appended and
+                        # rides the loop-top drain before the next select.
+                        self._wake_pending = False
                     elif kind == "accept":
                         ctx()  # server accept callback
                     elif kind == "conn":
@@ -255,6 +275,9 @@ class _IoLoop:
     def send(self, conn: _Conn, data: bytes):
         with conn.lock:
             conn.wbuf += data
+            if conn.want_w_pending:
+                return  # write interest already requested/active
+            conn.want_w_pending = True
         self.want_write(conn, True)
 
     def pump(self, conn: _Conn, mask: int, on_frames, on_close):
@@ -288,6 +311,11 @@ class _IoLoop:
                     except OSError:
                         broken = True
                 if not broken and not conn.wbuf:
+                    # a send() landing after the lock releases re-requests
+                    # write interest itself (its modify posts AFTER this
+                    # one in the IO-thread command queue, so the interest
+                    # ends enabled)
+                    conn.want_w_pending = False
                     self.want_write(conn, False)
             if broken:
                 # outside conn.lock: close listeners re-take it (_on_close)
@@ -579,15 +607,26 @@ class ClientTransport:
         while not self._closing:
             now = time.monotonic()
             expired = []
+            nearest = None
             with self._lock:
                 for cid, (future, deadline, _conn) in list(self._pending.items()):
                     if now >= deadline:
                         expired.append((cid, future))
                         del self._pending[cid]
+                    elif nearest is None or deadline < nearest:
+                        nearest = deadline
             for _cid, future in expired:
                 _count_event("transport_pending_expired")
                 future.complete_exceptionally(TransportError("request timed out"))
-            time.sleep(0.01)
+            # pace to the nearest deadline (bounded): a fixed 10ms scan of
+            # the pending table burned real CPU on single-core serving
+            # boxes while request timeouts are seconds-scale. The 0.1s cap
+            # bounds how late a request registered AFTER this scan can
+            # expire (the snapshot of `nearest` is stale by construction)
+            pause = 0.1 if nearest is None else min(
+                0.1, max(0.02, nearest - now)
+            )
+            time.sleep(pause)
 
     # -- public API --------------------------------------------------------
     def send_request(
