@@ -498,6 +498,254 @@ def run_serving_path(n_instances=2048, engine="tpu", threads=8,
         broker.close()
 
 
+def run_multi_tenant(engine="host", partitions=8, clients=24,
+                     instances_per_client=16, zipf_s=1.2, trickle_ms=0,
+                     scheduler=True, seed=7, duration_sec=60,
+                     overload=False):
+    """MULTI-TENANT serving mix: N small clients, each picking partitions
+    from a Zipf-skewed distribution (heavy head, long sparse tail) — the
+    traffic shape where per-partition waves collapse and the shared-wave
+    scheduler (zeebe_tpu/scheduler) earns its keep. ``trickle_ms`` spaces
+    each tenant's creates out (sparse mode). ``scheduler=False`` runs the
+    per-partition baseline drain — the A/B pair at EQUAL offered load.
+    ``overload=True`` shrinks the admission watermarks so the gateway's
+    shed-before-collapse path is exercised and counted."""
+    import random as _random
+    import tempfile
+    import threading as _threading
+    import time as _time
+
+    from zeebe_tpu.gateway.cluster_client import ClusterClient
+    from zeebe_tpu.models.bpmn.builder import Bpmn
+    from zeebe_tpu.runtime.cluster_broker import ClusterBroker
+    from zeebe_tpu.runtime.config import BrokerCfg
+    from zeebe_tpu.runtime.engines import engine_factory_from_config
+    from zeebe_tpu.runtime.metrics import GLOBAL_REGISTRY
+
+    cfg = BrokerCfg()
+    cfg.network.client_port = 0
+    cfg.network.management_port = 0
+    cfg.network.subscription_port = 0
+    cfg.metrics.port = 0
+    cfg.metrics.enabled = False
+    cfg.cluster.partitions = partitions
+    cfg.engine.type = engine
+    cfg.scheduler.enabled = scheduler
+    if overload:
+        cfg.admission.max_inflight_per_connection = 4
+        cfg.admission.queue_depth_high = 64
+        cfg.admission.retry_after_ms = 5
+    broker = ClusterBroker(
+        cfg, tempfile.mkdtemp(),
+        engine_factory=engine_factory_from_config(cfg),
+    )
+    clients_open = []
+    try:
+        for pid in range(partitions):
+            broker.open_partition(pid).join(600)
+            broker.bootstrap_partition(pid, {})
+        deadline = _time.time() + 600
+        while _time.time() < deadline and not all(
+            broker.partitions[pid].is_leader for pid in range(partitions)
+        ):
+            _time.sleep(0.02)
+        if not all(
+            broker.partitions[pid].is_leader for pid in range(partitions)
+        ):
+            raise RuntimeError("multi-tenant broker never led all partitions")
+
+        def counters():
+            c = GLOBAL_REGISTRY.counter
+            return {
+                "waves": c("serving_waves_total").value,
+                "records": c("serving_wave_records_total").value,
+                "shared": c("scheduler_shared_waves_total").value,
+                "sources": c("scheduler_wave_sources_total").value,
+                "shed_conn": c("gateway_commands_shed",
+                               reason="CONNECTION_INFLIGHT").value,
+                "shed_queue": c("gateway_commands_shed",
+                                reason="QUEUE_DEPTH").value,
+                "bp_skips": c("scheduler_backpressure_skips").value,
+            }
+
+        admin = ClusterClient(
+            [broker.client_address], num_partitions=partitions,
+            request_timeout_ms=300_000,
+        )
+        clients_open.append(admin)
+        model = (
+            Bpmn.create_process("tenant-flow")
+            .start_event()
+            .service_task("work", type="tenant-service")
+            .end_event()
+            .done()
+        )
+        admin.deploy_model(model)
+        done_cond = _threading.Condition()
+        done_at: dict = {}
+
+        def on_job(pid, rec):
+            # instance keys are PER-PARTITION keyspaces: the (partition,
+            # key) pair is the unique identity across a multi-tenant mix
+            with done_cond:
+                done_at[(pid, rec.value.headers.workflow_instance_key)] = (
+                    _time.perf_counter()
+                )
+                done_cond.notify_all()
+            return {}
+
+        worker = admin.open_job_worker(
+            "tenant-service", on_job, credits=256,
+        )
+        # warm every partition's engine outside the timed window
+        for pid in range(partitions):
+            admin.create_instance("tenant-flow", partition_id=pid)
+        with done_cond:
+            done_cond.wait_for(lambda: len(done_at) >= partitions,
+                               timeout=240)
+
+        # Zipf weights over partitions: rank r gets 1/(r+1)^s
+        weights = [1.0 / (r + 1) ** zipf_s for r in range(partitions)]
+        c0 = counters()
+        starts: dict = {}
+        starts_lock = _threading.Lock()
+        errors: list = []
+        stop_at = _time.monotonic() + duration_sec
+
+        def tenant(k):
+            rng = _random.Random(seed * 1000 + k)
+            client = ClusterClient(
+                [broker.client_address], num_partitions=partitions,
+                request_timeout_ms=120_000,
+            )
+            clients_open.append(client)
+            for _ in range(instances_per_client):
+                if _time.monotonic() > stop_at:
+                    return
+                pid = rng.choices(range(partitions), weights=weights)[0]
+                t_send = _time.perf_counter()
+                try:
+                    rsp = client.create_instance(
+                        "tenant-flow", payload={"t": k},
+                        partition_id=pid,
+                    )
+                    with starts_lock:
+                        starts[(pid, rsp.value.workflow_instance_key)] = (
+                            t_send
+                        )
+                except Exception as e:  # noqa: BLE001 - report, don't crash
+                    errors.append(str(e)[:120])
+                    return
+                if trickle_ms:
+                    _time.sleep(trickle_ms / 1000.0)
+
+        t0 = _time.perf_counter()
+        threads = [
+            _threading.Thread(target=tenant, args=(k,), daemon=True)
+            for k in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(duration_sec + 120)
+
+        def _all_done():
+            # a tenant stuck past its join timeout may still be inserting
+            # into starts: snapshot under the lock before iterating
+            with starts_lock:
+                pending = list(starts)
+            return all(key in done_at for key in pending)
+
+        with done_cond:
+            done_cond.wait_for(_all_done, timeout=min(120, duration_sec))
+        elapsed = _time.perf_counter() - t0
+        worker.close()
+        c1 = counters()
+        d_waves = c1["waves"] - c0["waves"]
+        d_recs = c1["records"] - c0["records"]
+        d_shared = c1["shared"] - c0["shared"]
+        with starts_lock:
+            starts_snapshot = dict(starts)
+        latencies = sorted(
+            done_at[key] - t_send
+            for key, t_send in starts_snapshot.items()
+            if key in done_at
+        )
+
+        def pct(p):
+            if not latencies:
+                return None
+            idx = min(len(latencies) - 1, int(len(latencies) * p))
+            return round(latencies[idx] * 1000.0, 1)
+
+        created = len(starts_snapshot)
+        shed = (c1["shed_conn"] - c0["shed_conn"]) + (
+            c1["shed_queue"] - c0["shed_queue"]
+        )
+        return {
+            "config": "multi-tenant-zipf",
+            "engine": engine,
+            "scheduler": scheduler,
+            "partitions": partitions,
+            "clients": clients,
+            "zipf_s": zipf_s,
+            "trickle_ms": trickle_ms,
+            "overload": overload,
+            "instances": created,
+            "completed": sum(1 for k in starts_snapshot if k in done_at),
+            "elapsed_sec": round(elapsed, 3),
+            "instances_per_sec": round(created / max(elapsed, 1e-9), 1),
+            "mean_wave_fill": round(d_recs / d_waves, 2) if d_waves else 0.0,
+            "waves": int(d_waves),
+            "shared_waves": int(d_shared),
+            "mean_wave_sources": round(
+                (c1["sources"] - c0["sources"]) / d_shared, 2
+            ) if d_shared else 0.0,
+            "shed": int(shed),
+            "shed_rate": round(shed / max(created + shed, 1), 4),
+            "backpressure_skips": int(c1["bp_skips"] - c0["bp_skips"]),
+            "p50_instance_latency_ms": pct(0.50),
+            "p99_instance_latency_ms": pct(0.99),
+            **({"errors": len(errors), "first_error": errors[0]}
+               if errors else {}),
+        }
+    finally:
+        for client in clients_open:
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001
+                pass
+        broker.close()
+
+
+def run_multi_tenant_ab(engine="host", **kw):
+    """The A/B the tentpole is judged on: shared waves vs per-partition
+    drains under the SAME Zipf-skewed offered load, plus a short overload
+    leg proving the gateway sheds instead of queueing to collapse."""
+    shared = run_multi_tenant(engine=engine, scheduler=True, **kw)
+    baseline = run_multi_tenant(engine=engine, scheduler=False, **kw)
+    overload = run_multi_tenant(
+        engine=engine, scheduler=True, overload=True,
+        clients=kw.get("clients", 24),
+        instances_per_client=kw.get("instances_per_client", 16),
+        partitions=kw.get("partitions", 8),
+        duration_sec=kw.get("duration_sec", 60),
+    )
+    fill_ratio = (
+        shared["mean_wave_fill"] / baseline["mean_wave_fill"]
+        if baseline["mean_wave_fill"] else None
+    )
+    return {
+        "config": "multi-tenant-ab",
+        "shared": shared,
+        "per_partition_baseline": baseline,
+        "overload": overload,
+        "fill_ratio_shared_over_baseline": (
+            round(fill_ratio, 2) if fill_ratio else None
+        ),
+    }
+
+
 def run_device_config(build_fn, label, total_instances, wave, progress,
                       cap_factor=4):
     """One device-engine bench: stage CREATE waves, drive to quiescence
@@ -1019,6 +1267,21 @@ def main():
     if "--host-path" in sys.argv:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         result = run_host_path(smoke="--smoke" in sys.argv)
+        print(json.dumps(result, indent=2))
+        return
+
+    if "--multi-tenant" in sys.argv:
+        # host engine on CPU unless the caller wants the device
+        # (ZB_BENCH_ENGINE=tpu); --trickle adds sparse think time
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        engine = os.environ.get("ZB_BENCH_ENGINE", "host")
+        kw = {}
+        if "--smoke" in sys.argv:
+            kw = dict(partitions=4, clients=8, instances_per_client=4,
+                      duration_sec=30)
+        if "--trickle" in sys.argv:
+            kw["trickle_ms"] = 25
+        result = run_multi_tenant_ab(engine=engine, **kw)
         print(json.dumps(result, indent=2))
         return
 

@@ -44,6 +44,10 @@ echo "== host-path bench smoke (columnar plane: stage counts match, codec"
 echo "   bit-identity, zero lazy-row materializations; non-timing asserts) =="
 JAX_PLATFORMS=cpu python bench.py --host-path --smoke > /dev/null
 
+echo "== wave-scheduler smoke (skewed-traffic fill >= 2x per-partition"
+echo "   baseline, per-partition logs bit-identical, overload sheds) =="
+JAX_PLATFORMS=cpu python tools/scheduler_smoke.py
+
 echo "== full test suite (tier-1; run './ci.sh slow' for the slow tier) =="
 python -m pytest tests/ -x -q -m "not slow" --ignore=tests/test_chaos.py --ignore=tests/test_exporters.py
 

@@ -514,6 +514,321 @@ class TestChaosBrokerFixedSeed:
 
 
 # ---------------------------------------------------------------------------
+# wave scheduler under chaos (ISSUE 8): crash-stop / failover / fault-
+# injected packing — acked records survive, per-partition order holds,
+# cursors resume gap-free, one wedged partition never stalls the rest
+# ---------------------------------------------------------------------------
+
+
+def _scheduler_chaos_round(seed, feeds_spec, rounds=50):
+    """Property harness for the scheduler core: seeded fault-injected
+    feeds (random backlog growth, random dispatch failures, random
+    leader-flap unregister/reregister) driven through real drains.
+    Invariants checked: per-feed dispatch order is exactly cursor order
+    with NO gaps and no loss (a failed dispatch re-drains), and sparse
+    feeds are never starved by deep ones."""
+    import random
+
+    from zeebe_tpu.scheduler import WaveScheduler
+
+    class ChaosFeed:
+        def __init__(self, pid, fail_rate, pipelined):
+            self.partition_id = pid
+            self.cursor = 0
+            self.available = 0
+            self.fail_rate = fail_rate
+            self.pipelined = pipelined
+            self.dispatched = []
+            self.collected = 0
+            self.rng = random.Random(seed * 31 + pid)
+
+        def backlog(self):
+            return self.available - self.cursor
+
+        def take(self, limit):
+            n = min(limit, self.available - self.cursor)
+            if n <= 0:
+                return []
+            out = list(range(self.cursor, self.cursor + n))
+            self.cursor += n
+            return out
+
+        def dispatch(self, records):
+            if self.rng.random() < self.fail_rate:
+                raise RuntimeError("chaos dispatch failure")
+            self.dispatched.extend(records)
+            if self.pipelined:
+                return list(records), 0.0, 0.0
+            self.collected += len(records)
+            return None, 0.0, 0.0
+
+        def collect(self, pending):
+            self.collected += len(pending)
+            return 0.0, 0.0
+
+        def rewind(self, position):
+            self.cursor = min(self.cursor, position)
+            # a rewound span re-drains: drop it from the dispatched tally
+            self.dispatched = [p for p in self.dispatched if p < position]
+
+        def tick(self):
+            pass
+
+    rng = random.Random(seed)
+    ws = WaveScheduler(wave_size=64, quantum=8, backpressure_limit=64)
+    feeds = [
+        ChaosFeed(pid, fail, pipe)
+        for pid, (fail, pipe) in enumerate(feeds_spec)
+    ]
+    registered = set()
+    for f in feeds:
+        ws.register(f)
+        registered.add(f.partition_id)
+    for _ in range(rounds):
+        # traffic arrival (skewed): feed 0 heavy, the rest sparse
+        for f in feeds:
+            f.available += rng.choice(
+                (24, 48) if f.partition_id == 0 else (0, 1, 3)
+            )
+        # leader flaps: random unregister/reregister
+        if rng.random() < 0.2 and len(registered) > 1:
+            pid = rng.choice(sorted(registered))
+            ws.unregister(pid)
+            registered.discard(pid)
+        if rng.random() < 0.4:
+            for f in feeds:
+                if f.partition_id not in registered:
+                    ws.register(f)
+                    registered.add(f.partition_id)
+                    break
+        try:
+            ws.drain()
+        except RuntimeError:
+            pass  # chaos dispatch failure: the records must re-drain
+    for f in feeds:
+        f.fail_rate = 0.0
+        if f.partition_id not in registered:
+            ws.register(f)
+    ws.drain()
+    for f in feeds:
+        # order + gap-free: the dispatched sequence IS cursor order
+        assert f.dispatched == list(range(len(f.dispatched))), (
+            f"feed {f.partition_id} order/gap violation"
+        )
+        # nothing lost or stuck: everything available was dispatched AND
+        # collected despite failures, flaps and backpressure
+        assert len(f.dispatched) == f.available
+        assert f.collected == f.available, (
+            f"feed {f.partition_id}: {f.collected}/{f.available} collected"
+        )
+
+
+class TestSchedulerChaosFixedSeed:
+    def test_packing_invariants_under_fault_injected_feeds(self):
+        """Fixed-seed scheduler-core chaos: dispatch failures + leader
+        flaps + a deep feed next to sparse pipelined ones."""
+        _scheduler_chaos_round(
+            SEED,
+            feeds_spec=[(0.1, False), (0.05, True), (0.0, True), (0.1, False)],
+        )
+
+    def test_wedged_partition_backpressure_never_stalls_others(self):
+        """A pipelined feed pinned at its in-flight cap (its collects are
+        deferred to the scheduler's own unblocking path) must not stop
+        the OTHER feeds from fully draining in the same waves."""
+        from zeebe_tpu.scheduler import WaveScheduler
+
+        class SlowFeed:
+            """Deep pipelined backlog: always has more to take."""
+
+            partition_id = 0
+
+            def __init__(self):
+                self.cursor = 0
+
+            def backlog(self):
+                return 100_000 - self.cursor
+
+            def take(self, limit):
+                n = min(limit, 100_000 - self.cursor)
+                out = list(range(self.cursor, self.cursor + n))
+                self.cursor += n
+                return out
+
+            def dispatch(self, records):
+                return list(records), 0.0, 0.0
+
+            def collect(self, pending):
+                return 0.0, 0.0
+
+            def rewind(self, position):
+                self.cursor = min(self.cursor, position)
+
+            def tick(self):
+                pass
+
+        class SparseFeed(SlowFeed):
+            partition_id = 1
+
+            def __init__(self):
+                super().__init__()
+                self.total = 40
+                self.dispatched = 0
+
+            def backlog(self):
+                return self.total - self.cursor
+
+            def take(self, limit):
+                n = min(limit, self.total - self.cursor)
+                out = list(range(self.cursor, self.cursor + n))
+                self.cursor += n
+                return out
+
+            def dispatch(self, records):
+                self.dispatched += len(records)
+                return None, 0.0, 0.0
+
+        ws = WaveScheduler(wave_size=32, quantum=8, backpressure_limit=32)
+        slow, sparse = SlowFeed(), SparseFeed()
+        ws.register(slow)
+        ws.register(sparse)
+        ws.drain(max_records=2048)
+        assert sparse.dispatched == 40, "sparse feed starved by wedged one"
+
+    def test_crash_stop_multi_partition_no_acked_loss(self, tmp_path):
+        """Crash-stop the broker mid-multi-partition traffic under the
+        shared-wave drain: every ACKED create survives restart on its own
+        partition, cursors resume gap-free (traffic completes on both
+        partitions), and the committed logs replay deterministically."""
+        harness = ChaosHarness(str(tmp_path), n_brokers=1, partitions=2)
+        client = None
+        try:
+            harness.await_leaders()
+            broker = harness.brokers["b0"]
+            assert broker.wave_scheduler is not None
+            client = harness.client()
+            client.deploy_model(order_process())
+            done = []
+            worker = client.open_job_worker(
+                "payment-service",
+                lambda pid, rec: done.append(pid) or {"paid": True},
+            )
+            acked = {0: [], 1: []}
+            for i in range(6):
+                pid = i % 2
+                rsp = client.create_instance(
+                    "order-process", partition_id=pid
+                )
+                acked[pid].append(rsp.value.workflow_instance_key)
+            assert wait_until(lambda: len(done) >= 6, timeout=30), done
+            worker.close()
+            client.close()
+            client = None
+
+            harness.crash("b0")
+            harness.restart("b0")
+            harness.await_leaders()
+            broker = harness.brokers["b0"]
+            # invariant 1 per partition: acked creates are in THEIR
+            # partition's recovered log, in issue order
+            from zeebe_tpu.protocol.enums import RecordType, ValueType
+            from zeebe_tpu.protocol.intents import (
+                WorkflowInstanceIntent as WI,
+            )
+
+            for pid, keys in acked.items():
+                log = broker.partitions[pid].log
+                created = [
+                    r.value.workflow_instance_key
+                    for r in log.reader(0)
+                    if r.metadata.value_type == ValueType.WORKFLOW_INSTANCE
+                    and r.metadata.record_type == RecordType.EVENT
+                    and r.metadata.intent == int(WI.CREATED)
+                ]
+                for key in keys:
+                    assert key in created, (
+                        f"acked instance {key} lost on partition {pid}"
+                    )
+                assert [k for k in created if k in keys] == keys, (
+                    f"partition {pid} lost issue order"
+                )
+            # cursors resumed: new traffic completes on both partitions
+            client = harness.client()
+            done2 = []
+            worker = client.open_job_worker(
+                "payment-service",
+                lambda pid, rec: done2.append(pid) or {"paid": True},
+            )
+            client.create_instance("order-process", partition_id=0)
+            client.create_instance("order-process", partition_id=1)
+            assert wait_until(lambda: len(done2) >= 2, timeout=30), done2
+            assert set(done2) == {0, 1}
+            worker.close()
+            _assert_oracle_parity(harness)
+        finally:
+            if client is not None:
+                client.close()
+            harness.close()
+
+    def test_leader_failover_scheduler_resumes(self, tmp_path):
+        """Failover under seeded network jitter with the scheduler
+        draining: the new leader's feed picks up at the replayed cursor
+        and traffic completes (the shared-wave analogue of invariant 3's
+        failover case)."""
+        plane = FaultPlane(seed=SEED)
+        plane.set_rule(delay_ms=0, delay_jitter_ms=3)
+        harness = ChaosHarness(str(tmp_path), n_brokers=3, plane=plane)
+        client = None
+        try:
+            harness.await_leaders()
+            client = harness.client()
+            client.deploy_model(order_process())
+            done = []
+            worker = client.open_job_worker(
+                "payment-service",
+                lambda pid, rec: done.append(rec.key) or {"paid": True},
+            )
+            client.create_instance("order-process")
+            client.create_instance("order-process")
+            assert wait_until(lambda: len(done) >= 2, timeout=30), done
+
+            old = harness.leader_of(0)
+            harness.crash(old.node_id)
+            assert wait_until(
+                lambda: harness.leader_of(0) is not None, timeout=30
+            )
+            new_leader = harness.leader_of(0)
+            assert new_leader.wave_scheduler is not None
+            assert wait_until(
+                lambda: new_leader.repository.latest("order-process")
+                is not None,
+                timeout=20,
+            )
+            client.create_instance("order-process")
+            assert wait_until(lambda: len(done) >= 3, timeout=30), done
+            worker.close()
+            _assert_oracle_parity(harness)
+        finally:
+            if client is not None:
+                client.close()
+            harness.close()
+
+
+@pytest.mark.slow
+class TestSchedulerChaosRandomized:
+    @pytest.mark.parametrize("seed", [11, 12, 13, 14, 15])
+    def test_packing_invariants_random_seeds(self, seed):
+        import random
+
+        rng = random.Random(seed)
+        spec = [
+            (rng.choice((0.0, 0.05, 0.15)), rng.random() < 0.5)
+            for _ in range(rng.randint(2, 6))
+        ]
+        _scheduler_chaos_round(seed, feeds_spec=spec, rounds=120)
+
+
+# ---------------------------------------------------------------------------
 # randomized sweep (slow): many seeds, probabilistic faults
 # ---------------------------------------------------------------------------
 

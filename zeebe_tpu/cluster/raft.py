@@ -206,11 +206,38 @@ class Raft(Actor):
             for _records, future in batch:
                 future.complete_exceptionally(RuntimeError("not leader"))
             return
-        merged: List = []
+        from zeebe_tpu.protocol.columnar import ColumnarBatch, MixedBatch
+
+        term = self.persistent.term
+        columnar = False
         for records, _future in batch:
-            for record in records:
-                record.raft_term = self.persistent.term
-            merged.extend(records)
+            if isinstance(records, ColumnarBatch):
+                # device-emission follow-ups arrive as a lazy batch: the
+                # term stamps onto the COLUMN (lazy rows pick it up at
+                # materialization), never forcing a row build here
+                records.set_raft_term(term)
+                columnar = True
+            else:
+                for record in records:
+                    record.raft_term = term
+        if not columnar:
+            merged: List = []
+            for records, _future in batch:
+                merged.extend(records)
+        elif len(batch) == 1:
+            merged = batch[0][0]
+        else:
+            # a coalesced group with a columnar member: merge the groups'
+            # tail ENTRIES (real rows + lazy refs) in call order — the
+            # combined batch still encodes in one pass, rows stay lazy
+            entries: List = []
+            for records, _future in batch:
+                if isinstance(records, ColumnarBatch):
+                    entries.extend(records.log_entries())
+                else:
+                    entries.extend(records)
+            merged = MixedBatch(entries)
+        group_sizes = [len(records) for records, _future in batch]
         try:
             last = self.log.append(merged, commit=False)
             self.log.flush()  # ONE durable fsync for the whole group
@@ -229,8 +256,13 @@ class Raft(Actor):
         self.match_position[self.node_id] = last
         self._maybe_commit()
         self._replicate_all()
-        for records, future in batch:
-            future.complete(records[-1].position if records else last)
+        # positions are dense over the merged group: each caller's last
+        # position derives from its slice, with no row materialization
+        first = last - len(merged) + 1 if len(merged) else last + 1
+        end = 0
+        for (records, future), size in zip(batch, group_sizes):
+            end += size
+            future.complete(first + end - 1 if size else last)
 
     # membership ops retry/forward for this long before giving up — a
     # leadership flap mid-call must not surface "not leader" to callers

@@ -231,6 +231,20 @@ class ClusterClient:
                     self._leaders.pop(partition, None)
                 pause()
                 continue
+            if msg.get("t") == "error" and msg.get("code") == "RESOURCE_EXHAUSTED":
+                # admission shed (broker overloaded or this connection's
+                # in-flight bound hit): RETRYABLE by contract — back off
+                # by the broker's hint and try again on the SAME leader
+                # (shedding is load, not a leadership signal). Still burns
+                # a retry so a permanently saturated broker fails the
+                # command with history instead of spinning out the clock.
+                last_error = f"RESOURCE_EXHAUSTED ({msg.get('reason', '')})"
+                failures += 1
+                retry_ms = max(1, int(msg.get("retry_ms", 50)))
+                time.sleep(
+                    min(pause_cap, retry_ms / 1000.0 * (1 << min(failures, 6)))
+                )
+                continue
             last_error = str(msg)
             failures += 1
             pause()

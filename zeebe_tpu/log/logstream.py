@@ -352,6 +352,9 @@ class LogStream:
             records.assign_positions(first_position, ts)
             buf, offsets = codec.encode_columnar(records)
             self._records.extend(records.log_entries())
+            # response/push-relevant rows that are already materialized
+            # get their just-encoded frame cached, like the list path
+            records.cache_frames(buf, offsets)
         else:
             n = len(records)
             for i, record in enumerate(records):

@@ -96,7 +96,8 @@ class ColumnarBatch:
     building full rows."""
 
     __slots__ = ("n", "_cols", "_rows", "_materializer", "_values",
-                 "_value_bytes", "_stamped")
+                 "_value_bytes", "_stamped", "_value_builder",
+                 "device_source")
 
     def __init__(
         self,
@@ -104,16 +105,24 @@ class ColumnarBatch:
         cols: Optional[Dict[str, list]] = None,
         materializer: Optional[Callable[[int], Record]] = None,
         values: Optional[list] = None,
+        value_builder: Optional[Callable[[int], object]] = None,
     ):
         self.n = n
         self._cols: Dict[str, list] = dict(cols or {})
         self._rows: List[Optional[Record]] = [None] * n
         self._materializer = materializer
         self._values = values
+        # builds just row i's RecordValue (no Record/metadata wrapper) —
+        # the append-edge encode path for lazy device emissions
+        self._value_builder = value_builder
         self._value_bytes: Optional[List[Optional[bytes]]] = None
         # columns assigned after construction (log append stamps positions
         # and timestamps) that must overrule the materializer's output
         self._stamped: set = set()
+        # set by the device readback decode: (host column arrays, scalar
+        # column lists, meta epoch) — lets the engine re-STAGE a lazy row
+        # straight from these columns (see TpuPartitionEngine)
+        self.device_source = None
 
     # -- construction -------------------------------------------------------
     @classmethod
@@ -240,13 +249,66 @@ class ColumnarBatch:
         row = self._rows[i]
         if row is not None:
             value = row.value
-        elif self._values is not None:
-            value = self._values[i]
         else:
-            value = self.row(i).value
+            # values list / value builder / full-row fallback, in order
+            value = self.value_of(i)
         encoded = value.encode() if value is not None else msgpack.EMPTY_DOCUMENT
         self._value_bytes[i] = encoded
         return encoded
+
+    def value_of(self, i: int):
+        """Row ``i``'s ``RecordValue`` WITHOUT building the full row when
+        the batch carries values (or a value builder) — the device
+        emission path appends values-only rows lazily."""
+        row = self._rows[i]
+        if row is not None:
+            return row.value
+        if self._values is not None and self._values[i] is not None:
+            value = self._values[i]
+            if callable(value):
+                # lazily-built value (device emission): build once, cache
+                value = value()
+                self._values[i] = value
+            return value
+        if self._value_builder is not None:
+            if self._values is None:
+                self._values = [None] * self.n
+            value = self._value_builder(i)
+            self._values[i] = value
+            return value
+        return self.row(i).value
+
+    def device_ref(self, i: int):
+        """``(source batch, row)`` when row ``i`` can be re-staged for the
+        device straight from readback columns, else None."""
+        if self.device_source is not None:
+            return (self, i)
+        return None
+
+    def cache_frames(self, buf, offsets: List[int]) -> None:
+        """Post-append frame caching for already-materialized rows that
+        are response/push-relevant (the broker re-encodes exactly these
+        for client marshalling moments later) — mirrors the list-append
+        path's caching; lazy rows skip (no object to hang the frame on)."""
+        total = len(buf)
+        n = self.n
+        for i, row in enumerate(self._rows):
+            if row is None:
+                continue
+            md = row.metadata
+            if md.request_id >= 0 or md.request_stream_id >= 0:
+                end = offsets[i + 1] if i + 1 < n else total
+                row._frame = (row.position, bytes(buf[offsets[i]:end]))
+
+    def set_raft_term(self, term: int) -> None:
+        """Stamp the raft term on every row (the group-commit drain does
+        this per record on list appends); lazy rows pick it up at
+        materialization via the stamped column."""
+        self._cols["raft_term"] = [term] * self.n
+        self._stamped.add("raft_term")
+        for row in self._rows:
+            if row is not None:
+                row.raft_term = term
 
     # -- sequence protocol --------------------------------------------------
     def __len__(self) -> int:
@@ -336,6 +398,12 @@ class RecordsView:
         entries = self._entries
         return RecordsView([entries[i] for i in indices])
 
+    def entries(self) -> list:
+        """The raw tail entries (``Record`` objects or lazy
+        ``(batch, idx)`` refs) — consumers that can act on refs without
+        materializing (the wave drains' apply loops) read these."""
+        return self._entries
+
     # -- sequence protocol --------------------------------------------------
     def _materialize(self, e) -> Record:
         if type(e) is tuple:
@@ -356,3 +424,88 @@ class RecordsView:
 
     def rows(self) -> List[Record]:
         return [self._materialize(e) for e in self._entries]
+
+
+class MixedBatch(ColumnarBatch):
+    """A log-appendable batch over MIXED entries — real ``Record`` objects
+    interleaved with lazy ``(batch, idx)`` refs, in append order.
+
+    This is how device-emission follow-ups reach ``LogStream.append``
+    without materializing: the wave drain's merged ``written`` list holds
+    eager rows for records that needed objects (responses, pushes, sends'
+    siblings) and lazy refs into the emission batch for plain appends.
+    Columns read through to the backing batch; refs materialize only on a
+    positional row read (counted by the BACKING batch — not double-counted
+    here)."""
+
+    __slots__ = ("_entries",)
+
+    def __init__(self, entries: list):
+        super().__init__(len(entries))
+        self._entries = list(entries)
+        rows = self._rows
+        for i, e in enumerate(self._entries):
+            if type(e) is not tuple:
+                rows[i] = e
+
+    def _build_col(self, name: str) -> list:
+        meta = name in (
+            "record_type", "value_type", "intent", "rejection_type",
+            "rejection_reason", "request_id", "request_stream_id",
+            "incident_key",
+        )
+        int_cast = name in (
+            "record_type", "value_type", "intent", "rejection_type",
+        )
+        out = []
+        for i, e in enumerate(self._entries):
+            row = self._rows[i]
+            if row is not None:
+                if meta:
+                    v = getattr(row.metadata, name)
+                    out.append(int(v) if int_cast else v)
+                else:
+                    out.append(getattr(row, name))
+            else:
+                out.append(e[0].col(name)[e[1]])
+        return out
+
+    def row(self, i: int) -> Record:
+        record = self._rows[i]
+        if record is None:
+            e = self._entries[i]
+            record = e[0].row(e[1])  # the backing batch counts + caches
+            for name in self._stamped:
+                if name == "position":
+                    record.position = self._cols["position"][i]
+                elif name == "timestamp":
+                    if record.timestamp < 0:
+                        record.timestamp = self._cols["timestamp"][i]
+                elif name == "raft_term":
+                    record.raft_term = self._cols["raft_term"][i]
+            self._rows[i] = record
+        return record
+
+    def value_bytes(self, i: int) -> bytes:
+        row = self._rows[i]
+        if row is None:
+            e = self._entries[i]
+            return e[0].value_bytes(e[1])
+        return super().value_bytes(i)
+
+    def device_ref(self, i: int):
+        e = self._entries[i]
+        if type(e) is tuple:
+            return e[0].device_ref(e[1])
+        return None
+
+
+def as_log_batch(written):
+    """A drain's merged ``written`` channel → what ``LogStream.append``
+    (and ``raft.append``) consume: the list itself when every entry is a
+    real ``Record`` (the host path — zero overhead), else a
+    :class:`MixedBatch` preserving order and laziness."""
+    for e in written:
+        if type(e) is tuple:
+            return MixedBatch(written)
+    return written

@@ -523,7 +523,13 @@ def stamp_source_positions(records: List["Record"], source_position: int) -> Non
     """Fill in the source position on follow-up records that don't carry one.
     Recovery's replay boundary is ``max(source_record_position)`` over the
     log (reference lastSourceEventPosition) — every written follow-up must
-    link back to the record whose processing produced it."""
+    link back to the record whose processing produced it.
+
+    Lazy columnar refs (``(batch, idx)`` tuples from the device emission
+    path) are skipped without materializing: the engine stamped their
+    source column at emit — emission rows always carry a real source."""
     for record in records:
+        if type(record) is tuple:
+            continue
         if record.source_record_position < 0:
             record.source_record_position = source_position

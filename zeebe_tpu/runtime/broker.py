@@ -21,6 +21,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from zeebe_tpu.engine.interpreter import PartitionEngine, WorkflowRepository
 from zeebe_tpu.log import LogStream, SegmentedLogStorage
+from zeebe_tpu.protocol.columnar import as_log_batch
 from zeebe_tpu.log.snapshot import SnapshotController, SnapshotMetadata, SnapshotStorage
 from zeebe_tpu.protocol.enums import RecordType, ValueType
 from zeebe_tpu.protocol.intents import SubscriberIntent, SubscriptionIntent
@@ -118,6 +119,72 @@ class TopicSubscriptionHandle:
             self.broker._topic_subscriptions.remove(self)
 
 
+def _entry_position(entry) -> int:
+    """Log position of a tail entry without materializing a lazy ref."""
+    if type(entry) is tuple:
+        return entry[0].col("position")[entry[1]]
+    return entry.position
+
+
+def _entry_record(entry):
+    """The entry as a real ``Record`` (materializes lazy refs — only the
+    record-listener tap pays this)."""
+    if type(entry) is tuple:
+        return entry[0].row(entry[1])
+    return entry
+
+
+class _BrokerFeed:
+    """In-process partition → scheduler feed. Dispatch is synchronous
+    (``engine.process_wave``) and applies PER RECORD in cursor order, so
+    each partition's log bytes are independent of how the shared waves
+    were packed — bit-identical to the per-partition drain."""
+
+    def __init__(self, broker: "Broker", partition: Partition):
+        self.broker = broker
+        self.partition = partition
+        self.partition_id = partition.partition_id
+
+    def backlog(self) -> int:
+        p = self.partition
+        return max(0, p.log.commit_position - p.next_read_position + 1)
+
+    def take(self, limit: int):
+        p = self.partition
+        view = p.log.committed_view(p.next_read_position, limit)
+        if not len(view):
+            return []
+        p.next_read_position = view.positions()[-1] + 1
+        return view
+
+    def dispatch(self, records):
+        import time as _time
+
+        t0 = _time.perf_counter()
+        p = self.partition
+        results = p.engine.process_wave(records)
+        entries = (
+            records.entries() if hasattr(records, "entries") else records
+        )
+        for entry, result in zip(entries, results):
+            self.broker._apply_result(p, entry, result)
+        host_s, device_s = getattr(p.engine, "last_wave_seconds", (None, 0.0))
+        if host_s is None:
+            host_s, device_s = _time.perf_counter() - t0, 0.0
+        return None, host_s, device_s
+
+    def collect(self, pending):  # synchronous dispatch: nothing pending
+        return 0.0, 0.0
+
+    def rewind(self, position: int) -> None:
+        if position >= 0:
+            p = self.partition
+            p.next_read_position = min(p.next_read_position, position)
+
+    def tick(self) -> None:  # Broker.tick drives sweeps explicitly
+        pass
+
+
 class Broker:
     """In-process broker (reference: EmbeddedBrokerRule-style single JVM)."""
 
@@ -144,6 +211,11 @@ class Broker:
         self._topic_subscriptions: List[TopicSubscriptionHandle] = []
         self._rr_partition = 0
         self._exporter_specs = list(exporters or [])
+        # shared-wave drain (zeebe_tpu/scheduler): the SAME scheduler the
+        # cluster broker runs, so tier-1 covers its packing/dispatch path;
+        # False restores the per-partition baseline the A/B compares to
+        self.use_scheduler = True
+        self._scheduler = None
 
         factory = engine_factory or (
             lambda pid: PartitionEngine(
@@ -431,10 +503,64 @@ class Broker:
     # the record-at-a-time baseline.
     wave_size = 256
 
+    def _wave_scheduler(self):
+        """The broker's shared-wave scheduler, feeds registered once and
+        sizing resynced per drain (tests retune ``wave_size`` after
+        construction)."""
+        from zeebe_tpu.scheduler import WaveScheduler
+
+        size = max(1, self.wave_size)
+        scheduler = self._scheduler
+        if scheduler is None:
+            scheduler = WaveScheduler(wave_size=size)
+            for partition in self.partitions:
+                scheduler.register(_BrokerFeed(self, partition))
+            self._scheduler = scheduler
+        if scheduler.wave_size != size:
+            scheduler.wave_size = size
+            scheduler.quantum = max(1, size // 8)
+            scheduler.backpressure_limit = 4 * size
+        return scheduler
+
     def run_until_idle(self, max_iterations: int = 100_000) -> int:
         """Process all partitions until no backlog remains. Returns the number
         of records processed (the StreamProcessorController hot loop,
-        StreamProcessorController.java:296-399, run to quiescence)."""
+        StreamProcessorController.java:296-399, run to quiescence).
+
+        Default mode drains through the shared-wave scheduler — one wave
+        may pack several partitions' committed tails (continuous
+        batching); per-partition apply order is cursor order either way,
+        so each partition's log is bit-identical across modes
+        (``use_scheduler = False`` forces the per-partition baseline)."""
+        if not self.use_scheduler:
+            return self._run_until_idle_unscheduled(max_iterations)
+        scheduler = self._wave_scheduler()
+        processed = 0
+        progress = True
+        while progress:
+            progress = False
+            drained = scheduler.drain(
+                max_records=max_iterations + 1 - processed
+            )
+            processed += drained
+            if processed > max_iterations:
+                raise RuntimeError("broker did not reach quiescence")
+            if drained:
+                progress = True
+            # deliver to topic subscriptions; their handlers may write acks
+            # or commands, which the next pass processes
+            if self._pump_topic_subscriptions():
+                progress = True
+            # exporters tail the freshly committed records; their position
+            # acks are records too and process on the next pass
+            if self._pump_exporters():
+                progress = True
+        return processed
+
+    def _run_until_idle_unscheduled(self, max_iterations: int) -> int:
+        """Per-partition baseline drain (the bench A/B reference): each
+        partition's backlog drains to empty in its own waves before the
+        next partition runs."""
         from zeebe_tpu.runtime.metrics import observe_wave
 
         processed = 0
@@ -461,22 +587,24 @@ class Broker:
                         if processed > max_iterations:
                             raise RuntimeError("broker did not reach quiescence")
                     progress = True
-            # deliver to topic subscriptions; their handlers may write acks
-            # or commands, which the next pass processes
             if self._pump_topic_subscriptions():
                 progress = True
-            # exporters tail the freshly committed records; their position
-            # acks are records too and process on the next pass
             if self._pump_exporters():
                 progress = True
         return processed
 
-    def _apply_result(self, partition: Partition, record: Record, result) -> None:
+    def _apply_result(self, partition: Partition, record, result) -> None:
         """Apply one processed record's outputs — sends, follow-up appends,
         responses, pushes — exactly as the per-record loop did (the engine
         already processed the whole wave; application stays record-major
-        so the log bytes don't depend on the wave size)."""
-        partition.next_read_position = record.position + 1
+        so the log bytes don't depend on the wave size). ``record`` may be
+        a real ``Record`` or a lazy ``(batch, idx)`` tail entry; only the
+        record-listener tap materializes it."""
+        position = _entry_position(record)
+        # monotone: the scheduler feed already advanced the cursor at
+        # take(); the baseline path advances here
+        if position + 1 > partition.next_read_position:
+            partition.next_read_position = position + 1
         for target_pid, send in result.sends:
             # reference: subscription transport → command on the target log.
             # Sends go BEFORE the local follow-up append: once the follow-ups
@@ -487,10 +615,15 @@ class Broker:
             # (dead activity ⇒ rejection; CLOSE removes all matches).
             self.partitions[target_pid].log.append([send])
         if result.written:
-            stamp_source_positions(result.written, record.position)
-            partition.log.append(result.written)
+            stamp_source_positions(result.written, position)
+            partition.log.append(as_log_batch(result.written))
+            cache = partition.engine.records_by_position
             for written in result.written:
-                partition.engine.records_by_position[written.position] = written
+                if type(written) is tuple:
+                    # lazy columnar follow-up: the log-backed cache serves
+                    # position re-reads without materializing it here
+                    continue
+                cache[written.position] = written
         for response in result.responses:
             if response.metadata.request_id >= 0:
                 self._responses[response.metadata.request_id] = response
@@ -499,7 +632,7 @@ class Broker:
             if listener is not None:
                 listener(partition.partition_id, push)
         for listener in self._record_listeners:
-            listener(partition.partition_id, record)
+            listener(partition.partition_id, _entry_record(record))
 
     # -- time-driven side processors ---------------------------------------
     def tick(self) -> None:

@@ -148,6 +148,12 @@ class PartitionServer:
         self.is_leader = False
         self._processing_scheduled = False
         self._fetch_attempted = False  # one fetch try per parked record
+        # wave-scheduler feed state: parked while a workflow fetch is in
+        # flight (take() yields nothing; the other partitions keep
+        # draining — the whole point of per-partition backpressure)
+        self._parked = False
+        self._fetch_candidate = None  # head record awaiting a fetch check
+        self._due_probe = None  # in-flight async deadline probe (device)
         # snapshot-while-serving: at most ONE take in flight per partition
         # (capture happens on the broker actor; commit on a worker thread)
         self._snapshot_inflight = False
@@ -214,6 +220,10 @@ class PartitionServer:
             self.engine.process(record)
             self.next_read_position = record.position + 1
         self.is_leader = True
+        if self.broker.wave_scheduler is not None:
+            # this partition's committed tail now feeds the broker's
+            # shared waves (the scheduler is the single place waves form)
+            self.broker.wave_scheduler.register(self)
         self._install_exporters()
         self.broker.on_partition_leader(self.partition_id, term)
         if self.partition_id == 0:
@@ -232,6 +242,11 @@ class PartitionServer:
     def _uninstall_leader(self) -> None:
         self.is_leader = False
         self.engine = None
+        if self.broker.wave_scheduler is not None:
+            self.broker.wave_scheduler.unregister(self.partition_id)
+        self._parked = False
+        self._fetch_candidate = None
+        self._due_probe = None
         # topic pushers are LEADER-LOCAL services (reference: push
         # processors are installed/removed with leadership); a pusher
         # surviving a leadership flap raced the new leader's pusher and
@@ -325,10 +340,167 @@ class PartitionServer:
 
     # -- the processing loop (StreamProcessorController hot loop) ----------
     def _schedule_processing(self) -> None:
-        if not self.is_leader or self._processing_scheduled:
+        if not self.is_leader:
+            return
+        if self.broker.wave_scheduler is not None:
+            # shared-wave mode: one drain job per broker packs ALL leader
+            # partitions' committed tails (zeebe_tpu/scheduler/)
+            self.broker._schedule_drain()
+            return
+        if self._processing_scheduled:
             return
         self._processing_scheduled = True
         self.broker.actor_control.run(self._process_committed)
+
+    # -- wave-scheduler feed surface (scheduler.PartitionFeed) -------------
+    # The scheduler packs this partition's committed tail into SHARED
+    # waves: take() consumes at the cursor (one-lock committed_view span),
+    # dispatch/collect ride the engine's existing double-buffered wave
+    # pipeline, and apply stays per partition — the log is bit-identical
+    # to the per-partition drain (tests/test_scheduler.py pins it).
+    def backlog(self) -> int:
+        if not self.is_leader:
+            return 0
+        return max(0, self.log.commit_position - self.next_read_position + 1)
+
+    def take(self, limit: int):
+        from zeebe_tpu.protocol.enums import RecordType, ValueType
+        from zeebe_tpu.protocol.intents import WorkflowInstanceIntent as WI
+
+        if not self.is_leader or self.engine is None or self._parked:
+            return []
+        view = self.log.committed_view(self.next_read_position, limit)
+        n = len(view)
+        if not n:
+            return []
+        # the one-fetch-per-parked-record latch exempts EXACTLY the head
+        # record (the one it parked on — consumed unconditionally so the
+        # engine can reject it); records behind it still get their own
+        # fetch scan, matching the old per-record latch reset
+        start = 0
+        if self._fetch_attempted:
+            self._fetch_attempted = False
+            start = 1
+        cut = n
+        if self.partition_id != 0:
+            # workflow-fetch scan over the COLUMNS: only WI CREATE
+            # commands can park, and those are client-born real rows —
+            # nothing lazy materializes here
+            vts = view.value_types()
+            rts = view.record_types()
+            its = view.intents()
+            wi = int(ValueType.WORKFLOW_INSTANCE)
+            cmd = int(RecordType.COMMAND)
+            create = int(WI.CREATE)
+            for i in range(start, n):
+                if vts[i] == wi and rts[i] == cmd and its[i] == create:
+                    record = view[i]
+                    if self._needs_workflow_fetch(record):
+                        # stop BEFORE the parking record; the prefix
+                        # still packs (a DEPLOYMENT inside it may provide
+                        # the workflow — re-checked after the drain)
+                        cut = i
+                        self._fetch_candidate = record
+                        break
+        if cut == 0:
+            return []
+        positions = view.positions()
+        self.next_read_position = positions[cut - 1] + 1
+        if cut == n:
+            return view
+        return view.select(list(range(cut)))
+
+    def dispatch(self, records):
+        """Feed one shared-wave segment to the engine. Pipelined engines
+        return the pending wave (collected later while the device computes
+        the next one); synchronous engines process AND apply inline."""
+        import time as _time
+
+        dispatch = getattr(self.engine, "dispatch_wave", None)
+        if dispatch is None:
+            t0 = _time.perf_counter()
+            result = self.engine.process_batch(records)
+            self._apply_chunk(records, result)
+            return None, _time.perf_counter() - t0, 0.0
+        return dispatch(records), 0.0, 0.0
+
+    def collect(self, pending):
+        from zeebe_tpu.engine.interpreter import ProcessingResult
+
+        merged = ProcessingResult.merged(self.engine.collect_wave(pending))
+        self._apply_chunk(pending.records, merged)
+        return pending.host_seconds, pending.device_seconds
+
+    def rewind(self, position: int) -> None:
+        if position >= 0:
+            self.next_read_position = min(self.next_read_position, position)
+
+    def maybe_start_fetch(self) -> None:
+        """After a drain settles: if take() stopped on a record whose
+        workflow is still unknown, park this feed and fetch — the other
+        partitions keep packing waves meanwhile."""
+        record = self._fetch_candidate
+        if record is None:
+            return
+        self._fetch_candidate = None
+        if not self.is_leader:
+            return
+        if not self._needs_workflow_fetch(record):
+            # a deployment drained in the prefix provided it meanwhile
+            self.broker._schedule_drain()
+            return
+        self._parked = True
+        self.broker.fetch_workflow(
+            record.value.bpmn_process_id,
+            record.value.workflow_key,
+            on_done=self._resume_after_fetch,
+        )
+
+    def _resume_after_fetch(self) -> None:
+        # one attempt per parked record: if the fetch produced nothing the
+        # engine now processes the command and rejects it (workflow not
+        # found), instead of fetch-looping forever
+        self._fetch_attempted = True
+        self._parked = False
+        self.broker._schedule_drain()
+
+    def tick(self) -> None:
+        """Deadline/TTL sweep for this partition (reference periodic actor
+        jobs). Engines exposing an async due-probe are polled WITHOUT
+        blocking: the tick only pays the device sweep when a ready probe
+        says something is due; host-oracle deadlines are cheap dict scans
+        swept unconditionally. The resulting commands append through raft
+        and re-enter the shared waves as committed records."""
+        if not self.is_leader or self.engine is None:
+            return
+        from zeebe_tpu.tpu.engine import PROBE_DEADLINES, PROBE_JOB_BACKLOG
+
+        engine = self.engine
+        commands: List[Record] = []
+        probe_fn = getattr(engine, "deadlines_due_probe", None)
+        if probe_fn is not None:
+            commands += engine.host_deadline_commands()
+            commands += engine.backlog_activations()
+            pending = self._due_probe
+            mask = 0
+            if pending is None:
+                self._due_probe = probe_fn()
+            elif pending.is_ready():
+                mask = int(pending)
+                self._due_probe = probe_fn()
+            if mask & PROBE_DEADLINES:
+                commands += engine.device_deadline_commands()
+            if mask & PROBE_JOB_BACKLOG:
+                commands += engine.device_backlog_activations()
+        else:
+            commands += (
+                engine.check_job_deadlines()
+                + engine.check_timer_deadlines()
+                + engine.check_message_ttls()
+                + engine.backlog_activations()
+            )
+        if commands:
+            self.raft.append(commands)
 
     # committed records drain into the engine in batches: the device
     # engine's throughput comes from SIMD batches (one kernel dispatch per
@@ -451,8 +623,12 @@ class PartitionServer:
             # every follow-up was source-stamped per record by the engine;
             # positions are assigned on the raft actor at append time, and
             # the records register into records_by_position when the
-            # processing loop reads them back as committed
-            self.raft.append(result.written)
+            # processing loop reads them back as committed. Device
+            # emissions may ride as LAZY columnar refs — as_log_batch
+            # keeps them lazy all the way into the log tail.
+            from zeebe_tpu.protocol.columnar import as_log_batch
+
+            self.raft.append(as_log_batch(result.written))
         for response in result.responses:
             self.broker.send_client_response(response)
         for target_pid, send in result.sends:
@@ -460,8 +636,12 @@ class PartitionServer:
         for subscriber_key, push in result.pushes:
             self.broker.push_to_subscriber(subscriber_key, self.partition_id, push)
         self.broker.metrics_events_processed.inc(len(records))
-        for record in records:
-            self._maybe_orchestrate_topic(record)
+        if self.partition_id == 0:
+            # topic orchestration lives on the system partition only; the
+            # guard also keeps lazy columnar rows on data partitions from
+            # materializing just to be inspected and discarded
+            for record in records:
+                self._maybe_orchestrate_topic(record)
 
     def _maybe_orchestrate_topic(self, record) -> None:
         from zeebe_tpu.protocol.enums import RecordType, ValueType
@@ -647,6 +827,8 @@ class PartitionServer:
             self._snapshot_inflight = False
 
     def close(self) -> None:
+        if self.broker.wave_scheduler is not None:
+            self.broker.wave_scheduler.unregister(self.partition_id)
         if self.exporter_director is not None:
             self.exporter_director.close()
             self.exporter_director = None
@@ -758,8 +940,42 @@ class ClusterBroker(Actor):
         # client-command dedup: cid → response future of the first append
         # (bounded FIFO; see _handle_command)
         self._cmd_dedup: Dict[str, ActorFuture] = {}
-        # partition id → in-flight device due-probe (see _tick_engines)
-        self._due_probes: Dict[int, object] = {}
+
+        # continuous-batching wave scheduler: ONE drain job per broker
+        # packs committed records from ALL leader partitions into shared
+        # device waves (cfg.scheduler.enabled=false restores the
+        # per-partition drain — the bench's A/B baseline)
+        from zeebe_tpu.scheduler import (
+            AdmissionConfig,
+            AdmissionController,
+            WaveScheduler,
+        )
+
+        sc = cfg.scheduler
+        self.wave_scheduler = (
+            WaveScheduler(
+                wave_size=sc.wave_size,
+                quantum=sc.quantum or None,
+                backpressure_limit=sc.backpressure_limit or None,
+            )
+            if sc.enabled
+            else None
+        )
+        self._drain_scheduled = False
+        # gateway admission: bounded in-flight per client connection +
+        # queue-depth shed, checked on the transport IO thread BEFORE a
+        # command touches the broker actor (shed-before-collapse)
+        ad = cfg.admission
+        self.admission = AdmissionController(
+            AdmissionConfig(
+                enabled=ad.enabled,
+                max_inflight_per_connection=ad.max_inflight_per_connection,
+                queue_depth_high=ad.queue_depth_high,
+                retry_after_ms=ad.retry_after_ms,
+            ),
+            queue_depth_probe=self._queue_depth,
+        )
+        self._admission_conns: set = set()
         # request ids are stamped INTO replicated records and responses
         # are matched by id alone on whichever broker processes the
         # record — so the id space must not collide across brokers (a
@@ -980,6 +1196,44 @@ class ClusterBroker(Actor):
             int(payload.get("term", 0)),
         )
 
+    # -- shared-wave drain (scheduler mode) ---------------------------------
+    def _schedule_drain(self) -> None:
+        """One drain job per burst of commits, broker-wide: every leader
+        partition's committed tail packs into the same shared waves."""
+        if self.wave_scheduler is None or self._drain_scheduled:
+            return
+        self._drain_scheduled = True
+        self.actor_control.run(self._drain_committed)
+
+    def _drain_committed(self) -> None:
+        self._drain_scheduled = False
+        if self.wave_scheduler is None:
+            return
+        self.wave_scheduler.drain()
+        for server in list(self.partitions.values()):
+            if server.is_leader:
+                # parked-record fetches start only once every in-flight
+                # wave collected (a DEPLOYMENT inside the drain may have
+                # provided the workflow)
+                server.maybe_start_fetch()
+                server.pump_topic_subscriptions()
+
+    def _queue_depth(self) -> int:
+        """Admission probe: committed records awaiting the drain (plus
+        dispatched-but-unapplied, in scheduler mode) plus responses
+        awaiting processing. Reads plain ints cross-thread — approximate
+        by design (a watermark, not an invariant)."""
+        depth = len(self._pending_responses)
+        if self.wave_scheduler is not None:
+            return depth + self.wave_scheduler.backlog()
+        for server in list(self.partitions.values()):
+            depth += server.backlog()
+        return depth
+
+    def _forget_admission(self, conn_key: int) -> None:
+        self.admission.forget_connection(conn_key)
+        self._admission_conns.discard(conn_key)
+
     # -- client API (reference ClientApiMessageHandler) ---------------------
     def _on_client_request(self, payload: bytes, conn):
         try:
@@ -988,7 +1242,27 @@ class ClusterBroker(Actor):
             return None
         t = msg.get("t")
         if t == "command":
+            # admission runs HERE, on the transport thread, before the
+            # command can queue behind the broker actor: overload is
+            # answered with a retryable rejection in O(1), never with
+            # queue time (shed-before-collapse)
+            conn_key = getattr(conn, "key", None) if conn is not None else None
+            if conn_key is not None:
+                reason = self.admission.try_admit(conn_key)
+                if reason is not None:
+                    return msgpack.pack(self.admission.rejection_body(reason))
+                if conn_key not in self._admission_conns:
+                    self._admission_conns.add(conn_key)
+                    conn.on_close(
+                        lambda k=conn_key: self._forget_admission(k)
+                    )
             result = ActorFuture()
+            if conn_key is not None:
+                # the in-flight slot frees when the response (or error)
+                # completes — every _handle_command path completes it
+                result.on_complete(
+                    lambda _f, k=conn_key: self.admission.release(k)
+                )
             self.actor.run(lambda: self._handle_command(msg, result))
             return result
         if t == "topology":
@@ -2226,47 +2500,13 @@ class ClusterBroker(Actor):
 
     def _tick_engines(self) -> None:
         """Timer/TTL sweeps on leader partitions (reference periodic actor
-        jobs: JobTimeOutStreamProcessor, MessageTimeToLiveChecker).
-
-        The full device sweep transfers whole table columns device→host;
-        over a tunneled TPU every sync costs ~150ms+, and at the 100ms tick
-        rate the blocking sweep starves the broker actor (observed: client
-        requests timing out while the actor sat in np.asarray). Engines
-        exposing an async due-probe are polled WITHOUT blocking: the tick
-        only pays the device sweep when a ready probe says something is
-        due. Host-oracle deadlines (demoted/host-only workflows inside a
-        TPU engine) are cheap dict scans and are swept UNCONDITIONALLY
-        every tick — never gated by the device probe (round-4 regression:
-        gating them meant host timers only fired if an unrelated device
-        deadline happened to be due)."""
-        from zeebe_tpu.tpu.engine import PROBE_DEADLINES, PROBE_JOB_BACKLOG
-
+        jobs: JobTimeOutStreamProcessor, MessageTimeToLiveChecker). The
+        per-partition probe/sweep logic lives in ``PartitionServer.tick``
+        (see its docstring for the async-probe rationale); in shared-wave
+        mode the scheduler drives it through the registered feeds, so the
+        sweep commands enter the same shared waves as client traffic."""
+        if self.wave_scheduler is not None:
+            self.wave_scheduler.tick()
+            return
         for server in self.partitions.values():
-            if not server.is_leader or server.engine is None:
-                continue
-            engine = server.engine
-            commands: List[Record] = []
-            probe_fn = getattr(engine, "deadlines_due_probe", None)
-            if probe_fn is not None:
-                commands += engine.host_deadline_commands()
-                commands += engine.backlog_activations()
-                pending = self._due_probes.get(server.partition_id)
-                mask = 0
-                if pending is None:
-                    self._due_probes[server.partition_id] = probe_fn()
-                elif pending.is_ready():
-                    mask = int(pending)
-                    self._due_probes[server.partition_id] = probe_fn()
-                if mask & PROBE_DEADLINES:
-                    commands += engine.device_deadline_commands()
-                if mask & PROBE_JOB_BACKLOG:
-                    commands += engine.device_backlog_activations()
-            else:
-                commands += (
-                    engine.check_job_deadlines()
-                    + engine.check_timer_deadlines()
-                    + engine.check_message_ttls()
-                    + engine.backlog_activations()
-                )
-            if commands:
-                server.raft.append(commands)
+            server.tick()

@@ -106,6 +106,37 @@ class EngineCfg:
 
 
 @dataclasses.dataclass
+class SchedulerCfg:
+    """Cross-partition continuous-batching wave scheduler
+    (``zeebe_tpu/scheduler/``): committed records from every leader
+    partition on this broker pack into SHARED device waves. ``enabled =
+    false`` restores the per-partition drain (the A/B baseline the bench
+    compares against)."""
+
+    enabled: bool = True
+    wave_size: int = 512  # shared-wave record capacity (= drain chunk)
+    # deficit-round-robin quantum: records of credit per feed per packing
+    # round (0 = wave_size // 8)
+    quantum: int = 0
+    # per-partition cap on dispatched-but-unapplied records; a partition
+    # at the cap is skipped until its apply side catches up (0 = 4 waves)
+    backpressure_limit: int = 0
+
+
+@dataclasses.dataclass
+class AdmissionCfg:
+    """Gateway admission control (shed-before-collapse): commands beyond
+    the per-connection in-flight bound — or arriving while the broker
+    backlog sits above the queue-depth watermark — are rejected with a
+    retryable RESOURCE_EXHAUSTED instead of queueing until timeout."""
+
+    enabled: bool = True
+    max_inflight_per_connection: int = 1024
+    queue_depth_high: int = 8192
+    retry_after_ms: int = 50
+
+
+@dataclasses.dataclass
 class GossipCfg:
     probe_interval_ms: int = 250
     probe_timeout_ms: int = 500
@@ -150,6 +181,8 @@ class BrokerCfg:
     gossip: GossipCfg = dataclasses.field(default_factory=GossipCfg)
     raft: RaftCfg = dataclasses.field(default_factory=RaftCfg)
     engine: EngineCfg = dataclasses.field(default_factory=EngineCfg)
+    scheduler: SchedulerCfg = dataclasses.field(default_factory=SchedulerCfg)
+    admission: AdmissionCfg = dataclasses.field(default_factory=AdmissionCfg)
     topics: List[TopicCfg] = dataclasses.field(default_factory=list)
     exporters: List[ExporterCfg] = dataclasses.field(default_factory=list)
 
@@ -163,6 +196,8 @@ _SECTION_KEYS = {
     "gossip": GossipCfg,
     "raft": RaftCfg,
     "engine": EngineCfg,
+    "scheduler": SchedulerCfg,
+    "admission": AdmissionCfg,
 }
 
 # env overrides (reference Environment: ZEEBE_* wins over the file)
@@ -192,6 +227,16 @@ _ENV_OVERRIDES = {
     ),
     "ZEEBE_ENGINE_TYPE": ("engine", "type", str),
     "ZEEBE_METRICS_PORT": ("metrics", "port", int),
+    "ZEEBE_SCHEDULER_ENABLED": (
+        "scheduler",
+        "enabled",
+        lambda v: v.strip().lower() in ("1", "true", "yes"),
+    ),
+    "ZEEBE_ADMISSION_ENABLED": (
+        "admission",
+        "enabled",
+        lambda v: v.strip().lower() in ("1", "true", "yes"),
+    ),
 }
 
 

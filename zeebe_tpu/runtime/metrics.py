@@ -308,6 +308,61 @@ def observe_wave(
         h["device_s"].inc(device_seconds)
 
 
+# -- shared-wave scheduler instrumentation -----------------------------------
+# The cross-partition wave scheduler (zeebe_tpu/scheduler/) reports each
+# SHARED wave here on top of the plain wave series: how many partitions
+# contributed (the fill-by-traffic-mix view — high fill with many sources
+# is the scheduler doing its job; high fill from one source is just a
+# firehose), plus its own backpressure/shed counters (allocated on first
+# use via count_event / the admission controller).
+_SCHED_HANDLES: dict = {}
+
+
+def _sched_handles() -> dict:
+    if not _SCHED_HANDLES:
+        g = GLOBAL_REGISTRY
+        _SCHED_HANDLES.update(
+            shared_waves=g.counter(
+                "scheduler_shared_waves_total",
+                "Shared waves packed across partitions by the wave scheduler",
+            ),
+            sources=g.gauge(
+                "serving_wave_sources",
+                "Partitions contributing records to the most recent shared "
+                "wave",
+            ),
+            sources_total=g.counter(
+                "scheduler_wave_sources_total",
+                "Sum of contributing partitions over all shared waves "
+                "(mean = this / scheduler_shared_waves_total)",
+            ),
+            sources_mean=g.gauge(
+                "serving_wave_sources_mean",
+                "Mean partitions per shared wave since process start",
+            ),
+        )
+    return _SCHED_HANDLES
+
+
+def observe_shared_wave(
+    records: int,
+    capacity: int,
+    sources: int,
+    host_seconds: float = 0.0,
+    device_seconds: float = 0.0,
+) -> None:
+    """Record one SHARED drain wave (scheduler path): the plain wave
+    series (fill/occupancy/time split) plus the traffic-mix gauges."""
+    observe_wave(records, capacity, host_seconds, device_seconds)
+    h = _sched_handles()
+    h["shared_waves"].inc()
+    h["sources"].set(sources)
+    h["sources_total"].inc(sources)
+    h["sources_mean"].set(
+        h["sources_total"].value / max(h["shared_waves"].value, 1.0)
+    )
+
+
 def render_with_global(registry: MetricsRegistry, now_ms: Optional[int] = None) -> str:
     """A registry's Prometheus dump with the global event counters appended
     (skipped when the registry IS the global one — no duplicate series)."""

@@ -23,6 +23,7 @@ device graph simply covers the compatible subset.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -137,6 +138,51 @@ def _pow2(n: int) -> int:
     return p
 
 
+def _as_record(entry) -> Record:
+    """Materialize a tail entry (real ``Record`` or lazy ``(batch, idx)``
+    ref) — the slow-path escape hatch for host-side inspection."""
+    if type(entry) is tuple:
+        return entry[0].row(entry[1])
+    return entry
+
+
+# frame-field defaults of a fresh Record/metadata (producer_id,
+# incident_key, rejection_type) — the lazy emission batch pre-fills its
+# frame columns with these so encode-from-columns matches what a
+# materialized row would encode
+_FRAME_DEFAULTS = None
+
+
+def _frame_defaults():
+    global _FRAME_DEFAULTS
+    if _FRAME_DEFAULTS is None:
+        md = RecordMetadata()
+        probe = Record(metadata=md)
+        _FRAME_DEFAULTS = (
+            probe.producer_id, md.incident_key, int(md.rejection_type),
+        )
+    return _FRAME_DEFAULTS
+
+
+# rows staged for the device STRAIGHT from readback columns (no Record
+# build) — the counterpart of serving_rows_materialized_total; cached
+# handle, this sits on the staging hot loop
+_staged_columnar_counter = None
+
+
+def _count_staged_columnar(n: int = 1) -> None:
+    global _staged_columnar_counter
+    if _staged_columnar_counter is None:
+        from zeebe_tpu.runtime.metrics import GLOBAL_REGISTRY
+
+        _staged_columnar_counter = GLOBAL_REGISTRY.counter(
+            "serving_rows_staged_columnar_total",
+            "Device rows re-staged straight from emission-batch columns "
+            "(no Record object ever materialized for them)",
+        )
+    _staged_columnar_counter.inc(n)
+
+
 @dataclasses.dataclass
 class _PendingSegment:
     """One dispatched (not yet collected) device segment of a wave."""
@@ -156,11 +202,19 @@ class PendingWave:
     """A wave in flight: dispatched to the device, results not yet
     materialized. The serving loop double-buffers on this — stage/dispatch
     wave N+1 and materialize wave N−1 while the device computes wave N
-    (JAX async dispatch carries the state dependency device-side)."""
+    (JAX async dispatch carries the state dependency device-side).
+
+    ``records`` may be a plain list or a lazy columnar view; ``positions``
+    carries every record's log position so collection never materializes
+    a row just to read it. ``partition_id`` tags the wave's owner — the
+    cross-partition scheduler packs SHARED waves whose per-partition
+    segments each arrive here tagged."""
 
     records: List[Record]
     per_record: List[Optional[ProcessingResult]]
     segments: List[_PendingSegment] = dataclasses.field(default_factory=list)
+    positions: List[int] = dataclasses.field(default_factory=list)
+    partition_id: int = -1
     host_seconds: float = 0.0    # staging + host-routed records + readback
     device_seconds: float = 0.0  # blocked on device outputs at collect
     collected: Optional[List[ProcessingResult]] = None  # one-shot cache
@@ -242,6 +296,14 @@ class TpuPartitionEngine:
         # array part names materialized (device→host) by the last
         # snapshot_state call — the zero-readback proof for tests
         self.last_snapshot_readback: List[str] = []
+        # lazy columnar emissions (ROADMAP item 4, device-path slice):
+        # plain follow-up rows flow to the log as lazy refs into the
+        # readback batch and re-STAGE from its columns — no Record builds
+        # on the hot path. ZB_LAZY_EMISSIONS=0 restores eager rows (A/B)
+        self.lazy_emissions = os.environ.get("ZB_LAZY_EMISSIONS", "1") != "0"
+        # bumped by _recompile: workflow SLOTS in older emission batches
+        # are stale after a redeploy — the staging fast path checks this
+        self._meta_epoch = 0
 
     # -- routing ----------------------------------------------------------
     def partition_for_correlation_key(self, correlation_key: str) -> int:
@@ -279,6 +341,7 @@ class TpuPartitionEngine:
             else:
                 workflows.append(wf)
         self._host_only_keys = host_only
+        self._meta_epoch += 1  # older emission batches' wf slots are stale
         if not workflows:
             self.graph = None
             self._compiled_count = 0
@@ -1372,24 +1435,44 @@ class TpuPartitionEngine:
         segment)."""
         return self.collect_wave(self.dispatch_wave(records))
 
-    def dispatch_wave(self, records: List[Record]) -> PendingWave:
+    def dispatch_wave(self, records) -> PendingWave:
         """Stage + launch a wave WITHOUT reading device outputs back.
         Host-routed records process inline (they mutate host state in
         strict log order); device segments dispatch through the kernel and
         stay pending until ``collect_wave``. The caller may dispatch the
         next wave before collecting this one — the state dependency chains
         on device, so host staging of wave N+1 overlaps device compute of
-        wave N."""
+        wave N.
+
+        ``records`` may be a plain list of ``Record`` objects or a lazy
+        columnar view (``RecordsView`` — the drains' ``committed_view``
+        spans). Routing reads the COLUMNS; a lazy entry that is a device
+        EVENT of a device-resident instance enters its segment as a ref
+        and later stages straight from the emission batch's columns — no
+        ``Record`` ever materializes for it (the columnar plane's
+        device-path slice)."""
         import time as _time
 
         t0 = _time.perf_counter()
-        for record in records:
-            # records_by_position aliases the host oracle's cache (one
-            # shared dict) — a single write covers both readers
-            self.records_by_position[record.position] = record
+        view = records if hasattr(records, "entries") else None
+        entries = list(view.entries()) if view is not None else records
+        n = len(entries)
+        if view is not None:
+            col_vts = view.value_types()
+            col_rts = view.record_types()
+            col_its = view.intents()
+            col_pos = view.positions()
+            col_keys = view.keys()
+        else:
+            col_vts = None
+            col_rts = col_its = col_pos = col_keys = None
 
-        per_record: List[Optional[ProcessingResult]] = [None] * len(records)
-        wave = PendingWave(records=records, per_record=per_record)
+        per_record: List[Optional[ProcessingResult]] = [None] * n
+        wave = PendingWave(
+            records=records, per_record=per_record,
+            partition_id=self.partition_id,
+        )
+        positions = wave.positions
         # segment processing: device rows batch up, but whenever a
         # host-routed record appears the pending device segment DISPATCHES
         # through the kernel first — state mutates in strict log order,
@@ -1424,13 +1507,22 @@ class TpuPartitionEngine:
             )
             host_allocated[0] = False
 
+        def seg_meta(i: int):
+            if col_vts is not None:
+                return col_vts[i], col_rts[i], col_its[i]
+            md = entries[i].metadata
+            return (
+                int(md.value_type), int(md.record_type), int(md.intent),
+            )
+
         def flush() -> None:
             if not pending:
                 return
             push_host_keys()  # device allocations continue after the host's
             seg = self._dispatch_device(
-                [records[i] for i in pending],
-                [records[i].position for i in pending],
+                [entries[i] for i in pending],
+                [positions[i] for i in pending],
+                [seg_meta(i) for i in pending],
             )
             seg.rows = list(pending)
             wave.segments.append(seg)
@@ -1438,20 +1530,46 @@ class TpuPartitionEngine:
             pending.clear()
             self._device_keys_dirty = True
 
-        for i, record in enumerate(records):
-            vt = int(record.metadata.value_type)
-            md = record.metadata
+        for i in range(n):
+            entry = entries[i]
+            lazy = type(entry) is tuple
+            if col_vts is not None:
+                vt, rt, intent = col_vts[i], col_rts[i], col_its[i]
+                pos, key = col_pos[i], col_keys[i]
+            else:
+                md = entry.metadata
+                vt = int(md.value_type)
+                rt = int(md.record_type)
+                intent = int(md.intent)
+                pos, key = entry.position, entry.key
+            positions.append(pos)
             device_vt = vt in _DEVICE_VALUE_TYPES or (
                 vt in _MESSAGE_VALUE_TYPES
                 and self.graph is not None
                 and self.graph.has_messages
             )
-            if (
-                device_vt
-                and self.meta is not None
-                and self.graph is not None
-                and not self._routes_to_host(record)
+            eligible = (
+                device_vt and self.meta is not None and self.graph is not None
+            )
+            if lazy and eligible and self._lazy_device_row(
+                entry, vt, rt, intent, key
             ):
+                # device EVENT of a device-resident instance, born from a
+                # readback batch with current workflow slots: the row
+                # stages from columns; no Record materializes, and the
+                # log-backed position cache covers any later re-read
+                pending.append(i)
+                continue
+            if lazy:
+                record = entry[0].row(entry[1])
+                entries[i] = record
+            else:
+                record = entry
+            # records_by_position aliases the host oracle's cache (one
+            # shared dict) — a single write covers both readers
+            self.records_by_position[pos] = record
+            md = record.metadata
+            if eligible and not self._routes_to_host(record):
                 # data contract of TPU-backed partitions: payload numbers
                 # must be exactly representable in float32 (device payload
                 # columns are f32). Commands violating it are REJECTED at
@@ -1526,10 +1644,52 @@ class TpuPartitionEngine:
                     host_allocated[0] = True
         flush()
         push_host_keys()
-        if records:
-            self.last_processed_position = records[-1].position
+        if positions:
+            self.last_processed_position = positions[-1]
         wave.host_seconds += _time.perf_counter() - t0
         return wave
+
+    def _lazy_device_row(self, entry, vt, rt, intent, key) -> bool:
+        """True when a LAZY tail ref (``(batch, idx)``) can enter a device
+        segment straight from its backing readback columns. Conservative:
+        anything this cannot prove device-resident from columns alone
+        materializes and takes the exact per-record path.
+
+        Mirrors ``_routes_to_host`` for the EVENT cases it admits —
+        device-born events are f32-exact and scalar by induction, so the
+        payload-contract checks are vacuous for them."""
+        if rt != int(RecordType.EVENT):
+            return False
+        ref = entry[0].device_ref(entry[1])
+        if ref is None:
+            return False
+        src, j = ref
+        _o, scols, epoch = src.device_source
+        if epoch != self._meta_epoch or self.meta is None:
+            # a redeploy recompiled the graph: workflow SLOTS in this
+            # batch are stale — rebuild through the record path
+            return False
+        if vt == int(ValueType.JOB):
+            if intent in (
+                int(JI.FAILED), int(JI.RETRIES_UPDATED), int(JI.CANCELED)
+            ):
+                # host-side job-incident bookkeeping reads these records
+                return False
+        elif vt != int(ValueType.WORKFLOW_INSTANCE):
+            return False
+        wf_slot = scols["wf"][j]
+        workflow = (
+            self.meta.workflows[wf_slot]
+            if 0 <= wf_slot < len(self.meta.workflows) else None
+        )
+        if workflow is not None and workflow.key in self._host_only_keys:
+            return False
+        instances = self._host.element_instances.instances
+        if scols["instance_key"][j] in instances:
+            return False
+        if vt == int(ValueType.JOB):
+            return key not in self._host.jobs
+        return key not in instances
 
     def collect_wave(self, wave: PendingWave) -> List[ProcessingResult]:
         """Materialize a dispatched wave: one bulk device fetch per
@@ -1549,10 +1709,10 @@ class TpuPartitionEngine:
             for i, res in zip(seg.rows, seg.results):
                 wave.per_record[i] = res
         results: List[ProcessingResult] = []
-        for record, res in zip(wave.records, wave.per_record):
+        for pos, res in zip(wave.positions, wave.per_record):
             if res is None:  # poisoned host record: contained, no output
                 res = ProcessingResult()
-            stamp_source_positions(res.written, record.position)
+            stamp_source_positions(res.written, pos)
             results.append(res)
         wave.device_seconds += device_s
         wave.host_seconds += (_time.perf_counter() - t0) - device_s
@@ -1681,9 +1841,61 @@ class TpuPartitionEngine:
         cols["v_vt"] = np.zeros((size, v), np.int8)
         cols["v_num"] = np.zeros((size, v), np.float32)
         cols["v_str"] = np.zeros((size, v), np.int32)
+        staged_lazy = 0
         for i, record in enumerate(records):
-            self._stage_row(cols, i, record)
+            if type(record) is tuple:
+                # lazy emission ref (_lazy_device_row admitted it): copy
+                # the device columns straight from the readback batch —
+                # payloads skip the columns→payload→columns round trip
+                src, j = record[0].device_ref(record[1])
+                self._stage_from_emission(cols, i, src, j)
+                staged_lazy += 1
+            else:
+                self._stage_row(cols, i, record)
+        if staged_lazy:
+            _count_staged_columnar(staged_lazy)
         return self._pack_batch(cols, size)
+
+    def _stage_from_emission(self, cols, i, src, j) -> None:
+        """Stage one row by COPYING the backing emission batch's columns
+        (the kernel emitted them; re-deriving via a materialized Record is
+        the identity — pinned by the lazy-vs-eager log bit-identity test).
+        Only the columns ``_stage_row`` would set for the value type are
+        copied; everything else keeps the staging defaults (``src``,
+        ``resp``, ``push`` are per-staging flags, never carried over)."""
+        o, s, _epoch = src.device_source
+        vt = s["vtype"][j]
+        cols["valid"][i] = True
+        cols["rtype"][i] = s["rtype"][j]
+        cols["vtype"][i] = vt
+        cols["intent"][i] = s["intent"][j]
+        cols["key"][i] = s["key"][j]
+        cols["req"][i] = s["req"][j]
+        cols["req_stream"][i] = s["req_stream"][j]
+        wf = s["wf"][j]
+        if vt == int(ValueType.WORKFLOW_INSTANCE):
+            cols["wf"][i] = wf
+            cols["elem"][i] = s["elem"][j] if wf >= 0 else -1
+            cols["instance_key"][i] = s["instance_key"][j]
+            cols["scope_key"][i] = s["scope_key"][j]
+        elif vt == int(ValueType.JOB):
+            cols["type_id"][i] = s["type_id"][j]
+            cols["retries"][i] = s["retries"][j]
+            cols["deadline"][i] = s["deadline"][j]
+            cols["worker"][i] = s["worker"][j]
+            cols["aux_key"][i] = s["aux_key"][j]
+            cols["instance_key"][i] = s["instance_key"][j]
+            cols["wf"][i] = wf
+            cols["elem"][i] = s["elem"][j] if wf >= 0 else -1
+        # payload columns copy MASKED by the type column: zeros where no
+        # variable is set — exactly what payload_to_columns(
+        # columns_to_payload(...)) would produce (unset lanes must not
+        # carry junk)
+        vt_row = o["v_vt"][j]
+        mask = vt_row != 0
+        cols["v_vt"][i] = vt_row
+        cols["v_num"][i] = np.where(mask, o["v_num"][j], 0)
+        cols["v_str"][i] = np.where(mask, o["v_str"][j], 0)
 
     def _pack_batch(self, cols: Dict[str, object], size: int) -> RecordBatch:
         """Scalar columns → one matrix per dtype family → one device_put
@@ -1873,11 +2085,24 @@ class TpuPartitionEngine:
 
     # -- device round -------------------------------------------------------
     def _dispatch_device(
-        self, records: List[Record], positions: List[int]
+        self, records: List, positions: List[int],
+        metas: "Optional[List[tuple]]" = None,
     ) -> _PendingSegment:
         """Host pre-work + staging + kernel launch for one device segment;
         returns the pending segment WITHOUT synchronizing on the device
-        (overflow check and emission fetch happen in ``_collect_device``)."""
+        (overflow check and emission fetch happen in ``_collect_device``).
+
+        ``records`` entries may be lazy ``(batch, idx)`` refs (admitted by
+        ``_lazy_device_row``); ``metas`` carries each entry's
+        ``(value_type, record_type, intent)`` so the host-side scans below
+        never materialize a row just to filter on it."""
+        if metas is None:
+            metas = []
+            for record in records:
+                md = record.metadata
+                metas.append(
+                    (int(md.value_type), int(md.record_type), int(md.intent))
+                )
         results = [ProcessingResult() for _ in records]
         # Job-incident bookkeeping lives in the host engine (incident records
         # are host-processed); run the oracle's _incident_on_job_event for
@@ -1888,52 +2113,56 @@ class TpuPartitionEngine:
         # (metadata.incident_key set), the RESOLVE_FAILED event. The
         # kernel's own unconditional incident-CREATE emission for these
         # rows is suppressed below (it cannot see the incident_key).
+        # (Lazy refs never match: _lazy_device_row excludes these intents.)
         suppress_incident_create: set = set()
-        for i, record in enumerate(records):
-            md = record.metadata
-            if int(md.value_type) != int(ValueType.JOB) or int(
-                md.record_type
-            ) != int(RecordType.EVENT):
+        for i, (vt, rt, intent) in enumerate(metas):
+            if vt != int(ValueType.JOB) or rt != int(RecordType.EVENT):
                 continue
-            intent = int(md.intent)
-            if intent == int(JI.FAILED) and record.value.retries <= 0:
-                # mutates the oracle's incident maps outside host.process
-                self._host.snapshot_mark_dirty(("h/incidents", "h/control"))
-                self._host._incident_on_job_event(record, results[i])
-                suppress_incident_create.add(i)
+            if intent == int(JI.FAILED):
+                record = _as_record(records[i])
+                if record.value.retries <= 0:
+                    # mutates the oracle's incident maps outside
+                    # host.process
+                    self._host.snapshot_mark_dirty(
+                        ("h/incidents", "h/control")
+                    )
+                    self._host._incident_on_job_event(record, results[i])
+                    suppress_incident_create.add(i)
             elif intent in (int(JI.RETRIES_UPDATED), int(JI.CANCELED)):
+                record = _as_record(records[i])
                 self._host.snapshot_mark_dirty(("h/incidents", "h/control"))
                 self._host._incident_on_job_event(record, results[i])
         # CREATE commands with unknown workflows are rejected host-side,
         # mirroring CreateWorkflowInstanceEventProcessor's rejection
         rejected = set()
-        for i, record in enumerate(records):
-            md = record.metadata
+        for i, (vt, rt, intent) in enumerate(metas):
             if (
-                int(md.value_type) == int(ValueType.WORKFLOW_INSTANCE)
-                and int(md.record_type) == int(RecordType.COMMAND)
-                and int(md.intent) == int(WI.CREATE)
-                and self._resolve_workflow(record.value) is None
+                vt == int(ValueType.WORKFLOW_INSTANCE)
+                and rt == int(RecordType.COMMAND)
+                and intent == int(WI.CREATE)
             ):
-                value = record.value.copy()
-                value.workflow_instance_key = self._next_wf_key_host()
-                rejection = Record(
-                    key=record.key,
-                    source_record_position=record.position,
-                    metadata=RecordMetadata(
-                        record_type=RecordType.COMMAND_REJECTION,
-                        value_type=ValueType.WORKFLOW_INSTANCE,
-                        intent=int(WI.CREATE),
-                        rejection_type=RejectionType.BAD_VALUE,
-                        rejection_reason="Workflow is not deployed",
-                        request_id=md.request_id,
-                        request_stream_id=md.request_stream_id,
-                    ),
-                    value=value,
-                )
-                results[i].written.append(rejection)
-                results[i].responses.append(rejection)
-                rejected.add(i)
+                record = _as_record(records[i])
+                if self._resolve_workflow(record.value) is None:
+                    md = record.metadata
+                    value = record.value.copy()
+                    value.workflow_instance_key = self._next_wf_key_host()
+                    rejection = Record(
+                        key=record.key,
+                        source_record_position=record.position,
+                        metadata=RecordMetadata(
+                            record_type=RecordType.COMMAND_REJECTION,
+                            value_type=ValueType.WORKFLOW_INSTANCE,
+                            intent=int(WI.CREATE),
+                            rejection_type=RejectionType.BAD_VALUE,
+                            rejection_reason="Workflow is not deployed",
+                            request_id=md.request_id,
+                            request_stream_id=md.request_stream_id,
+                        ),
+                        value=value,
+                    )
+                    results[i].written.append(rejection)
+                    results[i].responses.append(rejection)
+                    rejected.add(i)
 
         seg = _PendingSegment(
             results=results,
@@ -2035,15 +2264,24 @@ class TpuPartitionEngine:
         cols = {
             k: v[:count].tolist() for k, v in o.items() if v.ndim == 1
         }
-        names = self.meta.varspace.names
-        # the readback decodes into a COLUMNAR batch: routing decisions
-        # read the scalar columns, while Record objects build through the
-        # batch's counted lazy row view. TODAY every emission row still
-        # materializes in the loop below (each written follow-up is
-        # immediately appended and re-staged by the drain), so the batch
-        # is the SEAM — the counter makes the remaining per-row cost
-        # visible, and pushing laziness through ProcessingResult is the
-        # next slice of ROADMAP item 4 (PERF_NOTES round 8).
+        # bind THIS compile's meta into the lazy closures: a later
+        # redeploy replaces self.meta, but the slots in these columns
+        # index the graph that emitted them
+        meta = self.meta
+        names = meta.varspace.names
+        srcs = cols["src"]
+        sources = [
+            src_positions[s] if 0 <= s < len(src_positions) else -1
+            for s in srcs
+        ]
+        producer_d, incident_d, rejtype_d = _frame_defaults()
+        # the readback decodes into a COLUMNAR batch carrying the FULL
+        # frame-column set plus a value-only builder: plain follow-up rows
+        # flow to LogStream.append as lazy refs and encode from columns +
+        # built values — no Record/metadata objects on the append edge —
+        # and later re-STAGE from these very columns (_stage_from_
+        # emission). Only rows that need objects now (sends, responses,
+        # pushes, rejections, incident fixups) materialize here.
         emission = ColumnarBatch(
             count,
             {
@@ -2053,15 +2291,26 @@ class TpuPartitionEngine:
                 "intent": cols["intent"],
                 "request_id": cols["req"],
                 "request_stream_id": cols["req_stream"],
+                "source_record_position": sources,
+                "producer_id": [producer_d] * count,
+                "incident_key": [incident_d] * count,
+                "rejection_type": [rejtype_d] * count,
+                "rejection_reason": [""] * count,
+                "raft_term": [0] * count,
             },
-            materializer=lambda r: self._materialize(o, cols, r, names),
+            materializer=lambda r: self._materialize(
+                o, cols, r, names, sources, meta
+            ),
+            value_builder=lambda r: self._materialize_value(
+                o, cols, r, names, meta
+            ),
         )
+        emission.device_source = (o, cols, self._meta_epoch)
+        lazy_ok = self.lazy_emissions
+        rt_cmd = int(RecordType.COMMAND)
+        rt_rej = int(RecordType.COMMAND_REJECTION)
         for r in range(count):
-            src = cols["src"][r]
-            record = emission.row(r)
-            record.source_record_position = (
-                src_positions[src] if 0 <= src < len(src_positions) else -1
-            )
+            src = srcs[r]
             res = results[live_rows[src]] if 0 <= src < len(live_rows) else results[0]
             # cross-partition subscription commands are SENDS, not appended
             # records — exactly the oracle's out.sends channel
@@ -2069,23 +2318,25 @@ class TpuPartitionEngine:
             vt = cols["vtype"][r]
             rt = cols["rtype"][r]
             intent = cols["intent"][r]
-            if rt == int(RecordType.COMMAND) and vt == int(
+            if rt == rt_cmd and vt == int(
                 ValueType.MESSAGE_SUBSCRIPTION
             ) and intent in (int(MS.OPEN), int(MS.CLOSE)):
+                record = emission.row(r)
                 target = self.partition_for_correlation_key(
                     record.value.correlation_key
                 )
                 record.source_record_position = -1  # sends are unstamped
                 res.sends.append((target, record))
                 continue
-            if rt == int(RecordType.COMMAND) and vt == int(
+            if rt == rt_cmd and vt == int(
                 ValueType.WORKFLOW_INSTANCE_SUBSCRIPTION
             ) and intent == int(WS.CORRELATE):
+                record = emission.row(r)
                 record.source_record_position = -1
                 res.sends.append((cols["wf"][r], record))
                 continue
             if (
-                rt == int(RecordType.COMMAND)
+                rt == rt_cmd
                 and vt == int(ValueType.INCIDENT)
                 and intent == int(IncidentIntent.CREATE)
             ):
@@ -2098,6 +2349,7 @@ class TpuPartitionEngine:
                     # the host branches on metadata.incident_key, which
                     # the kernel cannot see) — drop the kernel's copy
                     continue
+                record = emission.row(r)
                 if (
                     record.value is not None
                     and record.value.failure_event_position < 0
@@ -2110,39 +2362,41 @@ class TpuPartitionEngine:
                     record.value.failure_event_position = (
                         record.source_record_position
                     )
+                res.written.append(record)
+                if cols["resp"][r] and cols["req"][r] >= 0:
+                    res.responses.append(record)
+                if cols["push"][r]:
+                    res.pushes.append((cols["req_stream"][r], record))
+                continue
+            resp = cols["resp"][r] and cols["req"][r] >= 0
+            push = cols["push"][r]
+            if lazy_ok and not resp and not push and rt != rt_rej:
+                # plain append: the row stays COLUMNS all the way into
+                # the log tail (a (batch, idx) ref) — materialized only
+                # if something later reads it as an object
+                res.written.append((emission, r))
+                continue
+            record = emission.row(r)
             res.written.append(record)
-            if cols["resp"][r] and cols["req"][r] >= 0:
+            if resp:
                 res.responses.append(record)
-            if cols["push"][r]:
+            if push:
                 res.pushes.append((cols["req_stream"][r], record))
 
-    def _materialize(self, o, cols, r, names) -> Record:
+    def _materialize(self, o, cols, r, names, sources, meta) -> Record:
         """One emission row → Record. ``cols`` holds the scalar columns as
-        Python lists (see _emit_records); ``o`` the 2D payload matrices."""
+        Python lists (see _emit_records); ``o`` the 2D payload matrices;
+        ``meta`` is the graph meta bound AT EMIT (slots in these columns
+        index it, not whatever self.meta later becomes)."""
         vt = cols["vtype"][r]
         rt = cols["rtype"][r]
-        intent = cols["intent"][r]
         rej = cols["rej"][r]
-        wf_slot = cols["wf"][r]
-        elem = cols["elem"][r]
-        payload = rb.columns_to_payload(
-            o["v_vt"][r], o["v_num"][r], o["v_str"][r], names, self.interns
-        )
-        workflow = (
-            self.meta.workflows[wf_slot]
-            if 0 <= wf_slot < len(self.meta.workflows)
-            else None
-        )
-        elem_id = self.meta.element_id(wf_slot, elem)
-        element = (
-            workflow.elements[elem] if workflow and 0 <= elem < len(workflow.elements)
-            else None
-        )
+        value = self._materialize_value(o, cols, r, names, meta)
 
         md = RecordMetadata(
             record_type=RecordType(rt),
             value_type=ValueType(vt),
-            intent=intent,
+            intent=cols["intent"][r],
             request_id=cols["req"][r],
             request_stream_id=cols["req_stream"][r],
         )
@@ -2153,6 +2407,37 @@ class TpuPartitionEngine:
                 else RejectionType.NOT_APPLICABLE
             )
             md.rejection_reason = rb.REJECTION_REASONS.get(rej, "")
+            if vt == int(ValueType.MESSAGE) and rej == rb.REJ_MSG_DUP:
+                md.rejection_type = RejectionType.BAD_VALUE
+                md.rejection_reason = (
+                    f"message with id '{value.message_id}' is already "
+                    "published"
+                )
+        record = Record(key=cols["key"][r], metadata=md, value=value)
+        record.source_record_position = sources[r]
+        return record
+
+    def _materialize_value(self, o, cols, r, names, meta):
+        """One emission row → its typed ``RecordValue`` only (no
+        Record/metadata wrapper) — the append-edge encode path for lazy
+        rows builds exactly this and nothing more."""
+        vt = cols["vtype"][r]
+        rej = cols["rej"][r]
+        wf_slot = cols["wf"][r]
+        elem = cols["elem"][r]
+        payload = rb.columns_to_payload(
+            o["v_vt"][r], o["v_num"][r], o["v_str"][r], names, self.interns
+        )
+        workflow = (
+            meta.workflows[wf_slot]
+            if 0 <= wf_slot < len(meta.workflows)
+            else None
+        )
+        elem_id = meta.element_id(wf_slot, elem)
+        element = (
+            workflow.elements[elem] if workflow and 0 <= elem < len(workflow.elements)
+            else None
+        )
 
         if vt == int(ValueType.WORKFLOW_INSTANCE):
             value = WorkflowInstanceRecord(
@@ -2212,11 +2497,6 @@ class TpuPartitionEngine:
                 payload=payload,
                 message_id=self.interns.string(cols["aux2_key"][r]) or "",
             )
-            if rt == int(RecordType.COMMAND_REJECTION) and rej == rb.REJ_MSG_DUP:
-                md.rejection_type = RejectionType.BAD_VALUE
-                md.rejection_reason = (
-                    f"message with id '{value.message_id}' is already published"
-                )
         elif vt == int(ValueType.MESSAGE_SUBSCRIPTION):
             from zeebe_tpu.protocol.records import MessageSubscriptionRecord
 
@@ -2246,7 +2526,7 @@ class TpuPartitionEngine:
             )
         else:
             value = None
-        return Record(key=cols["key"][r], metadata=md, value=value)
+        return value
 
     def _corr_string(self, cvt: int, cbits: int) -> str:
         """Correlation columns → the oracle's string form (numeric keys

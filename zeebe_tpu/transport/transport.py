@@ -350,6 +350,14 @@ class ConnectionHandle:
     def open(self) -> bool:
         return self._conn.open
 
+    @property
+    def key(self) -> int:
+        """Stable identity of the underlying connection. Handles are
+        constructed per request, so handle identity cannot key
+        per-connection state (the gateway's admission controller bounds
+        in-flight commands PER CONNECTION by this key)."""
+        return id(self._conn)
+
     def push(self, payload: bytes) -> bool:
         if not self._conn.open:
             return False
