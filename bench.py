@@ -746,6 +746,437 @@ def run_multi_tenant_ab(engine="host", **kw):
     }
 
 
+def _ensure_mesh_devices(n):
+    """≥ n visible devices: real chips when the backend has them, else the
+    virtual CPU mesh (the conftest ``--xla_force_host_platform_device_count``
+    hook, applied post-import via clear_backends like dryrun_multichip)."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    devs = jax.devices()
+    if len(devs) >= n:
+        return n
+    if devs and devs[0].platform != "cpu":
+        # fewer real chips than asked for: use every one of them — never
+        # abandon an accelerator backend for a virtual CPU mesh
+        return len(devs)
+    import jax.extend.backend
+
+    jax.extend.backend.clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        # this jax build has no post-import device-count knob AND parses
+        # XLA_FLAGS only once per process — the CLI entry re-execs with
+        # the flag before jax loads, so reaching here means a library
+        # caller skipped that bootstrap
+        pass
+    have = len(jax.devices())
+    if have < 2 <= n:
+        raise RuntimeError(
+            f"mesh bench needs >= 2 devices but this process has {have} "
+            "and this jax build cannot add virtual CPU devices "
+            "post-import; run with XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={n}"
+        )
+    return min(n, have)
+
+
+def run_mesh_serving(mesh=True, partitions=8, devices=8, clients=8,
+                     instances_per_client=8, resident=0, duration_sec=120,
+                     capacity=None, seed=11):
+    """MESH-SHARDED serving: one broker, ``partitions`` leader partitions
+    placed across ``devices`` devices (scheduler/placement.DevicePlan), the
+    shared-wave drain dispatching different partitions' segments to
+    different devices within one scheduling round. ``mesh=False`` pins
+    every engine to the default device — the single-device baseline at
+    EQUAL offered load (same scheduler, same traffic). ``resident``
+    pre-loads instances that stay live on device (a service task no worker
+    serves) so the timed window serves against a populated state — the
+    1M-resident scale target runs this with ``--resident 1000000`` on real
+    chips."""
+    import tempfile
+    import threading as _threading
+    import time as _time
+
+    from zeebe_tpu.gateway.cluster_client import ClusterClient
+    from zeebe_tpu.models.bpmn.builder import Bpmn
+    from zeebe_tpu.runtime.cluster_broker import ClusterBroker
+    from zeebe_tpu.runtime.config import BrokerCfg
+    from zeebe_tpu.runtime.engines import engine_factory_from_config
+    from zeebe_tpu.runtime.metrics import GLOBAL_REGISTRY
+
+    devices = _ensure_mesh_devices(devices)
+    cfg = BrokerCfg()
+    cfg.network.client_port = 0
+    cfg.network.management_port = 0
+    cfg.network.subscription_port = 0
+    cfg.metrics.port = 0
+    cfg.metrics.enabled = False
+    cfg.cluster.partitions = partitions
+    cfg.engine.type = "tpu"
+    if capacity is None:
+        # room for the resident set + the serving flow's churn
+        need = resident // max(partitions, 1) + 4096
+        capacity = 1 << max(12, (need - 1).bit_length())
+    cfg.engine.capacity = capacity
+    cfg.mesh.enabled = mesh
+    cfg.mesh.devices = devices
+    broker = ClusterBroker(
+        cfg, tempfile.mkdtemp(),
+        engine_factory=engine_factory_from_config(cfg),
+    )
+    clients_open = []
+    try:
+        for pid in range(partitions):
+            broker.open_partition(pid).join(600)
+            broker.bootstrap_partition(pid, {})
+        deadline = _time.time() + 600
+        while _time.time() < deadline and not all(
+            broker.partitions[pid].is_leader for pid in range(partitions)
+        ):
+            _time.sleep(0.02)
+        if not all(
+            broker.partitions[pid].is_leader for pid in range(partitions)
+        ):
+            raise RuntimeError("mesh broker never led all partitions")
+
+        def counters():
+            c = GLOBAL_REGISTRY.counter
+            out = {
+                "waves": c("serving_waves_total").value,
+                "records": c("serving_wave_records_total").value,
+                "shared": c("scheduler_shared_waves_total").value,
+                "mesh_devices": c("scheduler_wave_devices_total").value,
+                "shed_conn": c("gateway_commands_shed",
+                               reason="CONNECTION_INFLIGHT").value,
+                "shed_queue": c("gateway_commands_shed",
+                                reason="QUEUE_DEPTH").value,
+            }
+            for d in range(devices):
+                out[f"dev{d}"] = c(
+                    "serving_device_waves_total", device=str(d)
+                ).value
+                out[f"devrec{d}"] = c(
+                    "serving_device_records_total", device=str(d)
+                ).value
+            return out
+
+        admin = ClusterClient(
+            [broker.client_address], num_partitions=partitions,
+            request_timeout_ms=600_000,
+        )
+        clients_open.append(admin)
+        admin.deploy_model(
+            Bpmn.create_process("mesh-flow")
+            .start_event()
+            .service_task("work", type="mesh-service")
+            .end_event()
+            .done()
+        )
+        admin.deploy_model(
+            Bpmn.create_process("mesh-resident")
+            .start_event()
+            .service_task("hold", type="mesh-resident-service")  # no worker
+            .end_event()
+            .done()
+        )
+        done_cond = _threading.Condition()
+        done_at: dict = {}
+
+        def on_job(pid, rec):
+            with done_cond:
+                done_at[(pid, rec.value.headers.workflow_instance_key)] = (
+                    _time.perf_counter()
+                )
+                done_cond.notify_all()
+            return {}
+
+        worker = admin.open_job_worker("mesh-service", on_job, credits=256)
+        # warm every partition's engine (first kernel compile) off the clock
+        for pid in range(partitions):
+            admin.create_instance("mesh-flow", partition_id=pid)
+        with done_cond:
+            done_cond.wait_for(lambda: len(done_at) >= partitions,
+                               timeout=570)
+
+        # resident preload: instances that stay live on device
+        resident_created = 0
+        for i in range(resident):
+            admin.create_instance(
+                "mesh-resident", payload={"r": i},
+                partition_id=i % partitions,
+            )
+            resident_created += 1
+
+        c0 = counters()
+        starts: dict = {}
+        starts_lock = _threading.Lock()
+        errors: list = []
+        stop_at = _time.monotonic() + duration_sec
+
+        def tenant(k):
+            import random as _random
+
+            rng = _random.Random(seed * 1000 + k)
+            client = ClusterClient(
+                [broker.client_address], num_partitions=partitions,
+                request_timeout_ms=300_000,
+            )
+            clients_open.append(client)
+            for _ in range(instances_per_client):
+                if _time.monotonic() > stop_at:
+                    return
+                pid = rng.randrange(partitions)  # uniform: every device hot
+                t_send = _time.perf_counter()
+                try:
+                    rsp = client.create_instance(
+                        "mesh-flow", payload={"t": k}, partition_id=pid,
+                    )
+                    with starts_lock:
+                        starts[(pid, rsp.value.workflow_instance_key)] = (
+                            t_send
+                        )
+                except Exception as e:  # noqa: BLE001 - report, don't crash
+                    errors.append(str(e)[:120])
+                    return
+
+        t0 = _time.perf_counter()
+        threads = [
+            _threading.Thread(target=tenant, args=(k,), daemon=True)
+            for k in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(duration_sec + 300)
+
+        def _all_done():
+            with starts_lock:
+                pending = list(starts)
+            return all(key in done_at for key in pending)
+
+        with done_cond:
+            done_cond.wait_for(_all_done, timeout=max(120, duration_sec))
+        elapsed = _time.perf_counter() - t0
+        worker.close()
+        c1 = counters()
+        d_waves = c1["waves"] - c0["waves"]
+        d_recs = c1["records"] - c0["records"]
+        d_shared = c1["shared"] - c0["shared"]
+        with starts_lock:
+            starts_snapshot = dict(starts)
+        latencies = sorted(
+            done_at[key] - t_send
+            for key, t_send in starts_snapshot.items()
+            if key in done_at
+        )
+
+        def pct(p):
+            if not latencies:
+                return None
+            idx = min(len(latencies) - 1, int(len(latencies) * p))
+            return round(latencies[idx] * 1000.0, 1)
+
+        created = len(starts_snapshot)
+        per_device_waves = {
+            str(d): int(c1[f"dev{d}"] - c0[f"dev{d}"]) for d in range(devices)
+        }
+        per_device_records = {
+            str(d): int(c1[f"devrec{d}"] - c0[f"devrec{d}"])
+            for d in range(devices)
+        }
+        return {
+            "config": "mesh-serving",
+            "mesh": mesh,
+            "partitions": partitions,
+            "devices": devices,
+            "resident_instances": resident_created,
+            "instances": created,
+            "completed": sum(1 for k in starts_snapshot if k in done_at),
+            "elapsed_sec": round(elapsed, 3),
+            "records_per_sec": round(d_recs / max(elapsed, 1e-9), 1),
+            "instances_per_sec": round(created / max(elapsed, 1e-9), 1),
+            "mean_wave_fill": round(d_recs / d_waves, 2) if d_waves else 0.0,
+            "mean_wave_devices": round(
+                (c1["mesh_devices"] - c0["mesh_devices"]) / d_shared, 2
+            ) if d_shared else 0.0,
+            "per_device_waves": per_device_waves,
+            "per_device_records": per_device_records,
+            "shed": int(
+                (c1["shed_conn"] - c0["shed_conn"])
+                + (c1["shed_queue"] - c0["shed_queue"])
+            ),
+            "p50_instance_latency_ms": pct(0.50),
+            "p99_instance_latency_ms": pct(0.99),
+            **({"errors": len(errors), "first_error": errors[0]}
+               if errors else {}),
+        }
+    finally:
+        for client in clients_open:
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001
+                pass
+        broker.close()
+
+
+def _mesh_inprocess_parity(devices):
+    """Deterministic mesh leg (the smoke's non-timing asserts): the same
+    bulk workload drained once with engines spread across the mesh and
+    once pinned to the default device must produce BIT-IDENTICAL
+    per-partition logs — and the mesh drain must land waves on every
+    device, more than one per scheduling round."""
+    import itertools
+    import tempfile
+
+    import jax
+
+    from zeebe_tpu.engine.interpreter import WorkflowRepository
+    from zeebe_tpu.gateway import JobWorker, ZeebeClient
+    from zeebe_tpu.gateway import workers as workers_mod
+    from zeebe_tpu.models.bpmn.builder import Bpmn
+    from zeebe_tpu.protocol import codec
+    from zeebe_tpu.protocol.intents import WorkflowInstanceIntent
+    from zeebe_tpu.protocol.records import WorkflowInstanceRecord
+    from zeebe_tpu.runtime import Broker, ControlledClock
+    from zeebe_tpu.runtime.metrics import GLOBAL_REGISTRY
+    from zeebe_tpu.tpu import TpuPartitionEngine
+
+    devs = jax.devices()[:devices]
+    partitions = len(devs)
+
+    def run(data_dir, mesh):
+        workers_mod._subscriber_keys = itertools.count(1)
+        clock = ControlledClock(start_ms=1_000_000)
+        repo = WorkflowRepository()
+
+        def factory(pid):
+            return TpuPartitionEngine(
+                pid, partitions, repository=repo, clock=clock,
+                device=devs[pid] if mesh else None,
+                device_index=pid if mesh else -1,
+            )
+
+        broker = Broker(
+            num_partitions=partitions, data_dir=data_dir, clock=clock,
+            engine_factory=factory,
+        )
+        broker.wave_size = 256
+        try:
+            client = ZeebeClient(broker)
+            client.deploy_model(
+                Bpmn.create_process("mesh-smoke")
+                .start_event("s")
+                .service_task("w", type="mesh-smoke-svc")
+                .end_event("e")
+                .done()
+            )
+            JobWorker(broker, "mesh-smoke-svc", lambda ctx: {"ok": True})
+            # bulk arrival: every partition's tail is non-empty when the
+            # shared wave packs, so one scheduling round spans the mesh
+            for burst in range(3):
+                for i in range(4 * partitions):
+                    broker.write_command(
+                        i % partitions,
+                        WorkflowInstanceRecord(
+                            bpmn_process_id="mesh-smoke",
+                            payload={"b": burst, "i": i},
+                        ),
+                        WorkflowInstanceIntent.CREATE,
+                    )
+                broker.run_until_idle()
+            return [
+                [codec.encode_record(r) for r in broker.records(pid)]
+                for pid in range(partitions)
+            ]
+        finally:
+            broker.close()
+
+    c = GLOBAL_REGISTRY.counter
+    dev0 = {
+        d: c("serving_device_waves_total", device=str(d)).value
+        for d in range(partitions)
+    }
+    mesh_waves0 = c("scheduler_wave_devices_total").value
+    shared0 = c("scheduler_shared_waves_total").value
+    with tempfile.TemporaryDirectory() as root:
+        frames_mesh = run(os.path.join(root, "m"), True)
+        dev1 = {
+            d: c("serving_device_waves_total", device=str(d)).value
+            for d in range(partitions)
+        }
+        mesh_waves1 = c("scheduler_wave_devices_total").value
+        shared1 = c("scheduler_shared_waves_total").value
+        frames_single = run(os.path.join(root, "s"), False)
+    total = sum(len(f) for f in frames_mesh)
+    assert total > 50 * partitions, f"workload too small ({total})"
+    for pid, (a, b) in enumerate(zip(frames_mesh, frames_single)):
+        assert a == b, f"partition {pid} log diverged under mesh placement"
+    idle_devices = [d for d in range(partitions) if dev1[d] - dev0[d] <= 0]
+    assert not idle_devices, f"devices received no waves: {idle_devices}"
+    mean_devices = (mesh_waves1 - mesh_waves0) / max(shared1 - shared0, 1)
+    assert mean_devices > 1.0, (
+        f"mean devices per scheduling round {mean_devices:.2f} <= 1"
+    )
+    return {
+        "records": total,
+        "per_device_waves": {
+            str(d): int(dev1[d] - dev0[d]) for d in range(partitions)
+        },
+        "mean_wave_devices": round(mean_devices, 2),
+        "bit_identical": True,
+    }
+
+
+def run_mesh_ab(smoke=False, partitions=8, devices=8, resident=0,
+                instances_per_client=8, clients=8):
+    """The tentpole A/B: mesh-placed serving vs the single-device
+    scheduler path at equal offered load, plus the deterministic
+    in-process parity leg. ``--smoke`` keeps only the non-timing asserts
+    (all devices receive waves, bit-identity, zero sheds at nominal load)
+    at a scale that fits CI."""
+    # the virtual CPU mesh must exist BEFORE the parity leg reads
+    # jax.devices() (ci.sh exports XLA_FLAGS, but a bare `--mesh` run
+    # relies on this bootstrap)
+    devices = _ensure_mesh_devices(devices)
+    if devices < 2:
+        raise RuntimeError(
+            f"mesh bench needs >= 2 devices, have {devices}"
+        )
+    parity = _mesh_inprocess_parity(min(devices, 4) if smoke else devices)
+    if smoke:
+        kw = dict(partitions=4, devices=min(4, devices), clients=4,
+                  instances_per_client=3, duration_sec=60)
+        mesh = run_mesh_serving(mesh=True, **kw)
+        assert mesh["shed"] == 0, f"nominal load shed {mesh['shed']} commands"
+        assert mesh["completed"] == mesh["instances"], (
+            f"lost instances: {mesh['completed']}/{mesh['instances']}"
+        )
+        idle = [d for d, n in mesh["per_device_waves"].items() if n <= 0]
+        assert not idle, f"devices received no waves: {idle}"
+        return {"config": "mesh-smoke", "parity": parity, "mesh": mesh}
+    kw = dict(partitions=partitions, devices=devices, clients=clients,
+              instances_per_client=instances_per_client, resident=resident)
+    mesh = run_mesh_serving(mesh=True, **kw)
+    single = run_mesh_serving(mesh=False, **kw)
+    speedup = (
+        mesh["records_per_sec"] / single["records_per_sec"]
+        if single["records_per_sec"] else None
+    )
+    return {
+        "config": "mesh-ab",
+        "parity": parity,
+        "mesh": mesh,
+        "single_device_baseline": single,
+        "throughput_ratio_mesh_over_single": (
+            round(speedup, 2) if speedup else None
+        ),
+    }
+
+
 def run_device_config(build_fn, label, total_instances, wave, progress,
                       cap_factor=4):
     """One device-engine bench: stage CREATE waves, drive to quiescence
@@ -1282,6 +1713,51 @@ def main():
         if "--trickle" in sys.argv:
             kw["trickle_ms"] = 25
         result = run_multi_tenant_ab(engine=engine, **kw)
+        print(json.dumps(result, indent=2))
+        return
+
+    if "--mesh" in sys.argv:
+        # mesh-sharded serving A/B (ISSUE 9): 8 partitions across 8
+        # devices — real chips when the backend has them, the virtual
+        # CPU mesh otherwise. --smoke keeps the non-timing asserts only.
+        # Probe the backend first (same contract as the kernel bench): a
+        # blanket JAX_PLATFORMS=cpu here would silently run the ON-CHIP
+        # mesh validation on virtual CPU devices on a TPU host.
+        backend, _status, err = _probe_backend(
+            timeout_sec=int(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
+        )
+        if err:
+            _progress(f"device unavailable ({err}); mesh bench on CPU")
+
+        def _arg(name, default):
+            if name in sys.argv:
+                return int(sys.argv[sys.argv.index(name) + 1])
+            return default
+
+        if backend == "cpu":
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                # this jax build parses XLA_FLAGS exactly once per
+                # process and has no post-import device-count knob, so
+                # the virtual CPU mesh must exist BEFORE jax loads:
+                # re-exec with the flag (jax is not imported yet here —
+                # the backend probe runs in a subprocess)
+                n = _arg("--devices", 8)
+                os.environ["XLA_FLAGS"] = (
+                    flags
+                    + f" --xla_force_host_platform_device_count={n}"
+                ).strip()
+                os.execv(sys.executable, [sys.executable] + sys.argv)
+
+        result = run_mesh_ab(
+            smoke="--smoke" in sys.argv,
+            partitions=_arg("--partitions", 8),
+            devices=_arg("--devices", 8),
+            resident=_arg("--resident", 0),
+            clients=_arg("--clients", 8),
+            instances_per_client=_arg("--instances", 8),
+        )
         print(json.dumps(result, indent=2))
         return
 

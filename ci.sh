@@ -48,6 +48,17 @@ echo "== wave-scheduler smoke (skewed-traffic fill >= 2x per-partition"
 echo "   baseline, per-partition logs bit-identical, overload sheds) =="
 JAX_PLATFORMS=cpu python tools/scheduler_smoke.py
 
+echo "== sharded-mesh dry run (8-device partition mesh: all_to_all"
+echo "   exchange + psum aggregates, message-correlation drive) =="
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8); print('dryrun_multichip(8) OK')"
+
+echo "== mesh serving smoke (partitions across devices: every device"
+echo "   receives waves, >1 device per round, logs bit-identical to the"
+echo "   single-device drain, zero sheds at nominal load) =="
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python bench.py --mesh --smoke > /dev/null
+
 echo "== full test suite (tier-1; run './ci.sh slow' for the slow tier) =="
 python -m pytest tests/ -x -q -m "not slow" --ignore=tests/test_chaos.py --ignore=tests/test_exporters.py
 
@@ -59,5 +70,9 @@ python benchmarks/pallas_ops_check.py
 
 echo "== autotune dispatch self-check (skips without a TPU) =="
 python -m zeebe_tpu.tpu.autotune
+
+echo "== on-chip checklist (pending PR 1/4/8/9 validations; skips and"
+echo "   records the skip without a TPU, writes onchip_report.json) =="
+python tools/onchip_checklist.py --quick
 
 echo "CI GATE GREEN"

@@ -383,7 +383,26 @@ class TestTopicOrchestration:
         cluster3.await_leaders()
         client = cluster3.client()
         try:
-            client.create_topic("dup-topic", partitions=1)
+            # The FIRST create tolerates a spurious "already exists": the
+            # client's command dedup (cid) is PER BROKER, so under box
+            # saturation a timed-out attempt retried across a leader
+            # change appends a SECOND CREATE on the new leader, and the
+            # duplicate's rejection can answer the retry even though the
+            # ORIGINAL command created the topic (at-least-once across
+            # failover — same semantics as the reference; the PR-8 flake
+            # note traced exactly this window). Either way the topic
+            # exists afterwards, which is the precondition this test
+            # needs; any OTHER failure still fails the test.
+            try:
+                client.create_topic("dup-topic", partitions=1)
+            except ClientException as e:
+                assert "already exists" in str(e), e
+                # the tolerance is ONLY for the duplicate-command window:
+                # the topic must genuinely exist (created by our own
+                # first command) — any other spurious rejection fails
+                leader = cluster3.leader_of(0)
+                assert leader is not None
+                assert "dup-topic" in leader.partitions[0].engine.topics
             with pytest.raises(ClientException, match="already exists"):
                 client.create_topic("dup-topic", partitions=1)
         finally:

@@ -1,0 +1,123 @@
+"""One entry point for the pending ON-CHIP validations (PERF_NOTES
+rounds 6-9): the per-build autotune A/B, the pallas-vs-XLA parity gate,
+the serving-path bench, the shared-wave scheduler bench, and the mesh
+serving A/B — each queued across PRs 1/4/8/9 for "the next chip session".
+Running them through one command that WRITES A REPORT is what keeps the
+checklist from rotting: ci.sh invokes this on every gate, it skips
+cleanly off-TPU, and on a chip session the JSON lands in
+``onchip_report.json`` for the PERF_NOTES update.
+
+Run: ``python tools/onchip_checklist.py [--out report.json] [--quick]``
+  --quick swaps the full benches for their --smoke legs (sanity only).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_OUT = os.path.join(ROOT, "onchip_report.json")
+
+
+def probe_backend(timeout_sec: int = 180) -> str:
+    """The backend jax would initialize, probed in a SUBPROCESS so a dead
+    accelerator tunnel times out instead of hanging the gate."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=timeout_sec, cwd=ROOT,
+        )
+        if proc.returncode == 0:
+            return proc.stdout.strip().splitlines()[-1]
+    except (subprocess.TimeoutExpired, OSError):
+        pass
+    return "unavailable"
+
+
+def run_step(name, argv, timeout_sec, env=None):
+    start = time.time()
+    step = {"name": name, "cmd": " ".join(argv)}
+    try:
+        proc = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout_sec,
+            cwd=ROOT, env={**os.environ, **(env or {})},
+        )
+        step["rc"] = proc.returncode
+        tail = (proc.stdout + proc.stderr)[-4000:]
+        step["tail"] = tail
+    except subprocess.TimeoutExpired:
+        step["rc"] = -1
+        step["tail"] = f"TIMEOUT after {timeout_sec}s"
+    step["seconds"] = round(time.time() - start, 1)
+    print(
+        f"onchip_checklist: {name}: rc={step['rc']} "
+        f"({step['seconds']}s)", flush=True,
+    )
+    return step
+
+
+def main() -> int:
+    out_path = DEFAULT_OUT
+    if "--out" in sys.argv:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
+    quick = "--quick" in sys.argv
+
+    backend = probe_backend()
+    report = {
+        "backend": backend,
+        "started": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "quick": quick,
+        "steps": [],
+    }
+    if backend != "tpu":
+        # the checklist is ON-CHIP validation; off-TPU there is nothing to
+        # validate — but the skip is recorded so a chip session sees it
+        report["status"] = "skipped-no-tpu"
+        print(
+            f"onchip_checklist: backend={backend!r}, no TPU — skipping "
+            "(report recorded)"
+        )
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=2)
+        return 0
+
+    py = sys.executable
+    smoke = ["--smoke"] if quick else []
+    steps = [
+        # PR 1: per-build pallas/XLA dispatch decisions on THIS libtpu
+        ("autotune", [py, "-m", "zeebe_tpu.tpu.autotune"], 3600),
+        # PR 1: pallas table ops + mega-pass parity on the real lowering
+        ("pallas_ops_check",
+         [py, os.path.join("benchmarks", "pallas_ops_check.py")], 3600),
+        # PR 4: the pipelined serving path (expect >=10x over BENCH_r05's
+        # 11.5 t/s once the per-column tunnel transfers are gone)
+        ("serving_bench", [py, "bench.py"], 7200),
+        # PR 8: shared-wave fill -> throughput win on chip
+        ("shared_wave_bench",
+         [py, "bench.py", "--multi-tenant"] + smoke, 7200,
+         {"ZB_BENCH_ENGINE": "tpu"}),
+        # PR 9: mesh serving A/B across the real chips
+        ("mesh_bench", [py, "bench.py", "--mesh"] + smoke, 7200),
+    ]
+    failed = []
+    for entry in steps:
+        name, argv, timeout_sec = entry[0], entry[1], entry[2]
+        env = entry[3] if len(entry) > 3 else None
+        step = run_step(name, argv, timeout_sec, env)
+        report["steps"].append(step)
+        if step["rc"] != 0:
+            failed.append(name)
+    report["status"] = "failed" if failed else "ok"
+    report["failed"] = failed
+    report["completed"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"onchip_checklist: {report['status']} -> {out_path}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

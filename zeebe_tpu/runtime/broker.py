@@ -145,6 +145,12 @@ class _BrokerFeed:
         self.partition = partition
         self.partition_id = partition.partition_id
 
+    @property
+    def device_index(self) -> int:
+        """Mesh device of this partition's engine (per-device wave
+        metrics; -1 = unplaced/host engine)."""
+        return getattr(self.partition.engine, "device_index", -1)
+
     def backlog(self) -> int:
         p = self.partition
         return max(0, p.log.commit_position - p.next_read_position + 1)
@@ -211,6 +217,13 @@ class Broker:
         self._topic_subscriptions: List[TopicSubscriptionHandle] = []
         self._rr_partition = 0
         self._exporter_specs = list(exporters or [])
+        # mesh frame exchange (scheduler/placement.MeshExchange): when the
+        # engine factory placed partitions on devices, cross-partition
+        # sends between device-resident partitions ride the all_to_all
+        # exchange. The single-writer broker flushes IMMEDIATELY per send,
+        # so the destination log is byte-identical to the direct append
+        # (tests pin it). None = direct append (the default).
+        self.mesh_exchange = None
         # shared-wave drain (zeebe_tpu/scheduler): the SAME scheduler the
         # cluster broker runs, so tier-1 covers its packing/dispatch path;
         # False restores the per-partition baseline the A/B compares to
@@ -613,7 +626,7 @@ class Broker:
             # (reprocessable) follow-ups, not the send. Duplicate sends after
             # a crash are fine — subscription open/correlate are idempotent
             # (dead activity ⇒ rejection; CLOSE removes all matches).
-            self.partitions[target_pid].log.append([send])
+            self._route_send(partition, target_pid, send)
         if result.written:
             stamp_source_positions(result.written, position)
             partition.log.append(as_log_batch(result.written))
@@ -633,6 +646,36 @@ class Broker:
                 listener(partition.partition_id, push)
         for listener in self._record_listeners:
             listener(partition.partition_id, _entry_record(record))
+
+    def _route_send(self, partition: Partition, target_pid: int, send) -> None:
+        """Cross-partition send: over the mesh all_to_all frame exchange
+        when both partitions are device-resident and an exchange is
+        installed, direct append otherwise. Immediate flush keeps the
+        single-writer broker deterministic: the arrival appends at exactly
+        the point the direct append would have."""
+        exchange = self.mesh_exchange
+        if exchange is not None:
+            src = getattr(partition.engine, "device_index", -1)
+            dst = getattr(
+                self.partitions[target_pid].engine, "device_index", -1
+            )
+            if src >= 0 and dst >= 0 and src != dst:
+                from zeebe_tpu.protocol import codec
+
+                if exchange.queue(
+                    src, dst, target_pid, codec.encode_record(send)
+                ):
+                    exchange.flush(self._deliver_mesh_frame)
+                    return
+        self.partitions[target_pid].log.append([send])
+
+    def _deliver_mesh_frame(self, partition_id: int, frame: bytes) -> None:
+        from zeebe_tpu.protocol import codec
+
+        record, _ = codec.decode_record(bytes(frame))
+        record.position = -1  # assigned at append, like transport arrivals
+        record.timestamp = -1
+        self.partitions[partition_id].log.append([record])
 
     # -- time-driven side processors ---------------------------------------
     def tick(self) -> None:

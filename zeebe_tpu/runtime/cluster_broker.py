@@ -168,7 +168,48 @@ class PartitionServer:
         elif self.is_leader:
             self.broker.actor_control.run(self._uninstall_leader)
 
-    def _install_leader(self, term: int) -> None:
+    def _install_leader(self, term: int, _boundary: Optional[int] = None) -> None:
+        if self.raft.state != RaftState.LEADER or self.raft.term != term:
+            # deposed (or re-elected at a higher term) since this install
+            # was queued or deferred: installing now would serve on a
+            # FOLLOWER in parallel with the real leader. The state-change
+            # event that owns the CURRENT term schedules its own install.
+            return
+        # Replay can only read COMMITTED records, and a fresh leader's
+        # commit catch-up (the §5.4.2 no-op quorum round; on restart the
+        # log recovers with commit at -1) may still be in flight — raft
+        # fires the LEADER state change BEFORE that round lands.
+        # Installing early would replay NOTHING and leave the cursor at
+        # the front, so the drain would later reprocess records whose
+        # follow-ups are already in the log WITH side effects (observed
+        # as duplicate CREATED events after a crash-restart under load).
+        # The boundary check depends only on the log, so it runs BEFORE
+        # the expensive engine build + snapshot recovery; the scanned
+        # boundary is carried across deferral retries (source positions
+        # only grow through PROCESSING, which cannot start before the
+        # install — commands are rejected NOT_LEADER until then), so the
+        # 10ms retries never rescan the log.
+        last_source = _boundary
+        if last_source is None:
+            last_source = -1
+            for record in self.log.reader(0):
+                if record.source_record_position > last_source:
+                    last_source = record.source_record_position
+        if self.log.commit_position < last_source:
+            if (
+                not self.broker._closing
+                and self.raft.state == RaftState.LEADER
+                and self.raft.term == term
+            ):
+                count_event(
+                    "leader_install_deferred_uncommitted",
+                    "Leader installs deferred until the raft commit "
+                    "position covered the replay boundary",
+                )
+                self.broker.actor_control.run_delayed(
+                    10, lambda: self._install_leader(term, last_source)
+                )
+            return
         # the engine is the partition's stream processor — installed on
         # leadership like the reference's PartitionInstallService installing
         # TypedStreamProcessors (:106-291). Which engine (host oracle or
@@ -202,12 +243,9 @@ class PartitionServer:
                 "Duration of the last snapshot recovery INCLUDING the "
                 "engine state install (excludes log replay)",
             )
-        last_source = -1
-        for record in self.log.reader(0):
-            if not log_backed:  # no log behind the cache: pre-fill it
+        if not log_backed:  # no log behind the cache: pre-fill it
+            for record in self.log.reader(0):
                 self.engine.records_by_position[record.position] = record
-            if record.source_record_position > last_source:
-                last_source = record.source_record_position
         # replay bounded by the last source event position: tail records
         # (appended by the old leader but never processed) are handled by
         # the normal loop below, with side effects — else their follow-ups
@@ -244,6 +282,11 @@ class PartitionServer:
         self.engine = None
         if self.broker.wave_scheduler is not None:
             self.broker.wave_scheduler.unregister(self.partition_id)
+        if self.broker.device_plan is not None:
+            # leadership left: free the mesh slot so the next install
+            # (this partition or another) rebalances onto the emptiest
+            # device
+            self.broker.device_plan.release(self.partition_id)
         self._parked = False
         self._fetch_candidate = None
         self._due_probe = None
@@ -358,6 +401,14 @@ class PartitionServer:
     # dispatch/collect ride the engine's existing double-buffered wave
     # pipeline, and apply stays per partition — the log is bit-identical
     # to the per-partition drain (tests/test_scheduler.py pins it).
+    @property
+    def device_index(self) -> int:
+        """The mesh device this partition's engine is placed on (per-device
+        wave metrics label; -1 = unplaced/host engine)."""
+        if self.engine is None:
+            return -1
+        return getattr(self.engine, "device_index", -1)
+
     def backlog(self) -> int:
         if not self.is_leader:
             return 0
@@ -632,7 +683,7 @@ class PartitionServer:
         for response in result.responses:
             self.broker.send_client_response(response)
         for target_pid, send in result.sends:
-            self.broker.send_subscription_command(target_pid, send)
+            self.broker.route_send(self.partition_id, target_pid, send)
         for subscriber_key, push in result.pushes:
             self.broker.push_to_subscriber(subscriber_key, self.partition_id, push)
         self.broker.metrics_events_processed.inc(len(records))
@@ -829,6 +880,8 @@ class PartitionServer:
     def close(self) -> None:
         if self.broker.wave_scheduler is not None:
             self.broker.wave_scheduler.unregister(self.partition_id)
+        if self.broker.device_plan is not None:
+            self.broker.device_plan.release(self.partition_id)
         if self.exporter_director is not None:
             self.exporter_director.close()
             self.exporter_director = None
@@ -962,6 +1015,16 @@ class ClusterBroker(Actor):
             else None
         )
         self._drain_scheduled = False
+        # mesh-sharded serving plane: leader partitions place across the
+        # visible devices (scheduler/placement.DevicePlan) so different
+        # partitions' wave segments compute on DIFFERENT devices within
+        # one scheduling round. Built lazily on the first placement ask
+        # (host-engine brokers never touch jax device init); cross-
+        # partition command frames optionally ride the mesh's all_to_all
+        # exchange instead of the host transport hop (route_send).
+        self.device_plan = None
+        self._mesh_exchange_obj = None
+        self._mesh_exchange_failed = False
         # gateway admission: bounded in-flight per client connection +
         # queue-depth shed, checked on the transport IO thread BEFORE a
         # command touches the broker actor (shed-before-collapse)
@@ -1132,6 +1195,199 @@ class ClusterBroker(Actor):
             clock=self.clock,
         )
 
+    # -- mesh placement (scheduler/placement.DevicePlan) --------------------
+    def _mesh_plan(self):
+        if not self.cfg.mesh.enabled:
+            return None
+        if self.device_plan is None:
+            from zeebe_tpu.scheduler.placement import DevicePlan
+
+            self.device_plan = DevicePlan(max_devices=self.cfg.mesh.devices)
+        return self.device_plan
+
+    def planned_device(self, partition_id: int):
+        """(device, device index) for a leader partition — assigned sticky
+        by the DevicePlan at engine install; (None, -1) when the mesh is
+        disabled. Engine factories consult this (runtime/engines.py)."""
+        plan = self._mesh_plan()
+        if plan is None:
+            return None, -1
+        idx = plan.assign(partition_id)
+        return plan.devices[idx], idx
+
+    def _mesh_exchange(self):
+        """The all_to_all frame exchange, built once over the plan's
+        devices; None when unavailable (single device, mesh disabled, or
+        a build failure — counted, transport keeps working)."""
+        if self._mesh_exchange_obj is not None:
+            return self._mesh_exchange_obj
+        if self._mesh_exchange_failed:
+            return None
+        plan = self.device_plan
+        if plan is None or len(plan.devices) < 2:
+            return None
+        try:
+            from zeebe_tpu.scheduler.placement import MeshExchange
+
+            self._mesh_exchange_obj = MeshExchange(
+                plan.devices,
+                slots=self.cfg.mesh.exchange_slots,
+                frame_bytes=self.cfg.mesh.exchange_frame_bytes,
+            )
+        except Exception as e:  # noqa: BLE001 - the transport hop is the
+            # always-correct fallback; never wedge serving on the exchange
+            self._mesh_exchange_failed = True
+            logger.error(
+                "mesh frame exchange unavailable (falling back to the "
+                "host transport hop): %r", e,
+            )
+        return self._mesh_exchange_obj
+
+    def exclude_device(self, device_index: int) -> ActorFuture:
+        """Operator/health entry: mark a mesh device dead. Its partitions
+        rebalance onto the remaining healthy devices and their LIVE engine
+        state migrates there (``place_on``). Runs on the broker actor —
+        serialized with the wave drain, so no wave is in flight across the
+        migration. Completes with {partition_id: new device index}."""
+
+        def do():
+            plan = self.device_plan
+            if plan is None:
+                return {}
+            moves = plan.exclude(device_index)
+            # the frame exchange spans ALL plan devices — a collective
+            # over a dead chip hangs/fails, so cross-partition frames
+            # fall back to the host transport hop from here on
+            self._mesh_exchange_obj = None
+            self._mesh_exchange_failed = True
+            for pid, new_idx in moves.items():
+                server = self.partitions.get(pid)
+                if server is None or server.engine is None:
+                    continue
+                place = getattr(server.engine, "place_on", None)
+                if place is None:
+                    continue
+                try:
+                    place(plan.devices[new_idx], new_idx)
+                except Exception:  # noqa: BLE001 - the chip is REALLY
+                    # gone: its committed arrays are unreadable, so the
+                    # state migrates the durable way instead — rebuild
+                    # the engine from snapshot + committed-log replay
+                    # (both host-side) via the normal leadership install,
+                    # which places onto the rebalanced device
+                    count_event(
+                        "mesh_state_migration_failures",
+                        "Live-state migrations off an excluded device "
+                        "that failed (partition reinstalled from "
+                        "snapshot + replay instead)",
+                    )
+                    logger.exception(
+                        "live-state migration off device %d failed for "
+                        "partition %d; reinstalling from snapshot+replay",
+                        device_index, pid,
+                    )
+                    term = server.raft.term
+                    server._uninstall_leader()
+                    server._install_leader(term)
+            if moves:
+                logger.warning(
+                    "mesh device %d excluded; partitions rebalanced: %s",
+                    device_index, moves,
+                )
+            return moves
+
+        return self.actor.call(do)
+
+    def readmit_device(self, device_index: int) -> ActorFuture:
+        """Undo ``exclude_device`` once the device is healthy again: new
+        placements may land on it, and the frame exchange (disabled at
+        exclusion — its collective spans every plan device) rebuilds
+        lazily on the next eligible send. Already-moved partitions stay
+        where they are; leadership churn rebalances over time."""
+
+        def do():
+            plan = self.device_plan
+            if plan is None:
+                return
+            plan.readmit(device_index)
+            self._mesh_exchange_failed = False
+
+        return self.actor.call(do)
+
+    def route_send(self, source_partition: int, target_partition: int,
+                   record: Record) -> None:
+        """Cross-partition command routing: when BOTH partitions are
+        device-resident leaders on this broker, the encoded frame rides
+        the mesh's all_to_all exchange (flushed once per scheduling round
+        in ``_drain_committed``) instead of the host transport hop;
+        everything else takes ``send_subscription_command``."""
+        if self._queue_mesh_send(source_partition, target_partition, record):
+            return
+        self.send_subscription_command(target_partition, record)
+
+    def _queue_mesh_send(self, source_partition: int, target_partition: int,
+                         record: Record) -> bool:
+        if self.wave_scheduler is None or not self.cfg.mesh.exchange:
+            return False
+        plan = self.device_plan
+        if plan is None:
+            return False
+        target = self.partitions.get(target_partition)
+        if target is None or not target.is_leader or target.engine is None:
+            return False
+        src = plan.device_index(source_partition)
+        dst = plan.device_index(target_partition)
+        if src < 0 or dst < 0:
+            return False
+        if src == dst:
+            # same device: there is no hop to ride (not even ICI) — the
+            # direct local append is strictly cheaper
+            return False
+        exchange = self._mesh_exchange()
+        if exchange is None:
+            return False
+        if exchange.queue(
+            src, dst, target_partition, codec.encode_record(record)
+        ):
+            return True
+        # refused (oversize / pair slots full): frames queued EARLIER in
+        # this round must land first — flush them now, then let the
+        # caller take the transport path, so per-destination command
+        # order is preserved across the mixed routing (a CLOSE appended
+        # before the OPEN it follows would strand a stale subscription)
+        if exchange.pending():
+            self._flush_mesh_exchange()
+        return False
+
+    def _flush_mesh_exchange(self) -> None:
+        """One collective exchange for the scheduling round's queued
+        frames; arrivals append at their destination partition exactly
+        like transport arrivals would (decode → position/timestamp reset →
+        raft append, deposed-leader failures re-entering the retry loop)."""
+        exchange = self._mesh_exchange_obj
+        if exchange is None or not exchange.pending():
+            return
+        try:
+            exchange.flush(self._deliver_mesh_frame)
+        except Exception as e:  # noqa: BLE001 - belt: flush handles
+            # collective/delivery failures internally (direct host
+            # delivery of the snapshot), so this only catches bugs in
+            # the flush plumbing itself
+            count_event(
+                "mesh_exchange_flush_failures",
+                "Mesh exchange frame deliveries that raised",
+            )
+            logger.error("mesh exchange flush failed: %r", e)
+
+    def _deliver_mesh_frame(self, partition_id: int, frame: bytes) -> None:
+        record, _ = codec.decode_record(bytes(frame))
+        record.position = -1
+        record.timestamp = -1
+        # same append contract as the transport path (leadership may have
+        # moved between queue and flush: send_subscription_command's
+        # fast-path/retry split handles every case)
+        self.send_subscription_command(partition_id, record)
+
     def _on_actor_failure(self, actor, exc: BaseException) -> None:
         """Scheduler failure listener: every swallowed actor exception is
         counted; 3+ during a broker's lifetime flip health to unhealthy
@@ -1209,7 +1465,12 @@ class ClusterBroker(Actor):
         self._drain_scheduled = False
         if self.wave_scheduler is None:
             return
-        self.wave_scheduler.drain()
+        try:
+            self.wave_scheduler.drain()
+        finally:
+            # the round's cross-partition frames ride ONE collective over
+            # the mesh (route_send queued them during the waves' applies)
+            self._flush_mesh_exchange()
         for server in list(self.partitions.values()):
             if server.is_leader:
                 # parked-record fetches start only once every in-flight

@@ -124,6 +124,29 @@ class SchedulerCfg:
 
 
 @dataclasses.dataclass
+class MeshCfg:
+    """Mesh-sharded serving plane (``scheduler/placement.DevicePlan``):
+    leader partitions are placed across the visible accelerator devices
+    (round-robin, rebalanced on leadership change), so the wave
+    scheduler's drain dispatches different partitions' wave segments to
+    DIFFERENT devices within one scheduling round. ``enabled = false``
+    pins every engine to the default device — the single-device A/B
+    baseline ``bench.py --mesh`` compares against. Only the device engine
+    (``[engine] type = "tpu"``) is placed; the host oracle has no device
+    state."""
+
+    enabled: bool = True
+    # cap on devices used (0 = every visible device)
+    devices: int = 0
+    # route cross-partition message-correlation command frames over the
+    # mesh's all_to_all exchange instead of the host transport hop when
+    # both partitions are device-resident on this broker
+    exchange: bool = True
+    exchange_slots: int = 32  # frames per (src, dst) device pair per round
+    exchange_frame_bytes: int = 1024  # slot width; larger frames fall back
+
+
+@dataclasses.dataclass
 class AdmissionCfg:
     """Gateway admission control (shed-before-collapse): commands beyond
     the per-connection in-flight bound — or arriving while the broker
@@ -182,6 +205,7 @@ class BrokerCfg:
     raft: RaftCfg = dataclasses.field(default_factory=RaftCfg)
     engine: EngineCfg = dataclasses.field(default_factory=EngineCfg)
     scheduler: SchedulerCfg = dataclasses.field(default_factory=SchedulerCfg)
+    mesh: MeshCfg = dataclasses.field(default_factory=MeshCfg)
     admission: AdmissionCfg = dataclasses.field(default_factory=AdmissionCfg)
     topics: List[TopicCfg] = dataclasses.field(default_factory=list)
     exporters: List[ExporterCfg] = dataclasses.field(default_factory=list)
@@ -197,6 +221,7 @@ _SECTION_KEYS = {
     "raft": RaftCfg,
     "engine": EngineCfg,
     "scheduler": SchedulerCfg,
+    "mesh": MeshCfg,
     "admission": AdmissionCfg,
 }
 
@@ -237,6 +262,12 @@ _ENV_OVERRIDES = {
         "enabled",
         lambda v: v.strip().lower() in ("1", "true", "yes"),
     ),
+    "ZEEBE_MESH_ENABLED": (
+        "mesh",
+        "enabled",
+        lambda v: v.strip().lower() in ("1", "true", "yes"),
+    ),
+    "ZEEBE_MESH_DEVICES": ("mesh", "devices", int),
 }
 
 

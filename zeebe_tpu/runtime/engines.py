@@ -44,6 +44,15 @@ def engine_factory_from_config(
 
                 autotune.ensure_autotuned()
                 pallas_ops.selfcheck()
+            # mesh placement: the broker's DevicePlan assigned this leader
+            # partition a device at install time — the engine's state
+            # commits there and its waves compute there, concurrently with
+            # other partitions' waves on other devices
+            device = None
+            device_index = -1
+            planned = getattr(broker, "planned_device", None)
+            if planned is not None:
+                device, device_index = planned(partition_id)
             engine = TpuPartitionEngine(
                 partition_id,
                 broker.cfg.cluster.partitions,
@@ -52,6 +61,8 @@ def engine_factory_from_config(
                 capacity=capacity,
                 num_vars=num_vars,
                 sub_capacity=sub_capacity,
+                device=device,
+                device_index=device_index,
             )
             import jax as _jax
 

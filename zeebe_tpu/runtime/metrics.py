@@ -363,6 +363,107 @@ def observe_shared_wave(
     )
 
 
+# -- mesh serving instrumentation --------------------------------------------
+# The mesh-sharded serving plane (scheduler/placement.DevicePlan) places
+# leader partitions across devices; these series prove the spread is real:
+# per-device wave/record/occupancy/time-split (labeled by plan device
+# index) and the per-shared-wave distinct-device count — ">1 device active
+# per scheduling round" is serving_wave_devices_mean > 1.
+_DEVICE_WAVE_HANDLES: dict = {}
+_MESH_WAVE_HANDLES: dict = {}
+
+
+def _device_wave_handles(device: str) -> dict:
+    h = _DEVICE_WAVE_HANDLES.get(device)
+    if h is None:
+        g = GLOBAL_REGISTRY
+        h = dict(
+            waves=g.counter(
+                "serving_device_waves_total",
+                "Wave segments dispatched to each mesh device",
+                device=device,
+            ),
+            records=g.counter(
+                "serving_device_records_total",
+                "Records processed per mesh device",
+                device=device,
+            ),
+            share=g.gauge(
+                "serving_device_wave_share",
+                "Share of the most recent shared wave's records that "
+                "landed on each mesh device (balance view; ~1/active "
+                "devices under uniform load)",
+                device=device,
+            ),
+            host_s=g.counter(
+                "serving_device_host_seconds_total",
+                "Host seconds spent staging/collecting per mesh device",
+                device=device,
+            ),
+            device_s=g.counter(
+                "serving_device_device_seconds_total",
+                "Seconds blocked on each mesh device's outputs",
+                device=device,
+            ),
+        )
+        _DEVICE_WAVE_HANDLES[device] = h
+    return h
+
+
+def observe_device_wave(
+    device_index: int,
+    records: int,
+    wave_total: int,
+    host_seconds: float = 0.0,
+    device_seconds: float = 0.0,
+) -> None:
+    """Record one wave segment landing on a mesh device (labeled by the
+    DevicePlan index). ``wave_total`` is the WHOLE shared wave's record
+    count — the share gauge reads balance across devices, not fill.
+    Called by the wave scheduler per dispatched segment; engines without
+    a plan placement (index < 0) are skipped."""
+    if device_index < 0:
+        return
+    h = _device_wave_handles(str(device_index))
+    h["waves"].inc()
+    h["records"].inc(records)
+    if wave_total > 0:
+        h["share"].set(records / wave_total)
+    if host_seconds > 0:
+        h["host_s"].inc(host_seconds)
+    if device_seconds > 0:
+        h["device_s"].inc(device_seconds)
+
+
+def observe_mesh_wave(devices_active: int) -> None:
+    """Distinct mesh devices that received segments of one shared wave."""
+    h = _MESH_WAVE_HANDLES
+    if not h:
+        g = GLOBAL_REGISTRY
+        h.update(
+            devices=g.gauge(
+                "serving_wave_devices",
+                "Mesh devices active in the most recent shared wave",
+            ),
+            devices_total=g.counter(
+                "scheduler_wave_devices_total",
+                "Sum of active mesh devices over all shared waves "
+                "(mean = this / scheduler_shared_waves_total)",
+            ),
+            waves=g.counter("scheduler_shared_waves_total"),
+            devices_mean=g.gauge(
+                "serving_wave_devices_mean",
+                "Mean mesh devices active per shared wave since process "
+                "start (>1 = device compute overlaps across the mesh)",
+            ),
+        )
+    h["devices"].set(devices_active)
+    h["devices_total"].inc(devices_active)
+    h["devices_mean"].set(
+        h["devices_total"].value / max(h["waves"].value, 1.0)
+    )
+
+
 def render_with_global(registry: MetricsRegistry, now_ms: Optional[int] = None) -> str:
     """A registry's Prometheus dump with the global event counters appended
     (skipped when the registry IS the global one — no duplicate series)."""

@@ -13,6 +13,7 @@ from zeebe_tpu.scheduler.admission import (
     REASON_CONNECTION_INFLIGHT,
     REASON_QUEUE_DEPTH,
 )
+from zeebe_tpu.scheduler.placement import DevicePlan, MeshExchange
 from zeebe_tpu.scheduler.wave import (
     PartitionFeed,
     SharedWave,
@@ -23,6 +24,8 @@ from zeebe_tpu.scheduler.wave import (
 __all__ = [
     "AdmissionConfig",
     "AdmissionController",
+    "DevicePlan",
+    "MeshExchange",
     "PartitionFeed",
     "REASON_CONNECTION_INFLIGHT",
     "REASON_QUEUE_DEPTH",

@@ -39,7 +39,12 @@ from __future__ import annotations
 import logging
 from typing import Dict, List, Optional
 
-from zeebe_tpu.runtime.metrics import count_event, observe_shared_wave
+from zeebe_tpu.runtime.metrics import (
+    count_event,
+    observe_device_wave,
+    observe_mesh_wave,
+    observe_shared_wave,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -69,9 +74,14 @@ class PartitionFeed:
       segment, so the records re-drain instead of being lost).
     - ``tick()`` — deadline/TTL sweep entry (probe + command append);
       optional.
+    - ``device_index`` — the mesh device this partition's engine is placed
+      on (scheduler/placement.DevicePlan index), -1 when unplaced. Used
+      only for the per-device wave metrics; dispatch itself is routed by
+      the ENGINE's committed state placement.
     """
 
     partition_id: int = -1
+    device_index: int = -1
 
     def backlog(self) -> int:  # pragma: no cover - interface default
         return 0
@@ -287,6 +297,14 @@ class WaveScheduler:
             seg.pending = pending
             wave.host_seconds += host_s
             wave.device_seconds += device_s
+            if pending is None:
+                # synchronous engine: the segment processed+applied inline,
+                # so its per-device accounting lands here (pipelined
+                # segments report at collect, when their times are known)
+                observe_device_wave(
+                    getattr(seg.feed, "device_index", -1), seg.count,
+                    wave.total, host_s, device_s,
+                )
             if pending is not None and state is not None:
                 state.inflight += seg.count
 
@@ -303,6 +321,10 @@ class WaveScheduler:
                 host_s, device_s = seg.feed.collect(pending)
                 wave.host_seconds += host_s
                 wave.device_seconds += device_s
+                observe_device_wave(
+                    getattr(seg.feed, "device_index", -1), seg.count,
+                    wave.total, host_s, device_s,
+                )
             except Exception as e:  # noqa: BLE001 - one partition's
                 # collect failure must not strand the other segments'
                 # responses; re-raised after the loop
@@ -314,6 +336,14 @@ class WaveScheduler:
             wave.total, self.wave_size, len(wave.segments),
             wave.host_seconds, wave.device_seconds,
         )
+        devices = {
+            getattr(seg.feed, "device_index", -1)
+            for seg in wave.segments if seg.count
+        }
+        devices.discard(-1)
+        if devices:
+            # >1 here means this wave's compute overlapped across the mesh
+            observe_mesh_wave(len(devices))
         if error is not None:
             raise error
 

@@ -232,9 +232,19 @@ class TpuPartitionEngine:
         capacity: int = 1 << 12,
         num_vars: int = 16,
         sub_capacity: int = 16,
+        device=None,
+        device_index: int = -1,
     ):
         self.partition_id = partition_id
         self.num_partitions = num_partitions
+        # mesh placement (scheduler/placement.DevicePlan): this engine's
+        # state lives COMMITTED on `device`, batches stage onto it, and the
+        # step program executes there — so several partitions' waves
+        # compute concurrently across the mesh. None = default device (the
+        # single-device baseline). `device_index` is the plan's index,
+        # used only as the per-device metrics label.
+        self.device = device
+        self.device_index = device_index
         self.repository = repository if repository is not None else WorkflowRepository()
         self.clock = clock or (lambda: 0)
         # pallas-vs-XLA dispatch is BUILD-dependent (PERF_NOTES round 4):
@@ -260,8 +270,10 @@ class TpuPartitionEngine:
 
         self.graph: Optional[graph_mod.DeviceGraph] = None
         self.meta: Optional[graph_mod.GraphMeta] = None
-        self.state = state_mod.make_state(
-            capacity=capacity, num_vars=num_vars, sub_capacity=sub_capacity
+        self.state = self._place(
+            state_mod.make_state(
+                capacity=capacity, num_vars=num_vars, sub_capacity=sub_capacity
+            )
         )
         # key watermark of the last rebuild_lookup_state run: the direct-
         # mapped indexes are collision-free only within a window of index-
@@ -304,6 +316,30 @@ class TpuPartitionEngine:
         # bumped by _recompile: workflow SLOTS in older emission batches
         # are stale after a redeploy — the staging fast path checks this
         self._meta_epoch = 0
+
+    # -- mesh placement ----------------------------------------------------
+    def _place(self, tree):
+        """Commit a pytree's arrays to this engine's mesh device (no-op for
+        the default single-device engine). Committed placement is what
+        makes the jit programs EXECUTE there; uncommitted companions
+        (clock scalars, migration rows) follow the committed operands."""
+        if self.device is None:
+            return tree
+        return jax.device_put(tree, self.device)
+
+    def place_on(self, device, device_index: int = -1) -> None:
+        """Migrate this engine's live device state onto another mesh device
+        (DevicePlan rebalance after a device exclusion or leadership
+        change). Content is unchanged — snapshot dirty-tracking is
+        untouched — and the next dispatched wave compiles/executes on the
+        new device. Call between waves (the brokers do: placement changes
+        happen on the broker actor, serialized with the drain)."""
+        self.device = device
+        self.device_index = device_index
+        if device is not None:
+            self.state = jax.device_put(self.state, device)
+            if self.graph is not None:
+                self.graph = jax.device_put(self.graph, device)
 
     # -- routing ----------------------------------------------------------
     def partition_for_correlation_key(self, correlation_key: str) -> int:
@@ -354,6 +390,9 @@ class TpuPartitionEngine:
         self.graph, self.meta = graph_mod.compile_graph(
             workflows, interns=self.interns, extra_variables=var_names
         )
+        # the graph is replicated per engine: committed next to the state
+        # so a step never re-transfers it from the default device per call
+        self.graph = self._place(self.graph)
         if self.graph.num_vars > self.num_vars:
             raise PayloadError(
                 f"workflow variables ({self.graph.num_vars}) exceed engine "
@@ -1373,7 +1412,7 @@ class TpuPartitionEngine:
         # a bucket layout the local builder would not produce, and the
         # fallback maps must cover every restored live instance
         st = state_mod.rebuild_lookup_state(st)
-        self.state = st
+        self.state = self._place(st)
         self._keys_at_rebuild = 0
         self.capacity = st.capacity
         self.num_vars = st.num_vars
@@ -1910,9 +1949,15 @@ class TpuPartitionEngine:
         bools = np.empty((size, len(self._BOOL_COLS)), bool)
         for j, name in enumerate(self._BOOL_COLS):
             bools[:, j] = cols[name]
-        i64_dev = jnp.asarray(i64)
-        i32_dev = jnp.asarray(i32)
-        bool_dev = jnp.asarray(bools)
+        # staged columns commit to THIS engine's mesh device (placement is
+        # what routes the step program to it); default device otherwise
+        put = (
+            jnp.asarray if self.device is None
+            else (lambda a: jax.device_put(a, self.device))
+        )
+        i64_dev = put(i64)
+        i32_dev = put(i32)
+        bool_dev = put(bools)
         kw: Dict[str, jax.Array] = {}
         for j, name in enumerate(self._I64_COLS):
             kw[name] = i64_dev[:, j]
@@ -1920,9 +1965,9 @@ class TpuPartitionEngine:
             kw[name] = i32_dev[:, j]
         for j, name in enumerate(self._BOOL_COLS):
             kw[name] = bool_dev[:, j]
-        kw["v_vt"] = jnp.asarray(cols["v_vt"])
-        kw["v_num"] = jnp.asarray(cols["v_num"])
-        kw["v_str"] = jnp.asarray(cols["v_str"])
+        kw["v_vt"] = put(cols["v_vt"])
+        kw["v_num"] = put(cols["v_num"])
+        kw["v_str"] = put(cols["v_str"])
         return RecordBatch(**kw)
 
     def warm(self, sizes=(512,)) -> None:

@@ -200,6 +200,57 @@ def build_sharded_step(mesh: Mesh, exchange_slots: int = 128):
     return jax.jit(sharded_step), nparts
 
 
+def build_frame_exchange(mesh: Mesh, slots: int, frame_bytes: int):
+    """The subscription-transport hop for the SERVING plane, as a mesh
+    collective: encoded record frames ride the same per-destination
+    ``all_to_all`` exchange-slot pattern ``build_sharded_step`` uses for
+    staged record rows — but as raw wire bytes, so the destination decodes
+    EXACTLY what the host transport would have carried (bit-identical
+    appends by construction; see scheduler/placement.MeshExchange).
+
+    Returns ``exchange(buf[D,D,S,B] u8, lens[D,D,S] i32, pids[D,D,S] i32)
+    → (buf', lens', pids')`` where row ``d`` of each output carries the
+    frames addressed TO device ``d``, indexed [source device, slot].
+    """
+    axis = mesh.axis_names[0]
+
+    def shard_fn(buf, lens, pids):
+        buf = jnp.squeeze(buf, axis=0)    # [D, S, B] rows per destination
+        lens = jnp.squeeze(lens, axis=0)  # [D, S]
+        pids = jnp.squeeze(pids, axis=0)
+        out_buf = jax.lax.all_to_all(buf, axis, 0, 0)
+        out_lens = jax.lax.all_to_all(lens, axis, 0, 0)
+        out_pids = jax.lax.all_to_all(pids, axis, 0, 0)
+        return out_buf[None], out_lens[None], out_pids[None]
+
+    spec = P(axis)
+    fn = jax.jit(_shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=(spec, spec, spec),
+        check_vma=False,
+    ))
+    n = mesh.devices.shape[0]
+
+    def exchange(buf, lens, pids):
+        # the builder's geometry IS the contract: a mismatched caller
+        # would otherwise shard garbage silently
+        if buf.shape != (n, n, slots, frame_bytes):
+            raise ValueError(
+                f"frame exchange built for buf shape "
+                f"{(n, n, slots, frame_bytes)}, got {buf.shape}"
+            )
+        if lens.shape != (n, n, slots) or pids.shape != (n, n, slots):
+            raise ValueError(
+                f"frame exchange built for lane shape {(n, n, slots)}, "
+                f"got {lens.shape} / {pids.shape}"
+            )
+        return fn(buf, lens, pids)
+
+    return exchange
+
+
 def make_exchange(num_partitions: int, slots: int, num_vars: int) -> RecordBatch:
     """The cross-partition send buffer: [P, P, S] record rows (source,
     destination, slot)."""
