@@ -44,6 +44,15 @@ echo "== host-path bench smoke (columnar plane: stage counts match, codec"
 echo "   bit-identity, zero lazy-row materializations; non-timing asserts) =="
 JAX_PLATFORMS=cpu python bench.py --host-path --smoke > /dev/null
 
+echo "== trace smoke (sample_rate=1.0: every lifecycle stage present +"
+echo "   monotonic, wave timelines, trace_report round-trips valid JSON) =="
+JAX_PLATFORMS=cpu python tools/trace_smoke.py
+
+echo "== tracing overhead A/B structural leg (spans at 1.0, zero spans"
+echo "   with the tracer uninstalled; the timed ≤2% gate runs in the full"
+echo "   'python bench.py --tracing-ab') =="
+JAX_PLATFORMS=cpu python bench.py --tracing-ab --smoke > /dev/null
+
 echo "== wave-scheduler smoke (skewed-traffic fill >= 2x per-partition"
 echo "   baseline, per-partition logs bit-identical, overload sheds) =="
 JAX_PLATFORMS=cpu python tools/scheduler_smoke.py

@@ -29,6 +29,7 @@ from zeebe_tpu.testing.chaos import (
     ChaosHarness,
     DiskFaults,
     FaultPlane,
+    invariant,
     oracle_state_bytes,
     replay_oracle,
 )
@@ -321,9 +322,20 @@ class TestChaosRaftFixedSeed:
             for nid, log_ in cluster.logs.items():
                 for pos, (term, jtype) in acked.items():
                     record = log_.record_at(pos)
-                    assert record is not None, (nid, pos)
-                    assert record.raft_term == term, (nid, pos)
-                    assert getattr(record.value, "type", None) == jtype, (nid, pos)
+                    invariant(
+                        record is not None,
+                        f"invariant 1: acked record lost on {nid} at {pos}",
+                    )
+                    invariant(
+                        record.raft_term == term,
+                        f"invariant 1: acked record term diverged on "
+                        f"{nid} at {pos}",
+                    )
+                    invariant(
+                        getattr(record.value, "type", None) == jtype,
+                        f"invariant 1: acked record value diverged on "
+                        f"{nid} at {pos}",
+                    )
 
             # invariant 2: at most one leader per term
             ledger.assert_at_most_one_leader_per_term()
@@ -398,14 +410,31 @@ def _assert_oracle_parity(harness):
     )
     oracle_a = replay_oracle(committed)
     oracle_b = replay_oracle(committed)
-    assert oracle_state_bytes(oracle_a) == oracle_state_bytes(oracle_b)
-    assert set(oracle_a.jobs) == set(live.jobs)
-    for key, job in live.jobs.items():
-        assert oracle_a.jobs[key].state == job.state, key
-    assert sorted(oracle_a.element_instances.instances) == sorted(
-        live.element_instances.instances
+    invariant(
+        oracle_state_bytes(oracle_a) == oracle_state_bytes(oracle_b),
+        "invariant 3: independent oracle replays diverged bit-for-bit",
     )
-    assert oracle_a.last_processed_position == live.last_processed_position
+    invariant(
+        set(oracle_a.jobs) == set(live.jobs),
+        "invariant 3: oracle replay job set diverged from the live engine",
+    )
+    for key, job in live.jobs.items():
+        invariant(
+            oracle_a.jobs[key].state == job.state,
+            f"invariant 3: job {key} state diverged between replay and "
+            "live engine",
+        )
+    invariant(
+        sorted(oracle_a.element_instances.instances)
+        == sorted(live.element_instances.instances),
+        "invariant 3: element-instance set diverged between replay and "
+        "live engine",
+    )
+    invariant(
+        oracle_a.last_processed_position == live.last_processed_position,
+        "invariant 3: last processed position diverged between replay "
+        "and live engine",
+    )
 
 
 class TestChaosBrokerFixedSeed:
@@ -972,22 +1001,26 @@ def _assert_exporter_invariants(harness, exporter_id="chaos-mem"):
     sink = InMemoryExporter.sink(exporter_id)
     seen = {r.position for r in sink}
     missing = [p for p in expected if p not in seen]
-    assert not missing, (
+    invariant(
+        not missing,
         f"exporter {exporter_id!r} never saw committed positions "
-        f"{missing[:10]} (gap: at-least-once violated)"
+        f"{missing[:10]} (gap: at-least-once violated)",
     )
     for i, episode in enumerate(InMemoryExporter.episodes(exporter_id)):
         positions = [r.position for r in episode]
-        assert positions == sorted(positions), (
-            f"episode {i} delivered out of order"
+        invariant(
+            positions == sorted(positions),
+            f"exporter episode {i} delivered out of order",
         )
         # gap-free within an episode: the positions it saw are a
         # contiguous slice of the committed non-admin sequence
         idx = {p: n for n, p in enumerate(expected)}
         views = [idx[p] for p in positions if p in idx]
         if views:
-            assert views == list(range(views[0], views[0] + len(views))), (
-                f"episode {i} skipped committed records mid-stream"
+            invariant(
+                views == list(range(views[0], views[0] + len(views))),
+                f"exporter episode {i} skipped committed records "
+                "mid-stream",
             )
     return committed
 

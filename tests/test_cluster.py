@@ -397,6 +397,15 @@ class TestTopicOrchestration:
                 client.create_topic("dup-topic", partitions=1)
             except ClientException as e:
                 assert "already exists" in str(e), e
+                # the documented at-least-once window was taken: leave its
+                # forensics in the test log — the flight recorder holds
+                # the leadership churn that made the retry cross leaders
+                from zeebe_tpu.tracing.recorder import FLIGHT
+
+                print(
+                    "[duplicate-topic tolerance branch taken] recent "
+                    "flight-recorder events:\n" + FLIGHT.format_slice(40)
+                )
                 # the tolerance is ONLY for the duplicate-command window:
                 # the topic must genuinely exist (created by our own
                 # first command) — any other spurious rejection fails

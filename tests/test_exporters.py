@@ -186,6 +186,23 @@ class TestDirector:
         director.pump()
         assert ok.positions() == [0]
         assert director.handles[0].broken is not None
+        # one live exporter keeps the ack plane alive for tracing...
+        assert director.can_ack()
+        director.close()
+
+    def test_all_broken_handles_cannot_ack(self, tmp_path):
+        """Tracing probes can_ack() to decide whether the response/apply
+        is a span's final stage: a director whose every exporter broke at
+        open will never ack, and waiting on it would leak every span."""
+        class Exploding(Exporter):
+            def open(self, controller):
+                raise RuntimeError("boom")
+
+        log = make_log(tmp_path)
+        log.append([job_record(0)])
+        director = make_director(log, [("a", Exploding()), ("b", Exploding())])
+        director.open({})
+        assert not director.can_ack()
         director.close()
 
     def test_manual_ack_holds_position_until_confirmed(self, tmp_path):

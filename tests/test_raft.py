@@ -36,7 +36,10 @@ def wait_until(predicate, timeout=10.0, interval=0.01):
 
 def append_with_retry(cluster, records, timeout=15):
     """Append via the current leader, retrying on leadership changes (what
-    the reference client's topology-aware retry does)."""
+    the reference client's topology-aware retry does). ``append`` acks at
+    COMMIT: a deposed leader fails the future (records truncated by the
+    new leader) and the retry lands on the real one; a slow quorum round
+    under load surfaces as a join timeout and retries the same way."""
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         leader = cluster.leader()
@@ -45,7 +48,7 @@ def append_with_retry(cluster, records, timeout=15):
             continue
         try:
             return leader, leader.append(records).join(5)
-        except RuntimeError:
+        except (RuntimeError, TimeoutError):
             time.sleep(0.05)
     raise AssertionError("could not append within timeout")
 
@@ -187,6 +190,179 @@ class TestReplication:
         finally:
             cluster.close()
 
+    def test_commit_stall_watchdog_rearms_on_progress(self):
+        """Commit progress ends a stall episode even while newer pendings
+        remain — under sustained load _pending_commits never drains to
+        empty, and a once-armed watchdog would otherwise never warn or
+        count again (the metrics doc tells operators to alert on
+        sustained zb_raft_commit_stalls growth)."""
+        import threading
+        from types import SimpleNamespace
+
+        from zeebe_tpu.cluster.raft import Raft
+        from zeebe_tpu.runtime.actors import ActorFuture
+
+        def stub(commit, pendings):
+            return SimpleNamespace(
+                _append_lock=threading.Lock(),
+                _pending_commits=pendings,
+                _commit_stall_warned=True,
+                _traced_bound=set(),
+                log=SimpleNamespace(commit_position=commit),
+            )
+
+        f1, f2 = ActorFuture(), ActorFuture()
+        s = stub(1, [(0, 1, 0, f1), (2, 3, 0, f2)])
+        Raft._resolve_pending_commits(s)
+        assert f1.is_done() and not f2.is_done()
+        assert s._pending_commits == [(2, 3, 0, f2)]
+        assert s._commit_stall_warned is False  # progress re-armed it
+
+        s = stub(-1, [(0, 1, 0, ActorFuture())])
+        Raft._resolve_pending_commits(s)
+        assert s._commit_stall_warned is True  # wedged: still one episode
+
+    def test_follower_truncate_spares_other_brokers_spans(self):
+        """The tracer is process-global: a follower truncating its own
+        divergent suffix must not finish spans the in-process LEADER
+        bound at the same positions — only the raft that bound a span
+        (tracked in _traced_bound) may truncate-finish it."""
+        import threading
+        from types import SimpleNamespace
+
+        from zeebe_tpu import tracing
+        from zeebe_tpu.cluster.raft import Raft
+
+        tracer = tracing.install(tracing.RecordTracer(sample_rate=1.0))
+        try:
+            span = tracer.maybe_sample(0)
+            tracer.bind_request(span, 1, 0)
+            assert tracer.bind_append(1, 0, 7) is True
+
+            def stub(bound):
+                s = SimpleNamespace(
+                    _append_lock=threading.Lock(),
+                    _pending_commits=[],
+                    _commit_stall_warned=False,
+                    _traced_bound=bound,
+                    log=SimpleNamespace(partition_id=0),
+                    node_id="nX",
+                    persistent=SimpleNamespace(term=2),
+                )
+                return s
+
+            Raft._fail_pending_from(stub(set()), 5, "follower truncate")
+            assert not span.finished  # the leader's span survived
+
+            Raft._fail_pending_from(stub({7}), 5, "leader truncate")
+            assert span.finished
+            assert "truncated" in span.stage_names()
+        finally:
+            tracing.install(None)
+
+    def test_snapshot_fast_forward_fails_pending_appends(self):
+        """Snapshot catch-up resets the log without going through
+        set_commit_position, so a deposed leader's acked-means-committed
+        futures would never resolve — the fast-forward hook must fail
+        them so callers retry on the real leader."""
+        import threading
+        from types import SimpleNamespace
+
+        from zeebe_tpu.cluster.raft import Raft
+        from zeebe_tpu.runtime.actors import ActorFuture
+
+        future = ActorFuture()
+        stub = SimpleNamespace(
+            _append_lock=threading.Lock(),
+            _pending_commits=[(5, 9, 0, future)],
+            _commit_stall_warned=True,
+            _traced_bound=set(),
+            log=SimpleNamespace(partition_id=0),
+            node_id="n0",
+            persistent=SimpleNamespace(term=3),
+        )
+        stub._fail_pending_from = Raft._fail_pending_from.__get__(stub)
+        Raft.on_snapshot_fast_forward(stub)
+        assert stub._pending_commits == []
+        with pytest.raises(RuntimeError, match="fast-forward"):
+            future.join(1)
+
+    def test_append_racing_close_fails_fast(self, scheduler, tmp_path):
+        """An append whose drain lands after close() must fail its future
+        immediately — close() sweeps _pending_commits exactly once, so a
+        drain registering entries after that sweep would leave the caller
+        hanging with no replication and no resolver left (regression from
+        the acked-means-committed change)."""
+        cluster = Cluster(scheduler, tmp_path, 3)
+        try:
+            leader = cluster.await_leader()
+            leader.close()  # transports dead, but the actor still runs
+            future = leader.append([job_record(0)])
+            with pytest.raises(RuntimeError, match="raft closed|not leader"):
+                future.join(5)
+        finally:
+            cluster.close()
+
+    def test_deposed_leader_append_resolves_and_cluster_stays_live(
+        self, scheduler, tmp_path
+    ):
+        """Regression for the recorded replication flake (commit stuck at
+        the no-op): an append landing on a leader that was already deposed
+        — but had not yet heard the new term — used to ack on local
+        durability, and the new leader then truncated the records, so a
+        caller retrying only on failure waited forever for a commit that
+        could never come. Acked-means-committed closes the window: the
+        deposed leader's future RESOLVES (exceptionally when truncated)
+        as soon as the new leader makes contact, and the retry commits on
+        the real leader."""
+        from zeebe_tpu.testing.chaos import FaultPlane
+
+        cluster = Cluster(scheduler, tmp_path, 3)
+        plane = FaultPlane(seed=7)
+        try:
+            for nid, node in cluster.nodes.items():
+                plane.register_endpoint(nid, node.address)
+                plane.install_client(node.client, nid)
+                plane.install_server(node.server, nid)
+            old = cluster.await_leader()
+            assert wait_until(
+                lambda: all(
+                    log.commit_position >= 0 for log in cluster.logs.values()
+                )
+            )
+            plane.isolate(old.node_id)
+            assert wait_until(
+                lambda: any(
+                    n.state == RaftState.LEADER and n.node_id != old.node_id
+                    for n in cluster.nodes.values()
+                ),
+                timeout=15,
+            ), {nid: n.state for nid, n in cluster.nodes.items()}
+            # the deposed-but-unaware leader accepts the append locally;
+            # the future must NOT ack it (the records cannot commit)
+            future = old.append([job_record(0)])
+            plane.heal()
+            try:
+                last = future.join(15)
+                # only legitimate if the record genuinely committed
+                assert wait_until(
+                    lambda: cluster.logs[old.node_id].commit_position >= last
+                )
+            except RuntimeError as e:
+                assert "not leader" in str(e)
+            # liveness: a retry commits cluster-wide (this is exactly the
+            # wait the flaky test timed out on)
+            leader, last = append_with_retry(cluster, [job_record(1)])
+            assert wait_until(
+                lambda: all(
+                    log.commit_position >= last
+                    for log in cluster.logs.values()
+                ),
+                timeout=15,
+            ), {nid: log.commit_position for nid, log in cluster.logs.items()}
+        finally:
+            cluster.close()
+
     def test_append_on_follower_rejected(self, scheduler, tmp_path):
         cluster = Cluster(scheduler, tmp_path, 3)
         try:
@@ -222,11 +398,16 @@ class TestReplication:
                 if node.node_id != leader.node_id:
                     node.close()
             committed_before = cluster.logs[leader.node_id].commit_position
-            # a dying follower's last election poll (term+1) may legally
-            # depose the leader before the append lands — both outcomes
-            # prove the safety property: nothing can COMMIT without quorum
+            # acked-means-committed: without quorum the append future can
+            # never complete successfully — it either times out (no
+            # commit possible) or fails "not leader" (a dying follower's
+            # last election poll legally deposed the leader first). A
+            # successful ack here would BE the safety violation.
             try:
                 leader.append([job_record(0)]).join(5)
+                pytest.fail("append acked without a quorum to commit it")
+            except TimeoutError:
+                pass
             except RuntimeError as e:
                 assert "not leader" in str(e)
             time.sleep(0.5)

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""CI smoke: record-lifecycle tracing end to end.
+
+Boots an in-process broker with ``sample_rate=1.0`` and a JSONL exporter,
+runs a workflow through deploy → create → work → complete, then asserts:
+
+1. every sampled client command's span carries the full lifecycle —
+   gateway receive → commit → feed take → wave dispatch → apply →
+   response → exporter dispatch → exporter ack — with MONOTONIC
+   timestamps in stamp order;
+2. wave timelines were recorded and internally consistent
+   (collect >= dispatch per segment);
+3. the tracer dump converts through ``tools/trace_report.py`` into valid
+   Chrome-trace JSON that parses back (round trip).
+
+Exits non-zero on any violation.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from zeebe_tpu import tracing  # noqa: E402
+from zeebe_tpu.gateway import JobWorker, ZeebeClient  # noqa: E402
+from zeebe_tpu.models.bpmn.builder import Bpmn  # noqa: E402
+from zeebe_tpu.runtime import Broker  # noqa: E402
+from zeebe_tpu.runtime.config import ExporterCfg  # noqa: E402
+
+# the canonical single-writer lifecycle (no raft hops in-process; the
+# cluster-side raft_queue/raft_fsync stages are pinned by
+# tests/test_tracing.py instead)
+REQUIRED_STAGES = [
+    tracing.GATEWAY_RECV,
+    tracing.COMMIT,
+    tracing.FEED_TAKE,
+    tracing.WAVE_DISPATCH,
+    tracing.APPLY,
+    tracing.RESPONSE,
+    tracing.EXPORT_DISPATCH,
+    tracing.EXPORT_ACK,
+]
+
+
+def fail(msg: str) -> int:
+    print(f"trace smoke: FAIL — {msg}")
+    return 1
+
+
+def main() -> int:
+    tracer = tracing.install(tracing.RecordTracer(sample_rate=1.0, seed=1))
+    data_dir = tempfile.mkdtemp(prefix="zb-trace-smoke-")
+    audit_dir = tempfile.mkdtemp(prefix="zb-trace-smoke-audit-")
+    broker = Broker(
+        data_dir=data_dir,
+        exporters=[
+            ExporterCfg(id="audit", type="jsonl", args={"path": audit_dir}),
+        ],
+    )
+    client = ZeebeClient(broker)
+    model = (
+        Bpmn.create_process("trace-order")
+        .start_event("start")
+        .service_task("work", type="trace-svc")
+        .end_event("end")
+        .done()
+    )
+    client.deploy_model(model)
+    JobWorker(broker, "trace-svc", lambda ctx: {"done": True})
+    for i in range(5):
+        client.create_instance("trace-order", {"i": i})
+    broker.run_until_idle()
+    broker.close()
+
+    spans = tracer.spans()
+    if not spans:
+        return fail("no spans sampled at sample_rate=1.0")
+    # spans for records that produced a response AND were exported must
+    # carry the complete lifecycle; count how many do
+    complete = 0
+    for span in spans:
+        names = span.stage_names()
+        if tracing.RESPONSE not in names:
+            continue  # acks and fire-and-forget commands have no response
+        missing = [s for s in REQUIRED_STAGES if s not in names]
+        if missing:
+            return fail(
+                f"span trace_id={span.trace_id} position={span.position} "
+                f"missing lifecycle stages {missing} (has {names})"
+            )
+        ts = [t for _n, t, _f in span.stages]
+        if ts != sorted(ts):
+            return fail(
+                f"span trace_id={span.trace_id} timestamps not monotonic: "
+                f"{list(zip(names, ts))}"
+            )
+        complete += 1
+    if complete < 5:  # at least the five CREATE commands
+        return fail(f"only {complete} spans completed the full lifecycle")
+
+    waves = tracer.waves.snapshot()
+    if not waves:
+        return fail("no wave timelines recorded")
+    for wave in waves:
+        for seg in wave["segments"]:
+            if seg["t_collect_us"] >= 0 and (
+                seg["t_collect_us"] < seg["t_dispatch_us"]
+            ):
+                return fail(f"wave {wave['wave_id']} segment collected "
+                            "before dispatch")
+
+    # dump → trace_report → valid Chrome-trace JSON round trip
+    dump_path = os.path.join(data_dir, "trace-dump.json")
+    tracer.dump(dump_path)
+    import importlib
+
+    trace_report = importlib.import_module("trace_report")
+    with open(dump_path) as f:
+        doc = json.load(f)
+    chrome = json.loads(json.dumps(trace_report.convert(doc)))
+    if not chrome["traceEvents"]:
+        return fail("trace_report produced no events")
+    if not any(e["pid"] == "records" for e in chrome["traceEvents"]):
+        return fail("trace_report produced no record tracks")
+    if not any(e["pid"] == "devices" for e in chrome["traceEvents"]):
+        return fail("trace_report produced no device/wave tracks")
+
+    stats = tracer.stats()
+    tracing.install(None)
+    print(
+        f"trace smoke: OK — {complete} spans with the full "
+        f"{len(REQUIRED_STAGES)}-stage lifecycle (of {stats['sampled']} "
+        f"sampled), {len(waves)} wave timelines, "
+        f"{len(chrome['traceEvents'])} Chrome-trace events round-tripped"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

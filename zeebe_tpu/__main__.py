@@ -63,6 +63,11 @@ def main(argv=None) -> int:
     if args.data_dir:
         cfg.data.directory = args.data_dir
     data_dir = os.path.join(cfg.data.directory, cfg.cluster.node_id)
+    # operator forensics hook: SIGUSR2 dumps the flight recorder's recent
+    # control-plane events to disk (docs/operations/tracing.md)
+    from zeebe_tpu.tracing import install_signal_dump
+
+    install_signal_dump()
     broker = ClusterBroker(
         cfg, data_dir, engine_factory=engine_factory_from_config(cfg)
     )

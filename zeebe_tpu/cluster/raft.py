@@ -36,6 +36,7 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from zeebe_tpu._events import count_event as _count_event
+from zeebe_tpu.tracing.recorder import record_event as _flight
 from zeebe_tpu.log.logstream import LogStream
 from zeebe_tpu.protocol import codec, msgpack
 from zeebe_tpu.runtime.actors import Actor, ActorFuture, ActorScheduler
@@ -64,6 +65,10 @@ class RaftConfig:
     # cluster was least healthy)
     rpc_backoff_base_ms: int = 50
     rpc_backoff_max_ms: int = 2000
+    # commit-latency watchdog: a leader holding appends un-COMMITTED for
+    # longer than this logs + counts + flight-records the stall (the
+    # "commit stuck at the no-op" failure class)
+    commit_stall_ms: int = 5000
 
 
 class RaftPersistentStorage:
@@ -146,6 +151,21 @@ class Raft(Actor):
         # one durability flush (see append)
         self._append_queue: List[tuple] = []
         self._append_lock = threading.Lock()
+        # appended-but-uncommitted caller futures: (first, last, enq_ms,
+        # future), resolved when the commit position covers them and
+        # FAILED when a new leader's replication truncates them — acked
+        # means COMMITTED (see append()). Guarded by _append_lock (the
+        # drain registers on the raft actor; close() may fail them from
+        # another thread).
+        self._pending_commits: List[tuple] = []
+        self._commit_stall_warned = False
+        # log positions THIS raft bound sampled spans to (as leader, in
+        # _stamp_traced_appends): truncation cleanup touches only these,
+        # because the tracer is process-global and an in-process peer's
+        # follower-side truncate must not finish the real leader's live
+        # spans. Raft-actor-only state (append/resolve/truncate all run
+        # there); pruned as commits cover it, so it stays sampled-sized.
+        self._traced_bound: set = set()
 
         self.server = ServerTransport(host=host, port=port, request_handler=self._on_request)
         self.client = ClientTransport(default_timeout_ms=1000)
@@ -179,9 +199,24 @@ class Raft(Actor):
         self._state_listeners.append(listener)
 
     def append(self, records: List) -> ActorFuture:
-        """Leader-only: append records to the replicated log. Completes with
-        the last position once durably appended locally (commit follows
-        quorum replication; observe log.commit_position).
+        """Leader-only: append records to the replicated log. Completes
+        with the last position once the records are COMMITTED (quorum-
+        replicated), and completes exceptionally when they are lost —
+        deposed before the drain ran, or truncated off this node's log by
+        a new leader's replication.
+
+        Acked-means-committed is the liveness contract the old
+        acked-on-local-durability version broke: an append landing on a
+        leader that was already deposed (but had not yet heard the new
+        term) returned success for records the new leader then truncated,
+        so a caller retrying only on FAILURE hung forever waiting for a
+        commit that could never come (the recorded
+        ``test_appends_replicate_and_commit`` flake — commit stuck at the
+        no-op). Now that window resolves the future exceptionally and the
+        caller's retry lands on the real leader. Retries are
+        at-least-once: a failed future's records MAY still commit if the
+        new leader already replicated them (standard raft "leadership
+        lost" ambiguity; the client-level cid dedup covers commands).
 
         GROUP COMMIT: calls that queue while the raft actor is busy drain
         as ONE ``log.append`` + ONE durability flush (fsync) + one
@@ -201,6 +236,13 @@ class Raft(Actor):
         with self._append_lock:
             batch, self._append_queue = self._append_queue, []
         if not batch:
+            return
+        if self._stopped:
+            # close() already swept _pending_commits; a drain landing
+            # after that sweep must not append or register new pending
+            # entries — nothing would ever resolve them
+            for _records, future in batch:
+                future.complete_exceptionally(RuntimeError("raft closed"))
             return
         if self.state != RaftState.LEADER:
             for _records, future in batch:
@@ -253,16 +295,143 @@ class Raft(Actor):
                 "append() calls that shared another call's fsync",
                 delta=len(batch) - 1,
             )
-        self.match_position[self.node_id] = last
-        self._maybe_commit()
-        self._replicate_all()
         # positions are dense over the merged group: each caller's last
         # position derives from its slice, with no row materialization
         first = last - len(merged) + 1 if len(merged) else last + 1
         end = 0
-        for (records, future), size in zip(batch, group_sizes):
-            end += size
-            future.complete(first + end - 1 if size else last)
+        now = self.scheduler.now_ms()
+        with self._append_lock:
+            # close() flips _stopped before sweeping under this lock, so
+            # re-checking here is race-free: registering after the sweep
+            # would leave the futures with no resolver
+            stopped = self._stopped
+            for (records, future), size in zip(batch, group_sizes):
+                end += size
+                if stopped:
+                    future.complete_exceptionally(RuntimeError("raft closed"))
+                elif size:
+                    self._pending_commits.append(
+                        (first + end - size, first + end - 1, now, future)
+                    )
+                else:  # nothing to commit-wait on
+                    future.complete(last)
+        self._stamp_traced_appends(batch)
+        self.match_position[self.node_id] = last
+        self._maybe_commit()
+        self._replicate_all()
+
+    def _stamp_traced_appends(self, batch) -> None:
+        """Record-lifecycle tracing: bind sampled client commands to the
+        log positions this group commit just assigned (stamps RAFT_FSYNC).
+        One global read when tracing is off; one dict-truthiness read when
+        no request spans are live."""
+        from zeebe_tpu import tracing
+
+        tracer = tracing.TRACER
+        if tracer is None or not tracer.tracking_requests():
+            return
+        pid = getattr(self.log, "partition_id", 0)
+        for records, _future in batch:
+            if not isinstance(records, list):
+                continue  # columnar emissions carry no client request ids
+            for record in records:
+                rid = getattr(record.metadata, "request_id", -1)
+                if rid is not None and rid >= 0:
+                    if tracer.bind_append(rid, pid, record.position):
+                        self._traced_bound.add(record.position)
+
+    def _resolve_pending_commits(self) -> None:
+        """Complete append futures whose spans the commit position now
+        covers (acked means committed). Runs on the raft actor — both the
+        leader's quorum commit and a deposed leader learning the new
+        leader's commit resolve here."""
+        commit = self.log.commit_position
+        if self._traced_bound:
+            # committed positions can never be truncated ("commit is
+            # final"): stop tracking them for truncation cleanup
+            self._traced_bound = {
+                p for p in self._traced_bound if p > commit
+            }
+        done: List[tuple] = []
+        with self._append_lock:
+            if not self._pending_commits:
+                return
+            keep = []
+            for entry in self._pending_commits:
+                (done if entry[1] <= commit else keep).append(entry)
+            self._pending_commits = keep
+            if done or not keep:
+                # progress ends a stall episode even when newer pendings
+                # remain (sustained load never drains to empty): a later
+                # wedge must warn and count again
+                self._commit_stall_warned = False
+        for _first, last_pos, _enq, future in done:
+            future.complete(last_pos)
+
+    def on_snapshot_fast_forward(self) -> None:
+        """Snapshot catch-up reset the log underneath raft (fast_forward
+        discards everything below the snapshot boundary and jumps the
+        commit position without going through set_commit_position): every
+        pending append future references superseded positions and would
+        otherwise hang forever. Fail them all — the records MAY have
+        committed cluster-wide (the snapshot covers them; standard
+        leadership-lost at-least-once ambiguity) — so callers retry on
+        the real leader, and finish their bound spans before the
+        positions are re-served."""
+        self._fail_pending_from(0, "snapshot fast-forward")
+
+    def _fail_pending_from(self, position: int, reason: str) -> None:
+        """A truncate removed everything from ``position`` on: append
+        futures whose span intersects the cut lost records — fail them so
+        callers retry on the real leader instead of waiting forever."""
+        from zeebe_tpu import tracing
+
+        tracer = tracing.TRACER
+        if tracer is not None and self._traced_bound:
+            # the cut records no longer exist and their positions will be
+            # reused by the new leader: finish the bound spans so a later
+            # commit over a reused position cannot mis-stamp a dead trace.
+            # BEFORE the empty-pendings return — a second truncate walking
+            # further back can arrive with no pendings left but live spans
+            # still bound in the newly-cut range. Restricted to positions
+            # THIS raft bound: the tracer is process-global, and a
+            # follower-side truncate must not finish the in-process
+            # leader's live spans
+            mine = {p for p in self._traced_bound if p >= position}
+            if mine:
+                tracer.truncate_positions_from(
+                    getattr(self.log, "partition_id", 0), position,
+                    only=mine,
+                )
+                self._traced_bound -= mine
+        failed: List[tuple] = []
+        with self._append_lock:
+            if not self._pending_commits:
+                return
+            keep = []
+            for entry in self._pending_commits:
+                (failed if entry[1] >= position else keep).append(entry)
+            self._pending_commits = keep
+            if failed or not keep:
+                # the stall episode (if any) ended with the cut pendings:
+                # re-arm the watchdog for the next one
+                self._commit_stall_warned = False
+        if failed:
+            _count_event(
+                "raft_appends_truncated",
+                "Acked-pending append futures failed because a new "
+                "leader's replication truncated their records",
+                delta=len(failed),
+            )
+            _flight(
+                "raft", "pending appends truncated", node=self.node_id,
+                term=self.persistent.term, position=position,
+                futures=len(failed), reason=reason,
+            )
+        for _first, _last, _enq, future in failed:
+            future.complete_exceptionally(
+                RuntimeError(f"not leader: {reason}")
+            )
 
     # membership ops retry/forward for this long before giving up — a
     # leadership flap mid-call must not surface "not leader" to callers
@@ -430,6 +599,11 @@ class Raft(Actor):
 
     def close(self) -> None:
         self._stopped = True
+        with self._append_lock:
+            pending, self._pending_commits = self._pending_commits, []
+            self._commit_stall_warned = False
+        for _first, _last, _enq, future in pending:
+            future.complete_exceptionally(RuntimeError("raft closed"))
         self.server.close()
         self.client.close()
 
@@ -502,6 +676,12 @@ class Raft(Actor):
         if self.state == state:
             return
         self.state = state
+        _flight(
+            "raft", f"state -> {state.value}", node=self.node_id,
+            term=self.persistent.term, partition=getattr(
+                self.log, "partition_id", 0
+            ),
+        )
         for listener in self._state_listeners:
             listener(state, self.persistent.term)
 
@@ -509,10 +689,58 @@ class Raft(Actor):
         if self._stopped or not self.persistent.members:
             return
         if self.state == RaftState.LEADER:
+            self._check_commit_stall()
             self._replicate_all()
             return
         if self.scheduler.now_ms() >= self._election_deadline_ms:
             self._start_poll()
+
+    def _check_commit_stall(self) -> None:
+        """Commit-latency watchdog: a leader sitting on appends that never
+        commit is exactly the silent failure mode the recorded replication
+        flake had — warn ONCE per stall episode with the flight-recorder
+        slice, count it, and leave forensics in the ring."""
+        with self._append_lock:
+            if not self._pending_commits:
+                return
+            oldest = self._pending_commits[0]
+            stalled = (
+                self.scheduler.now_ms() - oldest[2]
+                > self.config.commit_stall_ms
+            )
+            if not stalled:
+                return
+            warned = self._commit_stall_warned
+            self._commit_stall_warned = True
+            pending = len(self._pending_commits)
+        # count EVERY stalled tick (the log line stays once-per-episode):
+        # a permanently wedged partition keeps the counter growing, which
+        # is what the documented "sustained growth" alert watches
+        _count_event(
+            "raft_commit_stalls",
+            "Ticks a leader spent with appends held uncommitted past the "
+            "commit-latency watchdog threshold",
+        )
+        if warned:
+            return
+        _flight(
+            "raft", "commit stall", node=self.node_id,
+            term=self.persistent.term,
+            commit=self.log.commit_position,
+            oldest_pending=oldest[0], pending_futures=pending,
+            match={m: p for m, p in self.match_position.items()},
+        )
+        from zeebe_tpu.tracing.recorder import FLIGHT
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "raft %s: appends pending past %dms without commit "
+            "(commit=%d, oldest pending position %d, %d futures); "
+            "recent flight-recorder events:\n%s",
+            self.node_id, self.config.commit_stall_ms,
+            self.log.commit_position, oldest[0], pending,
+            FLIGHT.format_slice(last=25),
+        )
 
     # -- election: poll (pre-vote) then vote -------------------------------
     def _last_entry(self):
@@ -560,6 +788,10 @@ class Raft(Actor):
 
     def _start_election(self) -> None:
         _count_event("raft_elections_started")
+        _flight(
+            "raft", "election started", node=self.node_id,
+            term=self.persistent.term + 1,
+        )
         self._become(RaftState.CANDIDATE)
         self.persistent.term += 1
         self.persistent.voted_for = self.node_id
@@ -631,6 +863,10 @@ class Raft(Actor):
 
     def _step_down(self, term: int) -> None:
         if term > self.persistent.term:
+            _flight(
+                "raft", "term bump", node=self.node_id,
+                old_term=self.persistent.term, new_term=term,
+            )
             self.persistent.term = term
             self.persistent.voted_for = None
             self.persistent.save()
@@ -752,6 +988,7 @@ class Raft(Actor):
         if self.log.term_at(candidate) != self.persistent.term:
             return
         self.log.set_commit_position(candidate)
+        self._resolve_pending_commits()
         if (
             self._self_removal_position is not None
             and candidate >= self._self_removal_position
@@ -886,6 +1123,9 @@ class Raft(Actor):
                 # conflicting suffix: truncate it (uncommitted by definition)
                 self.log.truncate(prev_position)
                 self._rollback_config(prev_position)
+                self._fail_pending_from(
+                    prev_position, "suffix truncated by new leader"
+                )
                 return msgpack.pack(
                     {
                         "t": "append-rsp",
@@ -909,6 +1149,9 @@ class Raft(Actor):
                     continue  # duplicate delivery (or compacted-away)
                 self.log.truncate(record.position)
                 self._rollback_config(record.position)
+                self._fail_pending_from(
+                    record.position, "suffix truncated by new leader"
+                )
             if record.position != self.log.next_position:
                 return msgpack.pack(
                     {
@@ -927,6 +1170,10 @@ class Raft(Actor):
         commit = int(msg.get("commit", -1))
         if commit > self.log.commit_position:
             self.log.set_commit_position(min(commit, self.log.next_position - 1))
+            # a deposed leader's surviving pending appends resolve here:
+            # the new leader replicated them before the election, so they
+            # committed — acked-means-committed holds across the flap
+            self._resolve_pending_commits()
         return msgpack.pack(
             {
                 "t": "append-rsp",

@@ -35,6 +35,7 @@ from zeebe_tpu.protocol.metadata import RecordMetadata
 from zeebe_tpu.protocol.records import ExporterPositionRecord, Record
 from zeebe_tpu.runtime.actors import Actor
 from zeebe_tpu.runtime.metrics import GLOBAL_REGISTRY, count_event
+from zeebe_tpu import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -118,6 +119,10 @@ class ExporterHandle:
         self.exporter = exporter
         # last durably acked position (mirrors engine.exporter_positions)
         self.position = position
+        # like .position but advanced only when the ack's append COMMITS
+        # (_append_acks on_durable): tracing's EXPORT_ACK keys off this,
+        # never off the optimistic in-flight value
+        self.durable_position = position
         # next read position; >= position+1 (runs ahead over hidden/admin
         # records and, for MANUAL_ACK exporters, over delivered batches)
         self.cursor = position + 1
@@ -168,6 +173,24 @@ class ExporterDirector:
         # backwards scan only walks the trailing run of hidden ack records)
         self._lv_cache = -1
         self._lv_cache_commit = -1
+
+    def can_ack(self) -> bool:
+        """Whether ANY exporter can still advance an ack. A handle whose
+        open/configure raised is broken for the life of the director —
+        when every handle is, no ack will ever arrive, and tracing must
+        treat the response/apply as a span's final stage (an unfinishable
+        span keeps every per-record stamp path hot forever)."""
+        return any(h.broken is None for h in self.handles)
+
+    def dispatch_passed(self, position: int) -> bool:
+        """Every live exporter's read cursor is already beyond
+        ``position``: no future dispatch will stamp it, so a span that
+        missed its dispatch window (bound after the pump raced past) can
+        never be finished by an ack — ``ack_exported`` requires an
+        EXPORT_DISPATCH stamp. The caller closes such a span instead of
+        leaking it."""
+        live = [h for h in self.handles if h.broken is None]
+        return bool(live) and all(h.cursor > position for h in live)
 
     # -- lifecycle ----------------------------------------------------------
     def open(self, positions: Dict[str, int]) -> None:
@@ -255,7 +278,7 @@ class ExporterDirector:
     ) -> Record:
         return ack_record(exporter_id, position, intent)
 
-    def _append_acks(self, records: List[Record]) -> None:
+    def _append_acks(self, records: List[Record], on_durable=None) -> None:
         try:
             result = self.append_fn(records)
         except Exception as e:  # noqa: BLE001 - a deposed leader's append
@@ -268,13 +291,19 @@ class ExporterDirector:
         # deposed leader's lost ack vanishes silently. The handle keeps
         # its optimistic position either way: the director closes on
         # step-down and the NEXT leader resumes from the replicated
-        # (committed) state, so at-least-once is unaffected
+        # (committed) state, so at-least-once is unaffected.
+        # ``on_durable`` fires only once the ack actually committed
+        # (raft futures resolve at commit) — tracing's EXPORT_ACK must
+        # not stamp an ack a new leader is about to truncate
         on_complete = getattr(result, "on_complete", None)
         if on_complete is not None:
             on_complete(lambda f: (
                 self._ack_append_failed(f._exception)
-                if getattr(f, "_exception", None) is not None else None
+                if getattr(f, "_exception", None) is not None
+                else (on_durable() if on_durable is not None else None)
             ))
+        elif on_durable is not None:  # single-writer: append IS commit
+            on_durable()
 
     def _ack_append_failed(self, exc) -> None:
         count_event(
@@ -415,13 +444,23 @@ class ExporterDirector:
                         partition=str(self.partition_id),
                     )
                 handle.exported_counter.inc(len(visible))
+                tracer = tracing.TRACER
+                if tracer is not None and tracer.by_position:
+                    tracer.stamp_positions(
+                        self.partition_id, tracing.positions_of(visible),
+                        tracing.EXPORT_DISPATCH, exporter=handle.id,
+                    )
             handle.cursor = pos
             ack_to = self._ack_target(handle, visible)
             if ack_to > handle.position:
                 handle.position = ack_to
                 handle.last_advance_ms = now
                 handle.stall_warned = False
-                self._append_acks([self._ack_record(handle.id, ack_to)])
+                self._append_acks(
+                    [self._ack_record(handle.id, ack_to)],
+                    on_durable=lambda h=handle, a=ack_to:
+                        self._ack_durable(h, a),
+                )
                 progress = True
         # MANUAL_ACK exporters may confirm between pumps without new
         # committed records arriving
@@ -429,9 +468,42 @@ class ExporterDirector:
             handle.position = handle.manual_position
             handle.last_advance_ms = now
             handle.stall_warned = False
-            self._append_acks([self._ack_record(handle.id, handle.position)])
+            self._append_acks(
+                [self._ack_record(handle.id, handle.position)],
+                on_durable=lambda h=handle, a=handle.position:
+                    self._ack_durable(h, a),
+            )
             progress = True
         return progress
+
+    def _ack_durable(self, handle: ExporterHandle, position: int) -> None:
+        """An ack's append COMMITTED (raft future resolved, or the
+        single-writer append that is its own commit): only now may
+        tracing treat the position as acked — an optimistic in-flight
+        ack could still be truncated by a new leader."""
+        if position > handle.durable_position:
+            handle.durable_position = position
+        self._stamp_acked()
+
+    def _stamp_acked(self) -> None:
+        """Record-lifecycle tracing: EXPORT_ACK is the lifecycle's final
+        stage, so a span finishes only once EVERY exporter's DURABLE ack
+        covers its position — the min across handles. Finishing on the
+        fastest exporter's ack would unindex the span before slower
+        exporters dispatch it, and their egress would vanish from the
+        trace."""
+        tracer = tracing.TRACER
+        if tracer is None or not tracer.by_position:
+            return
+        # broken exporters never dispatch again, so their frozen cursor
+        # must not hold every span open forever; backoff handles recover
+        # and DO count
+        ack = min(
+            (h.durable_position for h in self.handles if h.broken is None),
+            default=-1,
+        )
+        if ack >= 0:
+            tracer.ack_exported(self.partition_id, ack)
 
     def _ack_target(self, handle: ExporterHandle, visible) -> int:
         if handle.exporter.MANUAL_ACK:
@@ -559,6 +631,7 @@ class ExporterDirectorActor(Actor):
         self.director = director
         self._scheduler = scheduler
         self._pump_scheduled = False
+        self.can_ack = director.can_ack  # tracing's final-stage probe
         self._closing = False
         self._commit_listener = lambda _pos: self.schedule_pump()
         scheduler.submit_actor(self)

@@ -27,6 +27,7 @@ from zeebe_tpu.protocol.enums import RecordType, ValueType
 from zeebe_tpu.protocol.intents import SubscriberIntent, SubscriptionIntent
 from zeebe_tpu.protocol.records import Record, stamp_source_positions
 from zeebe_tpu.runtime.clock import SystemClock
+from zeebe_tpu import tracing
 
 
 class Partition:
@@ -160,7 +161,13 @@ class _BrokerFeed:
         view = p.log.committed_view(p.next_read_position, limit)
         if not len(view):
             return []
-        p.next_read_position = view.positions()[-1] + 1
+        positions = view.positions()
+        p.next_read_position = positions[-1] + 1
+        tracer = tracing.TRACER
+        if tracer is not None and tracer.by_position:
+            tracer.stamp_positions(
+                self.partition_id, positions, tracing.FEED_TAKE
+            )
         return view
 
     def dispatch(self, records):
@@ -210,7 +217,17 @@ class Broker:
         self.data_dir = data_dir or tempfile.mkdtemp(prefix="zeebe-tpu-")
         self.repository = WorkflowRepository()
         self.partitions: List[Partition] = []
+        import random
+
+        # request ids stay sequential from 0: they are LOG-VISIBLE
+        # metadata, and the wave/mesh parity suites pin two Brokers'
+        # logs byte-identical. The process-global tracer, however,
+        # indexes live spans by request id — several in-process Brokers
+        # would collide in by_request and stamp or finish each other's
+        # spans — so tracer keys get a per-incarnation random namespace
+        # added on top (the log bytes never see it)
         self._next_request_id = 0
+        self._trace_request_ns = random.getrandbits(47) << 20
         self._responses: Dict[int, Record] = {}
         self._push_listeners: Dict[int, Callable[[Record], None]] = {}
         self._record_listeners: List[Callable[[int, Record], None]] = []
@@ -229,6 +246,18 @@ class Broker:
         # False restores the per-partition baseline the A/B compares to
         self.use_scheduler = True
         self._scheduler = None
+        # record-lifecycle tracing: reuse (or install) the process-wide
+        # span tracer — stamp sites read the tracing.TRACER global, and
+        # tests drive sampling via tracing.install()
+        tracing.ensure_tracer()
+        from zeebe_tpu.tracing.recorder import record_event
+
+        # a boot marker anchors every flight-recorder dump: restarts are
+        # the first thing a post-mortem looks for
+        record_event(
+            "broker", "in-process broker started",
+            partitions=num_partitions, data_dir=self.data_dir,
+        )
 
         factory = engine_factory or (
             lambda pid: PartitionEngine(
@@ -424,7 +453,45 @@ class Broker:
             md.request_id = request_id
             md.request_stream_id = 0
         record = Record(key=key, metadata=md, value=value)
-        self.partitions[partition_id].log.append([record])
+        tracer = tracing.TRACER
+        span = tracer.maybe_sample(partition_id) if tracer is not None else None
+        partition = self.partitions[partition_id]
+        if span is not None and request_id is not None:
+            # bind by request id BEFORE the append: a concurrent drain
+            # thread can apply the record the instant it lands, and the
+            # RESPONSE stamp looks the span up by request id
+            tracer.bind_request(
+                span, self._trace_request_ns + request_id, partition_id
+            )
+        partition.log.append([record])
+        if span is not None:
+            # single-writer broker: the append IS the commit (no raft
+            # queue/fsync hops); the span is position-keyed from here
+            tracer.bind_position(
+                span, partition_id, record.position, committed=True
+            )
+            if (
+                not span.finished
+                and partition.next_read_position > record.position
+            ):
+                # a drain on another thread applied the record between
+                # the append and the bind: the position-keyed stamps
+                # (APPLY, finish_positions) already missed this span.
+                # With no ack plane nothing later can finish it; with a
+                # working plane it survives ONLY if some exporter has
+                # not yet dispatched past the position (the coming
+                # dispatch stamps it and the ack then finishes it) —
+                # otherwise close it instead of leaking it in the live
+                # budget with every stamp path hot.
+                director = partition.exporter_director
+                if (
+                    director is None
+                    or not director.can_ack()
+                    or director.dispatch_passed(record.position)
+                ):
+                    tracer.finish_positions(
+                        partition_id, (record.position,)
+                    )
         return request_id
 
     def next_partition(self) -> int:
@@ -618,6 +685,14 @@ class Broker:
         # take(); the baseline path advances here
         if position + 1 > partition.next_read_position:
             partition.next_read_position = position + 1
+        tracer = tracing.TRACER
+        # "no ack will ever arrive" probe (scans exporter handles):
+        # computed lazily, at most once per record, only on traced paths
+        no_ack_plane = None
+        if tracer is not None and tracer.by_position:
+            tracer.stamp_positions(
+                partition.partition_id, (position,), tracing.APPLY
+            )
         for target_pid, send in result.sends:
             # reference: subscription transport → command on the target log.
             # Sends go BEFORE the local follow-up append: once the follow-ups
@@ -640,10 +715,29 @@ class Broker:
         for response in result.responses:
             if response.metadata.request_id >= 0:
                 self._responses[response.metadata.request_id] = response
+                if tracer is not None and tracer.tracking_requests():
+                    # without an exporter plane — or with one whose every
+                    # exporter broke at open — no ack will ever finish
+                    # the span: the response is its last stage
+                    if no_ack_plane is None:
+                        no_ack_plane = tracing.no_ack_plane(partition)
+                    tracer.stamp_request(
+                        self._trace_request_ns + response.metadata.request_id,
+                        tracing.RESPONSE, final=no_ack_plane,
+                    )
         for subscriber_key, push in result.pushes:
             listener = self._push_listeners.get(subscriber_key)
             if listener is not None:
                 listener(partition.partition_id, push)
+        if tracer is not None and tracer.by_position:
+            if no_ack_plane is None:
+                no_ack_plane = tracing.no_ack_plane(partition)
+            if no_ack_plane:
+                # no exporter plane (or one that can never ack again):
+                # this apply is the last stage a span at this position can
+                # reach (response-less internal commands never hit the
+                # stamp_request(final=True) path above)
+                tracer.finish_positions(partition.partition_id, (position,))
         for listener in self._record_listeners:
             listener(partition.partition_id, _entry_record(record))
 
@@ -718,6 +812,10 @@ class Broker:
         return list(self.partitions[partition_id].log.reader(0))
 
     def close(self) -> None:
+        from zeebe_tpu.tracing.recorder import record_event
+
+        record_event("broker", "in-process broker closed",
+                     data_dir=self.data_dir)
         for partition in self.partitions:
             if partition.exporter_director is not None:
                 partition.exporter_director.close()

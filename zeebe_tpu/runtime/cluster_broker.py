@@ -53,6 +53,8 @@ from zeebe_tpu.runtime.metrics import (
     count_event,
 )
 from zeebe_tpu.transport import ClientTransport, RemoteAddress, ServerTransport
+from zeebe_tpu import tracing
+from zeebe_tpu.tracing.recorder import FLIGHT, record_event
 
 logger = logging.getLogger(__name__)
 
@@ -133,6 +135,10 @@ class PartitionServer:
                 heartbeat_interval_ms=broker.cfg.raft.heartbeat_interval_ms,
                 election_timeout_ms=broker.cfg.raft.election_timeout_ms,
                 election_jitter_ms=broker.cfg.raft.election_timeout_ms,
+                # the [tracing] watchdog threshold drives the raft-side
+                # commit-latency watchdog too (it is sampling-independent
+                # but the same operator knob)
+                commit_stall_ms=broker.cfg.tracing.commit_stall_ms,
             ),
             host=broker.cfg.network.host,
             storage_path=os.path.join(pdir, "raft.meta"),
@@ -159,7 +165,14 @@ class PartitionServer:
         self._snapshot_inflight = False
         self._snapshot_thread: Optional[threading.Thread] = None
         self.raft.on_state_change(self._on_raft_state_change)
-        self.log.on_commit(lambda _pos: self._schedule_processing())
+        self.log.on_commit(self._on_commit)
+
+    def _on_commit(self, position: int) -> None:
+        tracer = tracing.TRACER
+        if tracer is not None:
+            # stamp COMMIT on sampled spans the advance covered
+            tracer.on_commit(self.partition_id, position)
+        self._schedule_processing()
 
     # -- leadership transitions (reference PartitionInstallService) --------
     def _on_raft_state_change(self, state: RaftState, term: int) -> None:
@@ -205,6 +218,12 @@ class PartitionServer:
                     "leader_install_deferred_uncommitted",
                     "Leader installs deferred until the raft commit "
                     "position covered the replay boundary",
+                )
+                record_event(
+                    "leadership", "install deferred (commit < boundary)",
+                    node=self.broker.node_id, partition=self.partition_id,
+                    term=term, commit=self.log.commit_position,
+                    boundary=last_source,
                 )
                 self.broker.actor_control.run_delayed(
                     10, lambda: self._install_leader(term, last_source)
@@ -258,6 +277,11 @@ class PartitionServer:
             self.engine.process(record)
             self.next_read_position = record.position + 1
         self.is_leader = True
+        record_event(
+            "leadership", "leader installed", node=self.broker.node_id,
+            partition=self.partition_id, term=term,
+            replayed_to=self.next_read_position - 1,
+        )
         if self.broker.wave_scheduler is not None:
             # this partition's committed tail now feeds the broker's
             # shared waves (the scheduler is the single place waves form)
@@ -277,7 +301,15 @@ class PartitionServer:
                     )
         self._schedule_processing()
 
-    def _uninstall_leader(self) -> None:
+    def _uninstall_leader(self, orphan_spans: bool = True) -> None:
+        """``orphan_spans=False`` is for same-node reinstalls (mesh
+        rebalance fallback): leadership never leaves this broker, so its
+        live spans will still be applied/responded/exported here."""
+        if self.is_leader:
+            record_event(
+                "leadership", "leader uninstalled",
+                node=self.broker.node_id, partition=self.partition_id,
+            )
         self.is_leader = False
         self.engine = None
         if self.broker.wave_scheduler is not None:
@@ -300,6 +332,18 @@ class PartitionServer:
         if self.exporter_director is not None:
             self.exporter_director.close()
             self.exporter_director = None
+        tracer = tracing.TRACER
+        if orphan_spans and tracer is not None and tracer.by_position:
+            # spans stranded by the step-down can never progress on this
+            # node (drain/apply/response/export are all leader-side):
+            # finish them or they pin every per-record stamp path hot
+            # until budget eviction. (Process-global-tracer caveat: in an
+            # in-process multi-broker harness this also closes the NEW
+            # leader's in-flight spans for the partition — position keys
+            # carry no broker identity; see docs/operations/tracing.md.)
+            tracer.finish_partition_spans(
+                self.partition_id, "leader uninstalled"
+            )
 
     def _install_exporters(self) -> None:
         """Leader-only exporter plane (reference: the exporter stream
@@ -457,6 +501,11 @@ class PartitionServer:
             return []
         positions = view.positions()
         self.next_read_position = positions[cut - 1] + 1
+        tracer = tracing.TRACER
+        if tracer is not None and tracer.by_position:
+            tracer.stamp_positions(
+                self.partition_id, positions[:cut], tracing.FEED_TAKE
+            )
         if cut == n:
             return view
         return view.select(list(range(cut)))
@@ -479,6 +528,12 @@ class PartitionServer:
         from zeebe_tpu.engine.interpreter import ProcessingResult
 
         merged = ProcessingResult.merged(self.engine.collect_wave(pending))
+        tracer = tracing.TRACER
+        if tracer is not None and tracer.by_position:
+            tracer.stamp_positions(
+                self.partition_id, tracing.positions_of(pending.records),
+                tracing.DEVICE_COLLECT, device=self.device_index,
+            )
         self._apply_chunk(pending.records, merged)
         return pending.host_seconds, pending.device_seconds
 
@@ -638,6 +693,12 @@ class PartitionServer:
         """
         from zeebe_tpu.runtime.metrics import observe_wave
 
+        tracer = tracing.TRACER
+        if tracer is not None and tracer.by_position:
+            tracer.stamp_positions(
+                self.partition_id, tracing.positions_of(records),
+                tracing.WAVE_DISPATCH, device=self.device_index,
+            )
         dispatch = getattr(self.engine, "dispatch_wave", None)
         if dispatch is None:
             import time as _time
@@ -663,6 +724,12 @@ class PartitionServer:
         from zeebe_tpu.runtime.metrics import observe_wave
 
         merged = ProcessingResult.merged(self.engine.collect_wave(wave))
+        tracer = tracing.TRACER
+        if tracer is not None and tracer.by_position:
+            tracer.stamp_positions(
+                self.partition_id, tracing.positions_of(wave.records),
+                tracing.DEVICE_COLLECT, device=self.device_index,
+            )
         self._apply_chunk(wave.records, merged)
         observe_wave(
             len(wave.records), self._DRAIN_BATCH,
@@ -670,6 +737,12 @@ class PartitionServer:
         )
 
     def _apply_chunk(self, records: list, result) -> None:
+        tracer = tracing.TRACER
+        if tracer is not None and tracer.by_position:
+            tracer.stamp_positions(
+                self.partition_id, tracing.positions_of(records),
+                tracing.APPLY,
+            )
         if result.written:
             # every follow-up was source-stamped per record by the engine;
             # positions are assigned on the raft actor at append time, and
@@ -681,7 +754,7 @@ class PartitionServer:
 
             self.raft.append(as_log_batch(result.written))
         for response in result.responses:
-            self.broker.send_client_response(response)
+            self.broker.send_client_response(response, server=self)
         for target_pid, send in result.sends:
             self.broker.route_send(self.partition_id, target_pid, send)
         for subscriber_key, push in result.pushes:
@@ -787,6 +860,11 @@ class PartitionServer:
             last_processed_position=self.next_read_position - 1,
             last_written_position=self.log.next_position - 1,
             term=self.raft.term,
+        )
+        record_event(
+            "snapshot", "take started", node=self.broker.node_id,
+            partition=self.partition_id,
+            processed=meta.last_processed_position,
         )
         try:
             pending = self.snapshots.capture(self.engine, meta)
@@ -1010,6 +1088,9 @@ class ClusterBroker(Actor):
                 wave_size=sc.wave_size,
                 quantum=sc.quantum or None,
                 backpressure_limit=sc.backpressure_limit or None,
+                # like the raft commit watchdog, the slow-wave threshold
+                # is an operator knob independent of [tracing] enabled
+                slow_wave_ms=cfg.tracing.slow_wave_ms,
             )
             if sc.enabled
             else None
@@ -1094,6 +1175,16 @@ class ClusterBroker(Actor):
 
         # periodic snapshotting (reference snapshotPeriod)
         self._snapshot_period_ms = cfg.data.snapshot_period_ms
+
+        # record-lifecycle tracing: one span tracer per process (like the
+        # global metrics registry); [tracing] enabled=false uninstalls it
+        # and every stamp site degrades to a single read of tracing.TRACER
+        tracing.ensure_tracer(cfg.tracing)
+        # boot marker: restarts anchor every flight-recorder dump
+        record_event(
+            "broker", "broker started", node=self.node_id,
+            partitions=cfg.cluster.partitions, engine=cfg.engine.type,
+        )
 
     # -- lifecycle ---------------------------------------------------------
     def on_actor_started(self) -> None:
@@ -1237,6 +1328,10 @@ class ClusterBroker(Actor):
         except Exception as e:  # noqa: BLE001 - the transport hop is the
             # always-correct fallback; never wedge serving on the exchange
             self._mesh_exchange_failed = True
+            record_event(
+                "mesh", "exchange unavailable (transport fallback)",
+                node=self.node_id, error=repr(e),
+            )
             logger.error(
                 "mesh frame exchange unavailable (falling back to the "
                 "host transport hop): %r", e,
@@ -1287,9 +1382,15 @@ class ClusterBroker(Actor):
                         device_index, pid,
                     )
                     term = server.raft.term
-                    server._uninstall_leader()
+                    # same-node reinstall: leadership stays here, so the
+                    # partition's live spans are NOT orphaned
+                    server._uninstall_leader(orphan_spans=False)
                     server._install_leader(term)
             if moves:
+                record_event(
+                    "mesh", "device excluded", node=self.node_id,
+                    device=device_index, moves=dict(moves),
+                )
                 logger.warning(
                     "mesh device %d excluded; partitions rebalanced: %s",
                     device_index, moves,
@@ -1410,6 +1511,7 @@ class ClusterBroker(Actor):
 
     def close(self) -> None:
         self._closing = True
+        record_event("broker", "broker closed", node=self.node_id)
         self.scheduler.remove_actor_failure_listener(self._on_actor_failure)
         if self.metrics_http is not None:
             self.metrics_http.close()
@@ -1503,6 +1605,12 @@ class ClusterBroker(Actor):
             return None
         t = msg.get("t")
         if t == "command":
+            # record-lifecycle tracing samples HERE — the earliest hop a
+            # command is visible at (one global read when tracing is off)
+            tracer = tracing.TRACER
+            span = None
+            if tracer is not None:
+                span = tracer.maybe_sample(int(msg.get("partition", 0)))
             # admission runs HERE, on the transport thread, before the
             # command can queue behind the broker actor: overload is
             # answered with a retryable rejection in O(1), never with
@@ -1511,12 +1619,22 @@ class ClusterBroker(Actor):
             if conn_key is not None:
                 reason = self.admission.try_admit(conn_key)
                 if reason is not None:
+                    if span is not None:
+                        # shed: the lifecycle ends here — finish the span
+                        # so it never sits in the live budget
+                        tracer.finish(
+                            span, tracing.ADMISSION, verdict=reason
+                        )
                     return msgpack.pack(self.admission.rejection_body(reason))
                 if conn_key not in self._admission_conns:
                     self._admission_conns.add(conn_key)
                     conn.on_close(
                         lambda k=conn_key: self._forget_admission(k)
                     )
+            if span is not None:
+                tracer.stamp(span, tracing.ADMISSION, verdict="admitted")
+                tracer.stamp(span, tracing.ACTOR_ENQUEUE)
+                msg["_trace"] = span
             result = ActorFuture()
             if conn_key is not None:
                 # the in-flight slot frees when the response (or error)
@@ -1761,11 +1879,17 @@ class ClusterBroker(Actor):
                 and meta.last_processed_position >= server.log.next_position
             ):
                 lp_term = int(newest.get("lp_term", -1))
-                server.raft.actor.run(
-                    lambda: server.log.fast_forward(
+
+                def _fast_forward():
+                    server.log.fast_forward(
                         meta.last_processed_position + 1, term=lp_term
                     )
-                )
+                    # the reset bypassed set_commit_position, so pending
+                    # acked-means-committed futures (a deposed leader's)
+                    # would never resolve — fail them so callers retry
+                    server.raft.on_snapshot_fast_forward()
+
+                server.raft.actor.run(_fast_forward)
         except Exception as e:  # noqa: BLE001 - next poll retries
             logger.debug(
                 "snapshot replication fetch from %s for partition %d "
@@ -2434,9 +2558,19 @@ class ClusterBroker(Actor):
 
     def _handle_command(self, msg: dict, result: ActorFuture) -> None:
         partition_id = int(msg.get("partition", 0))
+        span = msg.pop("_trace", None)
+
+        def finish_span(reason: str) -> None:
+            # early lifecycle end (not leader / duplicate / malformed):
+            # release the span from the live budget with the reason
+            tracer = tracing.TRACER
+            if span is not None and tracer is not None:
+                tracer.finish(span, tracing.RESPONSE, verdict=reason)
+
         server = self.partitions.get(partition_id)
         if server is None or not server.is_leader:
             leader = self.topology.leader_node(partition_id)
+            finish_span("NOT_LEADER")
             result.complete(
                 msgpack.pack(
                     {"t": "error", "code": "NOT_LEADER", "leader": leader or ""}
@@ -2454,11 +2588,13 @@ class ClusterBroker(Actor):
             with self._request_lock:
                 existing = self._cmd_dedup.get(cid)
             if existing is not None:
+                finish_span("DUPLICATE")
                 existing.on_complete(self._command_responder(result))
                 return
         try:
             record, _ = codec.decode_record(bytes(msg.get("frame", b"")))
         except ValueError:
+            finish_span("MALFORMED")
             result.complete(msgpack.pack({"t": "error", "code": "MALFORMED"}))
             return
         with self._request_lock:
@@ -2467,6 +2603,13 @@ class ClusterBroker(Actor):
         record.metadata.request_id = request_id
         record.position = -1  # assigned on append
         record.timestamp = -1
+        if span is not None:
+            tracer = tracing.TRACER
+            if tracer is not None:
+                # from here the span is findable by request id (raft's
+                # group commit binds the log position at fsync time)
+                tracer.bind_request(span, request_id, partition_id)
+                tracer.stamp(span, tracing.RAFT_QUEUE)
 
         response_future = ActorFuture()
         self._pending_responses[request_id] = response_future
@@ -2486,6 +2629,17 @@ class ClusterBroker(Actor):
                 if cid:
                     with self._request_lock:
                         self._cmd_dedup.pop(cid, None)
+                tracer = tracing.TRACER
+                if tracer is not None and tracer.tracking_requests():
+                    # the append failed before a position was bound: this
+                    # is the span's terminal stage — nothing downstream
+                    # can ever reach it (the client's retry arrives as a
+                    # fresh sampled command), and an unfinishable span
+                    # would pin every per-record stamp path hot
+                    tracer.stamp_request(
+                        request_id, "append_failed", final=True,
+                        error=str(f._exception),
+                    )
                 # complete the SHARED future, not just this request's
                 # result: retries deduped onto it must also learn
                 # NOT_LEADER instead of hanging until their timeout
@@ -2495,12 +2649,22 @@ class ClusterBroker(Actor):
 
         append.on_complete(on_append)
 
-    def send_client_response(self, response: Record) -> None:
+    def send_client_response(self, response: Record, server) -> None:
         request_id = response.metadata.request_id
         if request_id < 0:
             return
         future = self._pending_responses.pop(request_id, None)
         if future is not None:
+            tracer = tracing.TRACER
+            if tracer is not None and tracer.tracking_requests():
+                # the shared no-ack-plane rule (tracing.no_ack_plane):
+                # no exporter plane on the responding partition, or every
+                # exporter broke at open = no ack will ever finish the
+                # span, so the response is its last stage
+                tracer.stamp_request(
+                    request_id, tracing.RESPONSE,
+                    final=tracing.no_ack_plane(server),
+                )
             future.complete(response)
 
     # -- job subscriptions over the client API ------------------------------
@@ -2618,8 +2782,26 @@ class ClusterBroker(Actor):
                 # instead of silently dropping the command
                 local = self.partitions.get(target_partition)
                 if local is not None and local.is_leader:
+                    future = local.raft.append([record])
                     try:
-                        local.raft.append([record]).join(3)
+                        future.join(3)
+                        return
+                    except TimeoutError:
+                        # acked-means-committed: a slow quorum can hold
+                        # the future past the join window while the
+                        # record already sits in the leader's log —
+                        # re-appending here would duplicate the command
+                        # every 3s. Hand liveness to the future instead:
+                        # a later failure (truncate/step-down) restarts
+                        # the retry from its callback.
+                        future.on_complete(lambda f: (
+                            self._retry_subscription_send(
+                                target_partition, record
+                            )
+                            if getattr(f, "_exception", None) is not None
+                            and not self._closing
+                            else None
+                        ))
                         return
                     except Exception:  # noqa: BLE001 - deposed mid-append
                         pass
@@ -2766,8 +2948,53 @@ class ClusterBroker(Actor):
         (see its docstring for the async-probe rationale); in shared-wave
         mode the scheduler drives it through the registered feeds, so the
         sweep commands enter the same shared waves as client traffic."""
+        self._check_span_commit_stalls()
         if self.wave_scheduler is not None:
             self.wave_scheduler.tick()
             return
         for server in self.partitions.values():
             server.tick()
+
+    def _check_span_commit_stalls(self) -> None:
+        """Commit-latency watchdog over the SAMPLED spans (the raft actor
+        has its own, sampling-independent one): a traced command appended
+        but uncommitted past the threshold is logged once with the
+        relevant flight-recorder slice and counted process-globally."""
+        tracer = tracing.TRACER
+        if tracer is None or not tracer.by_position:
+            return
+        # claim only partitions this broker LEADS: the tracer is process-
+        # global, and an in-process peer's tick must not report (and
+        # mislabel) another leader's stall
+        led = {
+            pid for pid, server in self.partitions.items()
+            if server.is_leader
+        }
+        if not led:
+            return
+        stalled = tracer.check_commit_stalls(led)
+        if not stalled:
+            return
+        for span in stalled:
+            count_event(
+                "serving_commit_stalls",
+                "Sampled commands appended but uncommitted past the "
+                "commit-latency watchdog threshold",
+            )
+            record_event(
+                "stall", "sampled command commit stall",
+                node=self.node_id, partition=span.partition,
+                position=span.position, request_id=span.request_id,
+            )
+        # one log line (and ONE flight slice) per sweep — a wedged
+        # partition can cross the threshold with a whole budget of spans
+        # at once, and 256 copies of the same 25-line slice would bury
+        # the forensics it exists to surface
+        first = stalled[0]
+        logger.warning(
+            "broker %s: %d sampled command(s) (first: partition %d "
+            "position %d) appended but uncommitted for >%dms; recent "
+            "flight-recorder events:\n%s",
+            self.node_id, len(stalled), first.partition, first.position,
+            tracer.commit_stall_ms, FLIGHT.format_slice(last=25),
+        )

@@ -160,6 +160,26 @@ class AdmissionCfg:
 
 
 @dataclasses.dataclass
+class TracingCfg:
+    """Record-lifecycle tracing (``zeebe_tpu/tracing/``): sampled
+    commands are stamped at every serving-plane hop (gateway receive →
+    … → exporter ack) and per-wave timelines are kept for Perfetto
+    export (``tools/trace_report.py``). Sampling is deterministic per
+    (seed, partition, arrival index) so chaos replays trace the same
+    commands. ``enabled = false`` removes the span tracer entirely —
+    the hot paths fall back to a single global read. The flight
+    recorder (bounded event ring, dump-on-invariant-failure) is always
+    on regardless of this section."""
+
+    enabled: bool = True
+    sample_rate: float = 0.01  # sampled fraction of commands per partition
+    seed: int = 0
+    per_partition_budget: int = 256  # live spans per partition (cap)
+    commit_stall_ms: int = 5_000  # commit-latency watchdog threshold
+    slow_wave_ms: int = 5_000  # slow-wave watchdog threshold
+
+
+@dataclasses.dataclass
 class GossipCfg:
     probe_interval_ms: int = 250
     probe_timeout_ms: int = 500
@@ -207,6 +227,7 @@ class BrokerCfg:
     scheduler: SchedulerCfg = dataclasses.field(default_factory=SchedulerCfg)
     mesh: MeshCfg = dataclasses.field(default_factory=MeshCfg)
     admission: AdmissionCfg = dataclasses.field(default_factory=AdmissionCfg)
+    tracing: TracingCfg = dataclasses.field(default_factory=TracingCfg)
     topics: List[TopicCfg] = dataclasses.field(default_factory=list)
     exporters: List[ExporterCfg] = dataclasses.field(default_factory=list)
 
@@ -223,6 +244,7 @@ _SECTION_KEYS = {
     "scheduler": SchedulerCfg,
     "mesh": MeshCfg,
     "admission": AdmissionCfg,
+    "tracing": TracingCfg,
 }
 
 # env overrides (reference Environment: ZEEBE_* wins over the file)
@@ -268,6 +290,12 @@ _ENV_OVERRIDES = {
         lambda v: v.strip().lower() in ("1", "true", "yes"),
     ),
     "ZEEBE_MESH_DEVICES": ("mesh", "devices", int),
+    "ZEEBE_TRACING_ENABLED": (
+        "tracing",
+        "enabled",
+        lambda v: v.strip().lower() in ("1", "true", "yes"),
+    ),
+    "ZEEBE_TRACING_SAMPLE_RATE": ("tracing", "sample_rate", float),
 }
 
 

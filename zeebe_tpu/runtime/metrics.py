@@ -217,7 +217,10 @@ class MetricsRegistry:
 # snapshot_recover_skipped; columnar record plane (docs/SERVING.md):
 # serving_rows_materialized_total — Record objects lazily materialized from
 # columnar batch views (protocol/columnar.py); 0 on the pure host wave
-# path, where every row is an engine-built Record already.
+# path, where every row is an engine-built Record already; tracing plane
+# (docs/operations/tracing.md): raft_commit_stalls,
+# raft_appends_truncated, serving_commit_stalls, serving_slow_waves,
+# flight_recorder_dumps.
 GLOBAL_REGISTRY = MetricsRegistry()
 
 

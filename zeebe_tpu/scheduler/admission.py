@@ -32,6 +32,7 @@ import threading
 from typing import Callable, Dict, Optional
 
 from zeebe_tpu.runtime.metrics import GLOBAL_REGISTRY
+from zeebe_tpu.tracing.recorder import RateLimitedEvent
 
 # rejection reasons (the wire carries them for observability; the client
 # treats any RESOURCE_EXHAUSTED identically — back off and retry)
@@ -95,6 +96,11 @@ class AdmissionController:
             "Broker backlog observed by the last admission check "
             "(committed records awaiting the drain + pending responses)",
         )
+        # sheds burst at per-command rate under exactly the overload a
+        # flight dump wants to explain — rate-limit the ring entries so
+        # they cannot evict the control-plane history (counters above
+        # stay exact)
+        self._shed_event = RateLimitedEvent("admission", "command shed")
 
     def set_queue_depth_probe(self, probe: Callable[[], int]) -> None:
         self._queue_depth_probe = probe
@@ -115,11 +121,18 @@ class AdmissionController:
             self._depth_gauge.set(depth)
             if depth >= cfg.queue_depth_high:
                 self._shed_queue.inc()
+                self._shed_event.record(
+                    reason=REASON_QUEUE_DEPTH, depth=depth,
+                )
                 return REASON_QUEUE_DEPTH
         with self._lock:
             inflight = self._inflight.get(conn_key, 0)
             if inflight >= cfg.max_inflight_per_connection:
                 self._shed_conn.inc()
+                self._shed_event.record(
+                    reason=REASON_CONNECTION_INFLIGHT, conn=conn_key,
+                    inflight=inflight,
+                )
                 return REASON_CONNECTION_INFLIGHT
             self._inflight[conn_key] = inflight + 1
         self._inflight_gauge.inc()

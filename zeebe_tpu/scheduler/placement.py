@@ -218,6 +218,15 @@ class MeshExchange:
         # queued[src][dst] = list of (dst_pid, frame)
         self._queued: Dict[int, Dict[int, List]] = {}
         self._count = 0
+        # fallbacks can burst at per-frame rate under sustained slot
+        # overflow — rate-limit the flight-ring entries so an overloaded
+        # mesh cannot evict the control-plane history (the
+        # mesh_exchange_fallbacks counter stays exact)
+        from zeebe_tpu.tracing.recorder import RateLimitedEvent
+
+        self._fallback_event = RateLimitedEvent(
+            "mesh", "frames fell back to transport"
+        )
 
     def pending(self) -> int:
         return self._count
@@ -234,6 +243,10 @@ class MeshExchange:
                 "Cross-partition frames routed over the host transport "
                 "because they did not fit the mesh exchange slots",
             )
+            self._fallback_event.record(
+                why="oversize", src=src_device, dst=dst_device,
+                bytes=len(frame),
+            )
             return False
         per_dst = self._queued.setdefault(src_device, {})
         block = per_dst.setdefault(dst_device, [])
@@ -242,6 +255,10 @@ class MeshExchange:
                 "mesh_exchange_fallbacks",
                 "Cross-partition frames routed over the host transport "
                 "because they did not fit the mesh exchange slots",
+            )
+            self._fallback_event.record(
+                why="pair slots full", src=src_device, dst=dst_device,
+                slots=self.slots,
             )
             return False
         block.append((dst_partition, frame))

@@ -39,12 +39,14 @@ from __future__ import annotations
 import logging
 from typing import Dict, List, Optional
 
+from zeebe_tpu import tracing
 from zeebe_tpu.runtime.metrics import (
     count_event,
     observe_device_wave,
     observe_mesh_wave,
     observe_shared_wave,
 )
+from zeebe_tpu.tracing.recorder import FLIGHT, record_event
 
 logger = logging.getLogger(__name__)
 
@@ -117,20 +119,21 @@ def _first_position(records) -> int:
 class WaveSegment:
     """One partition's contiguous slice of a shared wave."""
 
-    __slots__ = ("feed", "records", "pending", "count")
+    __slots__ = ("feed", "records", "pending", "count", "trace")
 
     def __init__(self, feed: PartitionFeed, records):
         self.feed = feed
         self.records = records
         self.count = len(records)
         self.pending = None  # dispatched-but-uncollected engine wave
+        self.trace = None  # wave-timeline segment entry (tracing on)
 
 
 class SharedWave:
     """A wave packed from several partitions' committed tails."""
 
     __slots__ = ("segments", "total", "host_seconds", "device_seconds",
-                 "dispatched")
+                 "dispatched", "trace")
 
     def __init__(self):
         self.segments: List[WaveSegment] = []
@@ -138,6 +141,7 @@ class SharedWave:
         self.host_seconds = 0.0
         self.device_seconds = 0.0
         self.dispatched = False
+        self.trace = None  # wave-timeline event (tracing on)
 
 
 class _FeedState:
@@ -157,7 +161,12 @@ class WaveScheduler:
         wave_size: int = 512,
         quantum: Optional[int] = None,
         backpressure_limit: Optional[int] = None,
+        slow_wave_ms: Optional[int] = None,
     ):
+        # slow-wave watchdog threshold: the [tracing] slowWaveMs knob,
+        # honored even with spans disabled (the watchdog is sampling-
+        # independent); None falls back to the tracer's value, then 5s
+        self.slow_wave_ms = slow_wave_ms
         self.wave_size = max(1, wave_size)
         # DRR quantum: fairness granularity. Small enough that several
         # active partitions share one wave, large enough that a lone
@@ -174,6 +183,14 @@ class WaveScheduler:
         self._feeds: Dict[int, _FeedState] = {}
         self._order: List[int] = []  # sorted pids (deterministic packing)
         self._rr = 0  # rotating start index into _order
+        # slow-wave watchdog: warn once per stall episode (every slow
+        # wave still counts + flight-records; a fast wave re-arms)
+        self._slow_wave_warned = False
+        from zeebe_tpu.tracing.recorder import RateLimitedEvent
+
+        self._backpressure_event = RateLimitedEvent(
+            "scheduler", "backpressure skip"
+        )
 
     # -- registration ------------------------------------------------------
     def register(self, feed: PartitionFeed) -> None:
@@ -239,6 +256,13 @@ class WaveScheduler:
                             "Feed visits skipped because the partition hit "
                             "its in-flight backpressure limit",
                         )
+                        # skips repeat every DRR round while a partition
+                        # is wedged — rate-limited like admission sheds,
+                        # or the burst would wrap the flight ring
+                        self._backpressure_event.record(
+                            partition=pid, inflight=state.inflight,
+                            backlog=state.feed.backlog(),
+                        )
                     state.deficit = min(state.deficit, self.quantum)
                     continue
                 records = state.feed.take(budget)
@@ -269,8 +293,26 @@ class WaveScheduler:
     # -- dispatch / collect ------------------------------------------------
     def _dispatch(self, wave: SharedWave) -> None:
         wave.dispatched = True
+        tracer = tracing.TRACER
+        if tracer is not None:
+            waves = tracer.waves
+            wave_id = next(waves.seq)
+            if wave_id % waves.stride == 0:
+                wave.trace = waves.begin(wave_id, self.wave_size)
         for i, seg in enumerate(wave.segments):
             state = self._feeds.get(seg.feed.partition_id)
+            pid = seg.feed.partition_id
+            device = getattr(seg.feed, "device_index", -1)
+            if tracer is not None:
+                if wave.trace is not None:  # this wave's timeline sampled
+                    seg.trace = tracer.waves.segment(
+                        wave.trace, pid, device, seg.count
+                    )
+                if tracer.by_position:
+                    tracer.stamp_positions(
+                        pid, tracing.positions_of(seg.records),
+                        tracing.WAVE_DISPATCH, device=device,
+                    )
             try:
                 pending, host_s, device_s = seg.feed.dispatch(seg.records)
             except Exception:
@@ -281,6 +323,10 @@ class WaveScheduler:
                 count_event(
                     "scheduler_dispatch_rewinds",
                     "Wave segments rewound because their dispatch raised",
+                )
+                record_event(
+                    "scheduler", "dispatch raised; segments rewound",
+                    partition=pid, segment_records=seg.count,
                 )
                 for later in wave.segments[i:]:
                     if later.pending is None and later.count:
@@ -305,6 +351,10 @@ class WaveScheduler:
                     getattr(seg.feed, "device_index", -1), seg.count,
                     wave.total, host_s, device_s,
                 )
+                if seg.trace is not None:
+                    tracer.waves.segment_collected(
+                        seg.trace, host_s, device_s
+                    )
             if pending is not None and state is not None:
                 state.inflight += seg.count
 
@@ -312,6 +362,7 @@ class WaveScheduler:
         """Materialize a dispatched shared wave's segments (apply appends/
         responses/sends/pushes per partition) and observe its metrics."""
         error = None
+        tracer = tracing.TRACER
         for seg in wave.segments:
             if seg.pending is None:
                 continue
@@ -325,6 +376,13 @@ class WaveScheduler:
                     getattr(seg.feed, "device_index", -1), seg.count,
                     wave.total, host_s, device_s,
                 )
+                if tracer is not None and seg.trace is not None:
+                    # DEVICE_COLLECT is stamped inside feed.collect()
+                    # between device collect and apply, so stage order
+                    # matches the baseline drain
+                    tracer.waves.segment_collected(
+                        seg.trace, host_s, device_s
+                    )
             except Exception as e:  # noqa: BLE001 - one partition's
                 # collect failure must not strand the other segments'
                 # responses; re-raised after the loop
@@ -332,6 +390,9 @@ class WaveScheduler:
             finally:
                 if state is not None:
                     state.inflight = max(0, state.inflight - seg.count)
+        if tracer is not None and wave.trace is not None:
+            tracer.waves.end(wave.trace)
+        self._check_slow_wave(wave)
         observe_shared_wave(
             wave.total, self.wave_size, len(wave.segments),
             wave.host_seconds, wave.device_seconds,
@@ -346,6 +407,44 @@ class WaveScheduler:
             observe_mesh_wave(len(devices))
         if error is not None:
             raise error
+
+    def _check_slow_wave(self, wave: SharedWave) -> None:
+        """Slow-wave watchdog: a wave whose host+device time exceeds the
+        threshold is counted + flight-recorded, and the FIRST one of an
+        episode logs the recorder slice (the next fast wave re-arms the
+        warning). The threshold is the scheduler's own slowWaveMs when
+        configured (honored even with [tracing] enabled=false), else the
+        tracer's; with neither the watchdog defaults to 5s."""
+        threshold_ms = self.slow_wave_ms
+        if threshold_ms is None:
+            tracer = tracing.TRACER
+            threshold_ms = tracer.slow_wave_ms if tracer is not None else 5000
+        threshold_s = threshold_ms / 1000.0
+        duration = wave.host_seconds + wave.device_seconds
+        if duration <= threshold_s:
+            self._slow_wave_warned = False
+            return
+        count_event(
+            "serving_slow_waves",
+            "Waves whose host+device time exceeded the slow-wave "
+            "watchdog threshold",
+        )
+        record_event(
+            "stall", "slow wave", records=wave.total,
+            segments=len(wave.segments),
+            host_s=round(wave.host_seconds, 4),
+            device_s=round(wave.device_seconds, 4),
+        )
+        if not self._slow_wave_warned:
+            self._slow_wave_warned = True
+            logger.warning(
+                "slow wave: %d records across %d segments took %.2fs "
+                "(host %.2fs / device %.2fs, threshold %.1fs); recent "
+                "flight-recorder events:\n%s",
+                wave.total, len(wave.segments), duration,
+                wave.host_seconds, wave.device_seconds, threshold_s,
+                FLIGHT.format_slice(last=25),
+            )
 
     def drain(self, max_records: Optional[int] = None) -> int:
         """Pack + dispatch shared waves until every feed runs dry, double-

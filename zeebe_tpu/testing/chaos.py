@@ -48,8 +48,34 @@ import zlib
 from typing import Callable, Dict, List, Optional, Tuple
 
 from zeebe_tpu.transport import RemoteAddress
+from zeebe_tpu.tracing.recorder import (
+    FLIGHT,
+    dump_flight_recorder,
+    record_event,
+)
 
 WILDCARD = "*"
+
+
+def forensics_dump(reason: str) -> str:
+    """Dump the process flight recorder for a chaos failure; returns the
+    dump path. Every invariant-failure path goes through here so the
+    next flake comes with the broker-side event history attached."""
+    return dump_flight_recorder(reason=reason)
+
+
+def invariant(condition, message: str) -> None:
+    """Chaos-invariant assert: on failure, dump the flight recorder to
+    disk and attach the dump path (plus the recent event slice) to the
+    raised AssertionError — a failing chaos run must carry its own
+    forensics, not require a re-run under instrumentation."""
+    if condition:
+        return
+    path = forensics_dump("invariant-failure")
+    raise AssertionError(
+        f"{message}\n[flight recorder dump: {path}]\n"
+        f"recent events:\n{FLIGHT.format_slice(last=30)}"
+    )
 
 
 class FaultPlane:
@@ -517,12 +543,14 @@ class ChaosHarness:
         the data dir stays for a later restart. (File buffers are flushed
         on close — use :class:`DiskFaults` on the data dir afterwards to
         simulate torn writes.)"""
+        record_event("chaos", "crash-stop broker", node=node)
         self.crashed.add(node)
         self.brokers[node].close()
 
     def restart(self, node: str) -> None:
         """Bring a crashed broker back (fresh ephemeral ports) and re-
         install raft membership cluster-wide with the new addresses."""
+        record_event("chaos", "restart broker", node=node)
         broker = self._make_broker(node)
         self.brokers[node] = broker
         self.crashed.discard(node)
