@@ -10,8 +10,10 @@
 set -e
 cd "$(dirname "$0")"
 
-echo "== nameslint (undefined-global gate; catches the round-4 bug class) =="
-python tools/nameslint.py
+echo "== zblint (project lint suite: undefined names, discarded actor"
+echo "   futures, blocking calls on actors, metrics hot loops + doc drift,"
+echo "   dirty-family coverage, swallowed excepts; docs/operations/lint.md) =="
+python -m tools.zblint
 
 echo "== compileall (syntax gate) =="
 python -m compileall -q zeebe_tpu tests benchmarks tools bench.py __graft_entry__.py

@@ -1,0 +1,552 @@
+"""zblint suite tests: every rule proves it fires on its motivating bug
+class (positive), stays quiet on the sanctioned idiom (negative), and
+honors inline suppression; plus baseline ratchet semantics, the live-tree
+pin, and the seeded-historical-bug gate proof from the issue's acceptance
+list.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from tools.zblint import BASELINE_PATH, RULES, lint, lint_source
+from tools.zblint.engine import (
+    FileCtx,
+    Finding,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def src(text):
+    return textwrap.dedent(text).lstrip("\n")
+
+
+# -- unobserved-actor-future -------------------------------------------------
+
+class TestUnobservedActorFuture:
+    RULE = "unobserved-actor-future"
+
+    def test_discarded_submit_actor_fires(self):
+        findings = lint_source(src("""
+            def boot(scheduler, actor):
+                scheduler.submit_actor(actor)
+        """), rules=[self.RULE])
+        assert [f.rule for f in findings] == [self.RULE]
+        assert findings[0].line == 2
+
+    def test_discarded_raft_append_fires(self):
+        # the historical bug: acked-means-committed made a discarded
+        # append future the only trace of dropped records
+        findings = lint_source(src("""
+            class PartitionServer:
+                def tick(self, commands):
+                    self.raft.append(commands)
+        """), rules=[self.RULE])
+        assert rules_of(findings) == {self.RULE}
+
+    def test_assigned_future_is_quiet(self):
+        findings = lint_source(src("""
+            def boot(scheduler, actor):
+                fut = scheduler.submit_actor(actor)
+                return fut
+        """), rules=[self.RULE])
+        assert findings == []
+
+    def test_observed_future_is_quiet(self):
+        findings = lint_source(src("""
+            def boot(scheduler, actor, cb):
+                scheduler.submit_actor(actor).on_complete(cb)
+        """), rules=[self.RULE])
+        assert findings == []
+
+    def test_inferred_return_type_fires(self):
+        findings = lint_source(src("""
+            from zeebe_tpu.runtime.actors import ActorFuture
+
+            def enqueue_probe() -> ActorFuture:
+                pass
+
+            def caller():
+                enqueue_probe()
+        """), rules=[self.RULE])
+        assert rules_of(findings) == {self.RULE}
+        assert findings[0].line == 7
+
+    def test_list_append_is_quiet(self):
+        findings = lint_source(src("""
+            def collect(items, x):
+                items.append(x)
+        """), rules=[self.RULE])
+        assert findings == []
+
+    def test_suppression(self):
+        findings = lint_source(src("""
+            def boot(scheduler, actor):
+                scheduler.submit_actor(actor)  # zblint: disable=unobserved-actor-future (boot)
+        """), rules=[self.RULE])
+        assert findings == []
+
+
+# -- actor-thread-blocking ---------------------------------------------------
+
+class TestActorThreadBlocking:
+    RULE = "actor-thread-blocking"
+
+    def test_sleep_reachable_from_lifecycle_hook_fires(self):
+        findings = lint_source(src("""
+            import time
+
+            class A:
+                def on_actor_started(self):
+                    self._pump()
+
+                def _pump(self):
+                    time.sleep(1)
+        """), rules=[self.RULE])
+        assert rules_of(findings) == {self.RULE}
+
+    def test_fsync_reachable_from_dispatched_method_fires(self):
+        findings = lint_source(src("""
+            import os
+
+            class A:
+                def kick(self):
+                    self.actor.run(self._work)
+
+                def _work(self):
+                    os.fsync(3)
+        """), rules=[self.RULE])
+        assert rules_of(findings) == {self.RULE}
+
+    def test_thread_target_body_is_quiet(self):
+        # a nested function handed to threading.Thread is NOT actor context
+        findings = lint_source(src("""
+            import threading
+            import time
+
+            class A:
+                def on_actor_started(self):
+                    def drain():
+                        time.sleep(1)
+                    threading.Thread(target=drain, daemon=True).start()
+        """), rules=[self.RULE])
+        assert findings == []
+
+    def test_str_join_is_quiet(self):
+        findings = lint_source(src("""
+            class A:
+                def on_actor_started(self):
+                    return ",".join(["a", "b"])
+        """), rules=[self.RULE])
+        assert findings == []
+
+    def test_suppression(self):
+        findings = lint_source(src("""
+            import os
+
+            class A:
+                def on_actor_started(self):
+                    # zblint: disable=actor-thread-blocking (durability)
+                    os.fsync(3)
+        """), rules=[self.RULE])
+        assert findings == []
+
+
+# -- metrics-hot-loop --------------------------------------------------------
+
+class TestMetricsHotLoop:
+    RULE = "metrics-hot-loop"
+
+    def test_count_event_in_loop_fires(self):
+        findings = lint_source(src("""
+            from zeebe_tpu.runtime.metrics import count_event
+
+            def drain(records):
+                for r in records:
+                    count_event("records_seen")
+        """), rules=[self.RULE])
+        assert rules_of(findings) == {self.RULE}
+
+    def test_registry_lookup_in_loop_fires(self):
+        findings = lint_source(src("""
+            def publish(registry, load):
+                for idx, n in load.items():
+                    registry.gauge("device_load", device=str(idx)).set(n)
+        """), rules=[self.RULE])
+        assert rules_of(findings) == {self.RULE}
+
+    def test_cached_handle_miss_guard_is_quiet(self):
+        findings = lint_source(src("""
+            def publish(registry, load, cache):
+                for idx, n in load.items():
+                    handle = cache.get(idx)
+                    if handle is None:
+                        handle = registry.gauge("device_load", device=str(idx))
+                        cache[idx] = handle
+                    handle.set(n)
+        """), rules=[self.RULE])
+        assert findings == []
+
+    def test_except_handler_path_is_quiet(self):
+        findings = lint_source(src("""
+            from zeebe_tpu.runtime.metrics import count_event
+
+            def drain(records, apply):
+                for r in records:
+                    try:
+                        apply(r)
+                    except ValueError:
+                        count_event("apply_failures")
+        """), rules=[self.RULE])
+        assert findings == []
+
+    def test_outside_loop_is_quiet(self):
+        findings = lint_source(src("""
+            from zeebe_tpu.runtime.metrics import count_event
+
+            def drain(records):
+                count_event("drains", delta=len(records))
+        """), rules=[self.RULE])
+        assert findings == []
+
+
+# -- metrics-doc-drift -------------------------------------------------------
+
+class TestMetricsDocDrift:
+    RULE = "metrics-doc-drift"
+
+    @staticmethod
+    def _tree(tmp_path, code, doc):
+        pkg = tmp_path / "zeebe_tpu"
+        pkg.mkdir()
+        (pkg / "m.py").write_text(code)
+        docs = tmp_path / "docs"
+        docs.mkdir()
+        (docs / "metrics.md").write_text(doc)
+        return str(tmp_path)
+
+    def test_both_directions_fire(self, tmp_path):
+        root = self._tree(
+            tmp_path,
+            'from x import count_event\ncount_event("undocumented_series")\n',
+            "| `zb_ghost_series` | counter | gone |\n",
+        )
+        findings, _, _ = lint(root, rules=[self.RULE], roots=("zeebe_tpu",))
+        messages = " ".join(f.message for f in findings)
+        assert "zb_undocumented_series" in messages
+        assert "zb_ghost_series" in messages
+
+    def test_documented_metric_is_quiet(self, tmp_path):
+        root = self._tree(
+            tmp_path,
+            'from x import count_event\ncount_event("good_series")\n',
+            "`zb_good_series` counts good things\n",
+        )
+        findings, _, _ = lint(root, rules=[self.RULE], roots=("zeebe_tpu",))
+        assert findings == []
+
+    def test_ifexp_names_register_both_branches(self, tmp_path):
+        # the STATE.md false positive: conditional metric names
+        root = self._tree(
+            tmp_path,
+            'from x import count_event\n'
+            'count_event("delta_takes" if True else "full_takes")\n',
+            "`zb_delta_takes` / `zb_full_takes` by kind\n",
+        )
+        findings, _, _ = lint(root, rules=[self.RULE], roots=("zeebe_tpu",))
+        assert findings == []
+
+    def test_histogram_suffixes_match_base_series(self, tmp_path):
+        root = self._tree(
+            tmp_path,
+            'from x import count_event\ncount_event("latency_ms")\n',
+            "`zb_latency_ms_bucket` and `zb_latency_ms_sum` rows\n",
+        )
+        findings, _, _ = lint(root, rules=[self.RULE], roots=("zeebe_tpu",))
+        assert findings == []
+
+
+# -- dirty-family-audit ------------------------------------------------------
+
+class TestDirtyFamilyAudit:
+    RULE = "dirty-family-audit"
+
+    def test_unmarked_table_mutation_fires(self):
+        # `jobs` is a HOST_FAMILIES table; TestEngine participates in
+        # dirty tracking, but mutate() is reachable from no marking method
+        findings = lint_source(src("""
+            class TestEngine:
+                def process(self, record):
+                    self.snapshot_mark_dirty(("jobs",))
+
+                def sweep(self, key):
+                    self.jobs.pop(key, None)
+        """), rules=[self.RULE])
+        assert rules_of(findings) == {self.RULE}
+        assert "self.jobs" in findings[0].message
+
+    def test_mutation_reachable_from_marker_is_quiet(self):
+        findings = lint_source(src("""
+            class TestEngine:
+                def process(self, record):
+                    self.snapshot_mark_dirty(("jobs",))
+                    self._apply(record)
+
+                def _apply(self, record):
+                    self.jobs.pop(record.key, None)
+        """), rules=[self.RULE])
+        assert findings == []
+
+    def test_dispatch_table_edge_is_quiet(self):
+        # the interpreter idiom: process() marks, then dispatches through
+        # a class-level handler table
+        findings = lint_source(src("""
+            class TestEngine:
+                def process(self, record):
+                    self.snapshot_mark_dirty(("jobs",))
+                    self._HANDLERS[record.kind](self, record)
+
+                def _handle_job(self, record):
+                    self.jobs.pop(record.key, None)
+
+                _HANDLERS = {"job": _handle_job}
+        """), rules=[self.RULE])
+        assert findings == []
+
+    def test_non_tracking_class_is_quiet(self):
+        findings = lint_source(src("""
+            class Cache:
+                def sweep(self, key):
+                    self.jobs.pop(key, None)
+        """), rules=[self.RULE])
+        assert findings == []
+
+    def test_init_is_exempt(self):
+        findings = lint_source(src("""
+            class TestEngine:
+                def __init__(self):
+                    self.jobs = {}
+
+                def process(self, record):
+                    self.snapshot_mark_dirty(("jobs",))
+        """), rules=[self.RULE])
+        assert findings == []
+
+
+# -- swallowed-exception -----------------------------------------------------
+
+class TestSwallowedException:
+    RULE = "swallowed-exception"
+
+    def test_silent_broad_except_fires(self):
+        findings = lint_source(src("""
+            def f(x):
+                try:
+                    return x()
+                except Exception:
+                    pass
+        """), rules=[self.RULE])
+        assert rules_of(findings) == {self.RULE}
+
+    def test_bare_except_fires(self):
+        findings = lint_source(src("""
+            def f(x):
+                try:
+                    return x()
+                except:
+                    pass
+        """), rules=[self.RULE])
+        assert rules_of(findings) == {self.RULE}
+
+    def test_logging_handler_is_quiet(self):
+        findings = lint_source(src("""
+            import logging
+
+            def f(x):
+                try:
+                    return x()
+                except Exception:
+                    logging.getLogger(__name__).warning("boom")
+        """), rules=[self.RULE])
+        assert findings == []
+
+    def test_narrow_except_is_quiet(self):
+        findings = lint_source(src("""
+            def f(d, k):
+                try:
+                    return d[k]
+                except KeyError:
+                    return None
+        """), rules=[self.RULE])
+        assert findings == []
+
+    def test_stashed_exception_is_quiet(self):
+        # deferred re-raise past a loop observes the exception
+        findings = lint_source(src("""
+            def f(x):
+                error = None
+                try:
+                    x()
+                except Exception as e:
+                    error = e
+                return error
+        """), rules=[self.RULE])
+        assert findings == []
+
+    def test_suppression(self):
+        findings = lint_source(src("""
+            def f(x):
+                try:
+                    return x()
+                except Exception:  # zblint: disable=swallowed-exception (why)
+                    pass
+        """), rules=[self.RULE])
+        assert findings == []
+
+
+# -- undefined-name (ex-nameslint) -------------------------------------------
+
+class TestUndefinedName:
+    RULE = "undefined-name"
+
+    def test_undefined_global_fires(self):
+        # the round-4 class: referenced on a rarely-run path, defined nowhere
+        findings = lint_source(src("""
+            def tick():
+                return _due_probe_jit()
+        """), rules=[self.RULE])
+        assert rules_of(findings) == {self.RULE}
+        assert "_due_probe_jit" in findings[0].message
+
+    def test_defined_global_is_quiet(self):
+        findings = lint_source(src("""
+            def _due_probe_jit():
+                return 1
+
+            def tick():
+                return _due_probe_jit()
+        """), rules=[self.RULE])
+        assert findings == []
+
+    def test_nameslint_shim_still_works(self):
+        import tools.nameslint as shim
+
+        assert shim.main([]) == 0
+
+
+# -- suppression mechanics ---------------------------------------------------
+
+class TestSuppression:
+    def test_comment_line_above(self):
+        findings = lint_source(src("""
+            def boot(scheduler, actor):
+                # zblint: disable=unobserved-actor-future (boot)
+                scheduler.submit_actor(actor)
+        """), rules=["unobserved-actor-future"])
+        assert findings == []
+
+    def test_disable_all(self):
+        findings = lint_source(src("""
+            def boot(scheduler, actor):
+                scheduler.submit_actor(actor)  # zblint: disable=all
+        """), rules=["unobserved-actor-future", "undefined-name"])
+        assert findings == []
+
+    def test_unrelated_rule_does_not_suppress(self):
+        findings = lint_source(src("""
+            def boot(scheduler, actor):
+                scheduler.submit_actor(actor)  # zblint: disable=metrics-hot-loop
+        """), rules=["unobserved-actor-future"])
+        assert len(findings) == 1
+
+
+# -- baseline ratchet --------------------------------------------------------
+
+class TestBaseline:
+    def test_round_trip_and_counts(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        f1 = Finding("swallowed-exception", "a.py", 10, "msg")
+        f2 = Finding("swallowed-exception", "a.py", 20, "msg")
+        write_baseline(path, [f1, f2])
+        baseline = load_baseline(path)
+        assert baseline == {"a.py::swallowed-exception::msg": 2}
+
+    def test_grandfathers_up_to_count_then_surfaces(self):
+        baseline = {"a.py::r::m": 1}
+        f1, f2 = Finding("r", "a.py", 1, "m"), Finding("r", "a.py", 2, "m")
+        surfaced, baselined = apply_baseline([f1, f2], baseline)
+        assert baselined == 1
+        assert surfaced == [f2]
+
+    def test_keys_survive_line_churn(self):
+        # baseline keys carry no line numbers by design
+        baseline = {"a.py::r::m": 1}
+        moved = Finding("r", "a.py", 999, "m")
+        surfaced, baselined = apply_baseline([moved], baseline)
+        assert surfaced == [] and baselined == 1
+
+    def test_checked_in_baseline_is_valid(self):
+        path = os.path.join(REPO_ROOT, BASELINE_PATH)
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["version"] == 1
+        assert doc["entries"], "empty baseline should just be deleted"
+        for key in doc["entries"]:
+            rule = key.split("::")[1]
+            assert rule in RULES, f"baseline entry for unknown rule {rule}"
+
+
+# -- the gate itself ---------------------------------------------------------
+
+class TestGate:
+    def test_live_tree_is_clean(self):
+        """The pin the whole PR stands on: repo lints clean after baseline."""
+        baseline = load_baseline(os.path.join(REPO_ROOT, BASELINE_PATH))
+        surfaced, _baselined, files = lint(REPO_ROOT, baseline=baseline)
+        assert files > 100
+        assert surfaced == [], "\n".join(f.render() for f in surfaced)
+
+    def test_seeded_historical_bug_fails_the_gate(self, tmp_path):
+        """Acceptance proof: re-introducing the unobserved raft.append bug
+        in a scratch tree makes the gate fail."""
+        pkg = tmp_path / "zeebe_tpu"
+        pkg.mkdir()
+        (pkg / "broker.py").write_text(src("""
+            class PartitionServer:
+                def tick(self, commands):
+                    if commands:
+                        self.raft.append(commands)
+        """))
+        surfaced, _, _ = lint(str(tmp_path), roots=("zeebe_tpu",))
+        assert "unobserved-actor-future" in rules_of(surfaced)
+
+    def test_parse_error_surfaces(self, tmp_path):
+        pkg = tmp_path / "zeebe_tpu"
+        pkg.mkdir()
+        (pkg / "broken.py").write_text("def f(:\n")
+        surfaced, _, _ = lint(str(tmp_path), roots=("zeebe_tpu",))
+        assert rules_of(surfaced) == {"parse-error"}
+
+    def test_json_cli_shape(self, tmp_path, capsys):
+        from tools.zblint.__main__ import main as zblint_main
+
+        pkg = tmp_path / "zeebe_tpu"
+        pkg.mkdir()
+        (pkg / "m.py").write_text("def boot(s, a):\n    s.submit_actor(a)\n")
+        rc = zblint_main([
+            "--json", "--no-baseline", "--root", str(tmp_path), "zeebe_tpu",
+        ])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert doc["findings"][0]["rule"] == "unobserved-actor-future"
+        assert set(doc["findings"][0]) == {"rule", "path", "line", "message"}

@@ -1,114 +1,23 @@
 #!/usr/bin/env python
-"""Undefined-global lint: flags names referenced in any scope that resolve
-to the module's global namespace but are defined nowhere in the module and
-are not builtins or known injected globals.
+"""Thin shim: nameslint is now zblint's `undefined-name` rule.
 
-This is the exact bug class that shipped in round 4 (`_due_probe_jit`
-referenced at zeebe_tpu/tpu/engine.py:803, defined nowhere — a NameError
-on every broker tick that 468 green tests never executed). The reference
-enforces an equivalent gate via its compile step + checkstyle
-(`/root/reference/build-tools/`, `Jenkinsfile:7-10`); Python has no
-compile-time name resolution, so this symtable pass stands in for it.
-
-Zero third-party dependencies by design (the CI gate must run in the bare
-image). No config: a finding is a failure. Star imports add all names from
-the imported module when it is importable; otherwise the file is skipped
-for global-resolution findings (none of this repo uses star imports).
+The symtable algorithm lives in tools/zblint/rule_names.py unchanged;
+this entry point survives for muscle memory and old scripts. Run the
+full suite with `python -m tools.zblint`.
 """
 
 from __future__ import annotations
 
-import builtins
-import os
 import sys
-import symtable
-
-# names the runtime injects without a visible assignment
-_IMPLICIT = {
-    "__file__", "__name__", "__doc__", "__package__", "__spec__",
-    "__loader__", "__builtins__", "__debug__", "__path__", "__class__",
-    # typing-only forward references resolved lazily by jax/dataclasses
-    "__annotations__",
-}
-
-
-def _module_globals(table: symtable.SymbolTable) -> set:
-    """All names bound at module level (imports, defs, classes, assigns)."""
-    names = set()
-    for sym in table.get_symbols():
-        # is_assigned covers =, def, class; is_imported covers import forms.
-        # A module-level symbol that is merely referenced is NOT a binding.
-        if sym.is_assigned() or sym.is_imported():
-            names.add(sym.get_name())
-    return names
-
-
-def _walk(table: symtable.SymbolTable, module_names: set, findings: list, path: str):
-    for sym in table.get_symbols():
-        if not sym.is_referenced():
-            continue
-        name = sym.get_name()
-        if (
-            sym.is_global()
-            or (table.get_type() == "module" and not sym.is_assigned()
-                and not sym.is_imported())
-        ):
-            if (
-                name not in module_names
-                and not hasattr(builtins, name)
-                and name not in _IMPLICIT
-            ):
-                findings.append(
-                    f"{path}: undefined name '{name}' "
-                    f"(referenced in scope '{table.get_name()}')"
-                )
-    for child in table.get_children():
-        _walk(child, module_names, findings, path)
-
-
-def lint_file(path: str) -> list:
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    if "import *" in src:
-        return []  # global resolution unsound under star imports
-    try:
-        table = symtable.symtable(src, path, "exec")
-    except SyntaxError as e:
-        return [f"{path}: syntax error: {e}"]
-    findings: list = []
-    _walk(table, _module_globals(table), findings, path)
-    return findings
 
 
 def main(argv) -> int:
-    roots = argv or ["zeebe_tpu", "tests", "benchmarks", "tools",
-                     "bench.py", "__graft_entry__.py"]
-    files = []
-    for root in roots:
-        if os.path.isfile(root):
-            files.append(root)
-            continue
-        for dirpath, _dirnames, filenames in os.walk(root):
-            files += [
-                os.path.join(dirpath, n)
-                for n in filenames
-                if n.endswith(".py") and not n.endswith("_pb2.py")
-            ]
-    findings = []
-    for path in sorted(files):
-        findings += lint_file(path)
-    # dedup: one report per (file, name)
-    seen, unique = set(), []
-    for f in findings:
-        key = f.split(" (referenced")[0]
-        if key not in seen:
-            seen.add(key)
-            unique.append(f)
-    for f in unique:
-        print(f)
-    print(f"nameslint: {len(files)} files, {len(unique)} findings")
-    return 1 if unique else 0
+    from tools.zblint.__main__ import main as zblint_main
+
+    args = ["--rules", "undefined-name", "--no-baseline"]
+    return zblint_main(args + list(argv))
 
 
 if __name__ == "__main__":
+    sys.path.insert(0, ".")  # allow `python tools/nameslint.py` from repo root
     sys.exit(main(sys.argv[1:]))

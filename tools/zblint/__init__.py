@@ -1,0 +1,83 @@
+"""zblint: the project's AST-based static-analysis suite.
+
+Mechanizes the bug classes this repo kept rediscovering by hand review
+(see docs/operations/lint.md for each rule's incident history):
+
+  unobserved-actor-future   discarded ActorFuture results
+  actor-thread-blocking     sleeps/joins/fsyncs on scheduler actors
+  metrics-hot-loop          registry name lookups per loop iteration
+  metrics-doc-drift         code vs docs/operations/metrics.md, both ways
+  dirty-family-audit        engine-state writes without a dirty mark
+  swallowed-exception       broad excepts that do nothing at all
+  undefined-name            the round-4 NameError class (ex-nameslint)
+
+Usage:  python -m tools.zblint [--json] [--write-baseline] [--no-baseline]
+                               [--rules a,b] [paths...]
+
+Stdlib only — the gate must run in the bare CI image.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from . import (
+    engine,
+    rule_blocking,
+    rule_dirty,
+    rule_excepts,
+    rule_futures,
+    rule_metrics,
+    rule_names,
+)
+from .engine import (  # noqa: F401 - public API re-exports
+    BASELINE_PATH,
+    Finding,
+    FileCtx,
+    Project,
+    apply_baseline,
+    collect_files,
+    load_baseline,
+    run_rules,
+    write_baseline,
+)
+
+RULES = {
+    rule_futures.RULE: rule_futures,
+    rule_blocking.RULE: rule_blocking,
+    rule_metrics.RULE_HOT: rule_metrics,
+    rule_metrics.RULE_DRIFT: rule_metrics,
+    rule_dirty.RULE: rule_dirty,
+    rule_excepts.RULE: rule_excepts,
+    rule_names.RULE: rule_names,
+}
+
+
+def lint(
+    root: str = ".",
+    rules: Optional[List[str]] = None,
+    roots: Optional[Tuple[str, ...]] = None,
+    baseline: Optional[Dict[str, int]] = None,
+) -> Tuple[List[Finding], int, int]:
+    """Run the suite; returns (surfaced findings, baselined count,
+    files scanned). ``baseline=None`` means no grandfathering."""
+    selected = {r: RULES[r] for r in (rules or RULES)}
+    files = collect_files(root, roots or engine.DEFAULT_ROOTS)
+    project = Project(root, files)
+    findings = run_rules(project, selected)
+    surfaced, baselined = apply_baseline(findings, baseline or {})
+    return surfaced, baselined, len(files)
+
+
+def lint_source(
+    src: str,
+    path: str = "zeebe_tpu/snippet.py",
+    rules: Optional[List[str]] = None,
+    project: Optional[Project] = None,
+) -> List[Finding]:
+    """Lint one in-memory source blob (test fixtures). The default path
+    puts the snippet inside the package so package-only rules run."""
+    ctx = FileCtx(path, src)
+    project = project or Project(".", [ctx])
+    selected = {r: RULES[r] for r in (rules or RULES)}
+    return run_rules(Project(project.root, [ctx]), selected)

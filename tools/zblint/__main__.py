@@ -1,0 +1,83 @@
+"""zblint CLI. Exit 0 = clean (after baseline), 1 = findings.
+
+The ratchet workflow: fix findings, then ``--write-baseline`` to shrink
+tools/zblint_baseline.json. Never hand-add entries for new code — use an
+inline ``# zblint: disable=<rule>`` with a justification instead, so the
+exemption is visible at the call site in review.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from . import BASELINE_PATH, RULES, lint
+from .engine import DEFAULT_ROOTS, load_baseline, write_baseline
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="zblint")
+    parser.add_argument("paths", nargs="*", help="roots to lint (default: repo set)")
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    parser.add_argument("--rules", help="comma-separated rule ids (default: all)")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline file (default: {BASELINE_PATH})")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="surface grandfathered findings too")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline from current findings and exit 0")
+    parser.add_argument("--root", default=".", help="repo root (default: cwd)")
+    args = parser.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            parser.error(
+                f"unknown rule(s) {unknown}; known: {', '.join(sorted(RULES))}"
+            )
+
+    baseline_path = args.baseline or os.path.join(args.root, BASELINE_PATH)
+    roots = tuple(args.paths) if args.paths else DEFAULT_ROOTS
+
+    started = time.monotonic()
+    if args.write_baseline:
+        findings, _n, files = lint(args.root, rules, roots, baseline=None)
+        entries = write_baseline(baseline_path, findings)
+        print(
+            f"zblint: baseline rewritten with {len(findings)} finding(s) "
+            f"over {len(entries)} key(s) -> {baseline_path}"
+        )
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+    findings, baselined, files = lint(args.root, rules, roots, baseline)
+    elapsed = time.monotonic() - started
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "message": f.message}
+                for f in findings
+            ],
+            "files": files,
+            "baselined": baselined,
+            "seconds": round(elapsed, 3),
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print(
+            f"zblint: {files} files, {len(findings)} finding(s) "
+            f"({baselined} baselined) in {elapsed:.2f}s"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

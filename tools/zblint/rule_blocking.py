@@ -1,0 +1,201 @@
+"""actor-thread-blocking: blocking primitives reachable from code that
+runs ON a scheduler actor thread. An actor's jobs are serialized through
+its mailbox and drained by a small shared worker pool — one blocking call
+stalls every actor behind it (the exporter-director stall, CHANGES.md
+PR 3/6). Actor code must yield instead: run_delayed for sleeps,
+run_on_completion for futures, and push IO to a dedicated thread.
+
+Seeding: a function is actor-dispatched when it is
+  - an ``on_actor_started`` / ``on_actor_closing`` lifecycle hook, or
+  - passed (as ``self.meth``, a local ``def``, or a lambda) to
+    ``<...>.actor.run / submit / call / run_delayed / run_at_fixed_rate /
+    on_condition / run_on_completion`` (the ActorControl dispatch API, cf.
+    runtime/actors.py and the registration patterns in
+    runtime/cluster_broker.py and exporter/director.py).
+Reachability is an intra-module call graph: ``self.m()`` edges, local
+``def`` edges, and ``x.m()`` edges when exactly one class in the module
+defines a non-generic ``m``. Blocking ops: ``time.sleep``, ``os.fsync``,
+``.join()`` with no/numeric-timeout args (ActorFuture/Thread join — never
+str.join), and no-arg ``.result()``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .engine import FileCtx, Finding, Project, attr_chain
+
+RULE = "actor-thread-blocking"
+PACKAGE_ONLY = True
+SKIP_TESTS = True
+
+_DISPATCH = {
+    "run", "submit", "call", "run_delayed", "run_at_fixed_rate",
+    "on_condition", "run_on_completion",
+}
+_ACTOR_RECEIVERS = {"actor", "actor_control"}
+_LIFECYCLE = {"on_actor_started", "on_actor_closing"}
+_GENERIC_METHODS = {
+    "append", "add", "get", "pop", "put", "send", "close", "start", "stop",
+    "run", "update", "remove", "clear", "items", "keys", "values", "set",
+    "join", "flush", "submit", "call", "signal", "cancel", "complete",
+}
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class _Func:
+    __slots__ = ("node", "name", "qual", "cls", "parent", "locals_")
+
+    def __init__(self, node, name, qual, cls, parent):
+        self.node = node
+        self.name = name
+        self.qual = qual
+        self.cls = cls
+        self.parent = parent
+        self.locals_: Dict[str, "_Func"] = {}
+
+
+def _own_nodes(fn_node: ast.AST):
+    """Nodes belonging to this function's body, not descending into
+    nested function definitions (those run in their own dispatch)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, _FUNC_NODES):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _collect(tree: ast.AST):
+    funcs: List[_Func] = []
+    methods: Dict[Tuple[str, str], _Func] = {}
+    module_funcs: Dict[str, _Func] = {}
+    by_method: Dict[str, List[_Func]] = {}
+
+    def walk(node, cls: Optional[str], parent: Optional[_Func]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name, parent)
+            elif isinstance(child, _FUNC_NODES):
+                name = getattr(child, "name", "<lambda>")
+                qual = f"{cls}.{name}" if cls else name
+                info = _Func(child, name, qual, cls, parent)
+                funcs.append(info)
+                if cls and parent is None:
+                    methods[(cls, name)] = info
+                    by_method.setdefault(name, []).append(info)
+                elif parent is None and not cls:
+                    module_funcs[name] = info
+                elif parent is not None:
+                    parent.locals_[name] = info
+                walk(child, cls, info)
+            else:
+                walk(child, cls, parent)
+
+    walk(tree, None, None)
+    return funcs, methods, module_funcs, by_method
+
+
+def _resolve(call: ast.Call, info: _Func, methods, module_funcs, by_method):
+    func = call.func
+    if isinstance(func, ast.Name):
+        scope = info
+        while scope is not None:
+            if func.id in scope.locals_:
+                return scope.locals_[func.id]
+            scope = scope.parent
+        return module_funcs.get(func.id)
+    chain = attr_chain(func)
+    if not chain:
+        return None
+    if chain[0] == "self" and len(chain) == 2 and info.cls:
+        return methods.get((info.cls, chain[1]))
+    m = chain[-1]
+    if m not in _GENERIC_METHODS and len(by_method.get(m, [])) == 1:
+        return by_method[m][0]
+    return None
+
+
+def _blocking_desc(call: ast.Call) -> Optional[str]:
+    chain = attr_chain(call.func)
+    if chain in (["time", "sleep"], ["_time", "sleep"]):
+        return "time.sleep"
+    if chain in (["os", "fsync"], ["_os", "fsync"]):
+        return "os.fsync"
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        if isinstance(call.func.value, ast.Constant):
+            return None  # "x".join(...) and friends
+        args_numeric = all(
+            isinstance(a, ast.Constant) and isinstance(a.value, (int, float))
+            for a in call.args
+        )
+        if attr == "join" and args_numeric:
+            return "blocking future/thread .join()"
+        if attr == "result" and not call.args:
+            return "blocking future .result()"
+    return None
+
+
+def check(ctx: FileCtx, project: Project) -> List[Finding]:
+    funcs, methods, module_funcs, by_method = _collect(ctx.tree)
+    if not funcs:
+        return []
+    by_node = {f.node: f for f in funcs}
+
+    # -- seed entries: lifecycle hooks + fns handed to the dispatch API
+    entries: Dict[_Func, str] = {}
+    for f in funcs:
+        if f.cls and f.name in _LIFECYCLE and f.parent is None:
+            entries[f] = f.qual
+    for f in funcs:
+        for node in _own_nodes(f.node):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if (
+                not chain
+                or len(chain) < 2
+                or chain[-1] not in _DISPATCH
+                or chain[-2] not in _ACTOR_RECEIVERS
+            ):
+                continue
+            dispatch = ".".join(chain)
+            for arg in node.args:
+                target = None
+                if isinstance(arg, ast.Lambda):
+                    target = by_node.get(arg)
+                elif isinstance(arg, (ast.Name, ast.Attribute)):
+                    fake = ast.Call(func=arg, args=[], keywords=[])
+                    target = _resolve(fake, f, methods, module_funcs, by_method)
+                if target is not None:
+                    entries.setdefault(target, f"{dispatch}({target.qual})")
+
+    # -- reachability closure over intra-module call edges
+    reached: Dict[_Func, str] = dict(entries)
+    frontier = list(entries)
+    while frontier:
+        cur = frontier.pop()
+        for node in _own_nodes(cur.node):
+            if isinstance(node, ast.Call):
+                callee = _resolve(node, cur, methods, module_funcs, by_method)
+                if callee is not None and callee not in reached:
+                    reached[callee] = reached[cur]
+                    frontier.append(callee)
+
+    findings: List[Finding] = []
+    for f, entry in sorted(reached.items(), key=lambda kv: kv[0].node.lineno):
+        for node in _own_nodes(f.node):
+            if not isinstance(node, ast.Call):
+                continue
+            desc = _blocking_desc(node)
+            if desc is not None:
+                findings.append(Finding(
+                    RULE, ctx.path, node.lineno,
+                    f"{desc} in '{f.qual}' runs on an actor thread "
+                    f"(dispatched via {entry}) — actors must yield, not "
+                    f"block: use run_delayed / run_on_completion or move "
+                    f"the IO off-actor",
+                ))
+    return findings

@@ -1,0 +1,158 @@
+"""dirty-family-audit: an engine-state mutation that no dirty-family
+mark covers produces a snapshot that silently misses committed state —
+the PR-5 INCIDENT-class bug (a handler mutated the incident tables while
+the value_type→families map said incidents were clean, so delta takes
+shipped stale families and a restore lost resolved incidents).
+
+The audited tables are exactly the ones named in
+``log/stateser.HOST_FAMILIES`` (parsed from the AST, never imported).
+Within any class that participates in dirty tracking (it calls
+``snapshot_mark_dirty`` / ``_mark_dirty_for_record`` somewhere), every
+method that mutates ``self.<table>`` must be *covered*:
+
+  - it marks dirty itself — a ``snapshot_mark_dirty`` /
+    ``_mark_dirty_for_record`` call, or any direct manipulation of the
+    tracking state (``self._dirty_families.add(...)``,
+    ``self._dirty_device = None``, ``_mark_device_dirty(...)`` — any
+    dirty-named reference counts), or
+  - it is reachable (``self.m()`` edges + class-level dispatch-table
+    references, e.g. ``_STEP_HANDLERS``) from a method that marks —
+    the ``process()`` → value_type map → handler chain.
+
+``__init__`` is exempt: a fresh engine's tracking is cold by contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .engine import FileCtx, Finding, Project, attr_chain
+
+RULE = "dirty-family-audit"
+PACKAGE_ONLY = True
+SKIP_TESTS = True
+
+_MUTATORS = {
+    "pop", "setdefault", "update", "clear", "append", "add", "remove",
+    "discard", "extend", "insert", "put", "merge", "destroy",
+    "new_instance", "popitem", "__setitem__",
+}
+
+
+def _method_calls_marker(fn: ast.AST) -> bool:
+    """Any dirty-named reference counts as marking: the engines spell it
+    as marker-method calls, ``_dirty_families.add``, ``_dirty_device =
+    None`` (mark-all on restore), ``_device_keys_dirty = True``, ..."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and "dirty" in node.attr:
+            return True
+        if isinstance(node, ast.Name) and "dirty" in node.id:
+            return True
+    return False
+
+
+def _self_table_attr(node: ast.AST, tables: Set[str]) -> Optional[str]:
+    """'jobs' for `self.jobs` when jobs is an audited table."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+        and node.attr in tables
+    ):
+        return node.attr
+    return None
+
+
+def _mutations(fn: ast.AST, tables: Set[str]) -> List[tuple]:
+    """(lineno, table, how) mutation sites of audited tables in one method."""
+    hits = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                table = _self_table_attr(t, tables)
+                if table:
+                    hits.append((node.lineno, table, "rebound"))
+                if isinstance(t, ast.Subscript):
+                    table = _self_table_attr(t.value, tables)
+                    if table:
+                        hits.append((node.lineno, table, "item-assigned"))
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    table = _self_table_attr(t.value, tables)
+                    if table:
+                        hits.append((node.lineno, table, "item-deleted"))
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in _MUTATORS:
+                table = _self_table_attr(node.func.value, tables)
+                if table:
+                    hits.append((node.lineno, table, f".{node.func.attr}()"))
+    return hits
+
+
+def check(ctx: FileCtx, project: Project) -> List[Finding]:
+    tables = set(project.host_table_attrs())
+    if not tables:
+        return []
+    findings: List[Finding] = []
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods: Dict[str, ast.AST] = {
+            item.name: item
+            for item in cls.body
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        if not any(_method_calls_marker(fn) for fn in methods.values()):
+            continue  # class does not participate in dirty tracking
+
+        # dispatch tables: class-level dict/tuple literals whose values
+        # reference methods by name (`_STEP_HANDLERS = {...: _h_x}`)
+        table_members: Dict[str, Set[str]] = {}
+        for item in cls.body:
+            if isinstance(item, ast.Assign) and isinstance(
+                item.value, (ast.Dict, ast.Tuple, ast.List)
+            ):
+                refs = {
+                    n.id
+                    for n in ast.walk(item.value)
+                    if isinstance(n, ast.Name) and n.id in methods
+                }
+                if refs:
+                    for t in item.targets:
+                        if isinstance(t, ast.Name):
+                            table_members[t.id] = refs
+
+        def edges(fn: ast.AST) -> Set[str]:
+            out: Set[str] = set()
+            for node in ast.walk(fn):
+                chain = attr_chain(node) if isinstance(node, ast.Attribute) else None
+                if chain and chain[0] in ("self", "cls") and len(chain) == 2:
+                    if chain[1] in methods:
+                        out.add(chain[1])
+                    out |= table_members.get(chain[1], set())
+            return out
+
+        covered = {name for name, fn in methods.items() if _method_calls_marker(fn)}
+        frontier = list(covered)
+        while frontier:
+            for callee in edges(methods[frontier.pop()]):
+                if callee not in covered:
+                    covered.add(callee)
+                    frontier.append(callee)
+
+        for name, fn in methods.items():
+            if name == "__init__" or name in covered:
+                continue
+            for _lineno, table, how in _mutations(fn, tables):
+                findings.append(Finding(
+                    RULE, ctx.path, _lineno,
+                    f"'{cls.name}.{name}' mutates engine table "
+                    f"'self.{table}' ({how}) outside any dirty-family "
+                    f"mark — snapshot deltas will miss it; call "
+                    f"snapshot_mark_dirty or route through the "
+                    f"value_type→families map",
+                ))
+    return findings

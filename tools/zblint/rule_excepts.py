@@ -1,0 +1,64 @@
+"""swallowed-exception: a bare/broad except whose body neither logs,
+counts, re-raises, nor calls anything at all. Such handlers turned real
+failures into silence more than once in this repo's history (the round-4
+broker-tick NameError ran for two rounds behind one).
+
+Broad means ``except:``, ``except Exception:`` or ``except BaseException:``
+(including inside a tuple). Narrow handlers (``except KeyError: pass``)
+are a deliberate idiom and not flagged. "Handles" means: any Call or Raise
+anywhere in the handler body — logging, count_event, future
+completion, traceback printing all qualify — or the body referencing the
+bound exception name (``except Exception as e: error = e`` defers the
+re-raise past a loop; the exception is observed, not swallowed).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .engine import FileCtx, Finding, Project
+
+RULE = "swallowed-exception"
+SKIP_TESTS = True
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in _BROAD:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _BROAD:
+            return True
+    return False
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Raise, ast.Call)):
+                return False
+            if (
+                handler.name
+                and isinstance(node, ast.Name)
+                and node.id == handler.name
+            ):
+                return False  # bound exception is used (e.g. stashed)
+    return True
+
+
+def check(ctx: FileCtx, project: Project) -> List[Finding]:
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ExceptHandler) and _is_broad(node) and _is_silent(node):
+            findings.append(Finding(
+                RULE, ctx.path, node.lineno,
+                "broad except swallows exceptions silently "
+                "(log, count, or re-raise — or narrow the exception type)",
+            ))
+    return findings

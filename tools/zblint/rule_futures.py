@@ -1,0 +1,165 @@
+"""unobserved-actor-future: a call that returns an ActorFuture whose
+result is thrown away — not joined, not assigned, and not given a
+completion callback. This is the repo's most-rediscovered review finding
+(lost subscription OPENs, lost exporter acks, the dead deposed-leader
+log; see CHANGES.md PRs 3-10): since raft went acked-means-committed, a
+discarded append future silently drops the *failure* path too.
+
+Seeds (the known future-returning API):
+  - ``Raft.append`` (cluster/raft.py) — matched on any receiver whose
+    attribute chain mentions ``raft`` (``self.raft.append``,
+    ``server.raft.append``), never on list.append;
+  - ``ActorScheduler.submit_actor`` / ``close_actor`` (runtime/actors.py)
+    — unambiguous names, matched on any receiver;
+  - ``ActorControl.call`` — matched when the receiver is an ``actor`` /
+    ``actor_control`` attribute;
+plus a lightweight intra-module inference pass: a function/method whose
+return annotation is ActorFuture, or that returns ``ActorFuture()`` (or
+a local completed later), or that returns another known future call, is
+itself future-returning; discarding its result is flagged for
+``self.<m>()`` and bare ``m()`` call forms.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .engine import FileCtx, Finding, Project, attr_chain
+
+RULE = "unobserved-actor-future"
+PACKAGE_ONLY = True
+SKIP_TESTS = True
+
+_UNAMBIGUOUS = {"submit_actor", "close_actor"}
+# attribute names too generic to match by inference alone on arbitrary
+# receivers (list.append, dict.get, ...)
+_GENERIC = {
+    "append", "add", "get", "pop", "run", "call", "put", "send", "join",
+    "close", "start", "stop", "update", "remove", "submit",
+}
+
+
+def _annotation_is_future(node: Optional[ast.AST]) -> bool:
+    if node is None:
+        return False
+    if isinstance(node, ast.Name):
+        return node.id == "ActorFuture"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "ActorFuture"
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return "ActorFuture" in node.value
+    return False
+
+
+class _Inference:
+    """Two-pass fixpoint over one module: which defs return ActorFuture."""
+
+    def __init__(self, tree: ast.AST):
+        self.methods: Dict[Tuple[str, str], ast.FunctionDef] = {}
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        for node in tree.body if hasattr(tree, "body") else []:
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self.methods[(node.name, item.name)] = item
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+        self.future_methods: Set[Tuple[str, str]] = set()
+        self.future_functions: Set[str] = set()
+        for _ in range(2):  # fixpoint: returns-of-returns settle in 2 passes
+            for key, fn in self.methods.items():
+                if self._returns_future(fn, key[0]):
+                    self.future_methods.add(key)
+            for name, fn in self.functions.items():
+                if self._returns_future(fn, None):
+                    self.future_functions.add(name)
+        self.future_method_names: Set[str] = {m for _c, m in self.future_methods}
+
+    def _call_is_future(self, call: ast.Call, cls: Optional[str]) -> bool:
+        if isinstance(call.func, ast.Name):
+            return (
+                call.func.id == "ActorFuture"
+                or call.func.id in self.future_functions
+            )
+        if isinstance(call.func, ast.Attribute):
+            if call.func.attr == "ActorFuture":
+                return True
+            chain = attr_chain(call.func)
+            if chain and chain[0] == "self" and len(chain) == 2 and cls:
+                return (cls, call.func.attr) in self.future_methods
+        return False
+
+    def _returns_future(self, fn: ast.FunctionDef, cls: Optional[str]) -> bool:
+        if _annotation_is_future(fn.returns):
+            return True
+        future_locals: Set[str] = set()
+        result = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if self._call_is_future(node.value, cls):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            future_locals.add(t.id)
+            if isinstance(node, ast.Return) and node.value is not None:
+                v = node.value
+                if isinstance(v, ast.Call) and self._call_is_future(v, cls):
+                    result = True
+                if isinstance(v, ast.Name) and v.id in future_locals:
+                    result = True
+        return result
+
+
+def _flag_reason(call: ast.Call, cls: Optional[str], inf: _Inference) -> Optional[str]:
+    """Callee description when this discarded call returns an ActorFuture."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        if func.id in inf.future_functions:
+            return func.id
+        return None
+    if not isinstance(func, ast.Attribute):
+        return None
+    attr = func.attr
+    chain = attr_chain(func)
+    dotted = ".".join(chain) if chain else f"<expr>.{attr}"
+    if attr in _UNAMBIGUOUS:
+        return dotted
+    receiver = chain[:-1] if chain else []
+    if attr == "append" and any("raft" in seg for seg in receiver):
+        return dotted
+    if attr == "call" and receiver and receiver[-1] in ("actor", "actor_control"):
+        return dotted
+    if chain and chain[0] == "self" and len(chain) == 2 and cls:
+        if (cls, attr) in inf.future_methods:
+            return dotted
+    if attr in inf.future_method_names and attr not in _GENERIC:
+        return dotted
+    return None
+
+
+def check(ctx: FileCtx, project: Project) -> List[Finding]:
+    inf = _Inference(ctx.tree)
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, cls: Optional[str], fn: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, child.name, fn)
+                continue
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(child, cls, child.name)
+                continue
+            if isinstance(child, ast.Expr) and isinstance(child.value, ast.Call):
+                callee = _flag_reason(child.value, cls, inf)
+                if callee is not None:
+                    where = f"{cls}.{fn}" if cls else (fn or "<module>")
+                    findings.append(Finding(
+                        RULE, ctx.path, child.lineno,
+                        f"ActorFuture from '{callee}' is discarded in "
+                        f"'{where}' — join it, attach run_on_completion, "
+                        f"or justify fire-and-forget with a disable comment",
+                    ))
+            visit(child, cls, fn)
+
+    visit(ctx.tree, None, "")
+    return findings

@@ -1,0 +1,171 @@
+"""The two metrics rules.
+
+metrics-hot-loop: ``MetricsRegistry.counter()/gauge()/histogram()`` (and
+the ``count_event``/``global_counter``/``global_gauge``/``set_gauge``
+wrappers) resolve the series through a name+labels dict lookup under the
+registry lock. Doing that per loop iteration is the per-record cost this
+repo has removed three separate times (CHANGES.md PRs 6-8) — allocate the
+handle once outside the loop and ``inc()`` the handle. The established
+cached-handle idiom (allocate under an ``if <miss>`` guard inside the
+loop, store the handle) is exempt: only *unconditional* per-iteration
+lookups are flagged.
+
+metrics-doc-drift: every metric name literal registered in zeebe_tpu/
+must have a matching ``zb_<name>`` mention in docs/, and every ``zb_``
+series mentioned in docs/ must still be registered somewhere in code.
+Both directions — stale doc rows have burned operators before
+(docs/operations/metrics.md is the alerting reference).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Tuple
+
+from .engine import FileCtx, Finding, Project, attr_chain
+
+RULE_HOT = "metrics-hot-loop"
+RULE_DRIFT = "metrics-doc-drift"
+RULE = RULE_HOT
+PACKAGE_ONLY = True
+SKIP_TESTS = True
+
+_ALLOC_ATTRS = {"counter", "gauge", "histogram"}
+_ALLOC_NAMES = {
+    "count_event", "_count_event", "global_counter", "global_gauge",
+    "set_gauge", "_set_gauge",
+}
+_METRIC_PREFIX = "zb_"
+_DOC_TOKEN_RE = re.compile(r"\bzb_([a-z][a-z0-9_]*)")
+# prometheus histogram sub-series documented per-suffix
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+_LOOP_NODES = (ast.For, ast.AsyncFor, ast.While)
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _alloc_call_name(node: ast.Call) -> str:
+    """Metric-allocation callee name, or '' if this call is not one."""
+    if isinstance(node.func, ast.Name) and node.func.id in _ALLOC_NAMES:
+        return node.func.id
+    if isinstance(node.func, ast.Attribute) and node.func.attr in _ALLOC_ATTRS:
+        chain = attr_chain(node.func)
+        return ".".join(chain) if chain else f"<expr>.{node.func.attr}"
+    return ""
+
+
+def check(ctx: FileCtx, project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, stack: List[ast.AST]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.Call):
+                callee = _alloc_call_name(child)
+                if callee:
+                    # innermost enclosing loop within the same function;
+                    # an If or except-handler between loop and call is the
+                    # cached-handle / error-path idiom and exempt
+                    guarded, in_loop = False, False
+                    for anc in reversed(stack):
+                        if isinstance(anc, _FUNC_NODES):
+                            break
+                        if isinstance(anc, (ast.If, ast.IfExp, ast.ExceptHandler)):
+                            guarded = True
+                        if isinstance(anc, _LOOP_NODES):
+                            in_loop = True
+                            break
+                    if in_loop and not guarded:
+                        findings.append(Finding(
+                            RULE_HOT, ctx.path, child.lineno,
+                            f"metrics registry lookup '{callee}(...)' runs "
+                            f"every loop iteration — allocate the handle "
+                            f"once outside the loop and inc()/set() it",
+                        ))
+            stack.append(child)
+            visit(child, stack)
+            stack.pop()
+
+    visit(ctx.tree, [])
+    return findings
+
+
+# -- doc drift (repo-level) --------------------------------------------------
+
+def _code_metric_names(files: List[FileCtx]) -> Dict[str, Tuple[str, int]]:
+    """Literal metric names registered in package code -> first site."""
+    names: Dict[str, Tuple[str, int]] = {}
+    for ctx in files:
+        if not ctx.in_package or ctx.is_test or ctx.tree is None:
+            continue
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and _alloc_call_name(node)):
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            literals = []
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                literals.append(arg.value)
+            elif isinstance(arg, ast.IfExp):
+                # `count_event("a" if cond else "b")` registers both
+                for branch in (arg.body, arg.orelse):
+                    if isinstance(branch, ast.Constant) and isinstance(
+                        branch.value, str
+                    ):
+                        literals.append(branch.value)
+            if not literals:
+                continue  # dynamic names are out of static reach
+            for name in literals:
+                names.setdefault(name, (ctx.path, node.lineno))
+    return names
+
+
+def _doc_metric_tokens(docs_dir: str) -> Dict[str, Tuple[str, int]]:
+    tokens: Dict[str, Tuple[str, int]] = {}
+    for dirpath, _dirs, filenames in os.walk(docs_dir):
+        for fname in sorted(filenames):
+            if not fname.endswith(".md"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, os.path.dirname(docs_dir))
+            rel = rel.replace(os.sep, "/")
+            try:
+                with open(path, encoding="utf-8") as f:
+                    for lineno, line in enumerate(f, 1):
+                        for m in _DOC_TOKEN_RE.finditer(line):
+                            tokens.setdefault(m.group(1), (rel, lineno))
+            except OSError:
+                continue
+    return tokens
+
+
+def check_repo(project: Project) -> List[Finding]:
+    code = _code_metric_names(project.files)
+    docs = _doc_metric_tokens(project.docs_dir)
+    findings: List[Finding] = []
+    for name, (path, line) in sorted(code.items()):
+        documented = name in docs or any(
+            name + suffix in docs for suffix in _HIST_SUFFIXES
+        )
+        if not documented:
+            findings.append(Finding(
+                RULE_DRIFT, path, line,
+                f"metric '{_METRIC_PREFIX}{name}' is registered here but "
+                f"documented nowhere under docs/ — add a row to "
+                f"docs/operations/metrics.md",
+            ))
+    for token, (path, line) in sorted(docs.items()):
+        base = token
+        for suffix in _HIST_SUFFIXES:
+            if token.endswith(suffix) and token[: -len(suffix)] in code:
+                base = token[: -len(suffix)]
+                break
+        if base not in code:
+            findings.append(Finding(
+                RULE_DRIFT, path, line,
+                f"documented metric '{_METRIC_PREFIX}{token}' is not "
+                f"registered anywhere in zeebe_tpu/ — stale row, or the "
+                f"series was renamed",
+            ))
+    return findings

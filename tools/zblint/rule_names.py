@@ -1,0 +1,83 @@
+"""undefined-name: names that resolve to module globals but are defined
+nowhere in the module (the round-4 `_due_probe_jit` NameError class).
+
+This is tools/nameslint.py folded into zblint: same symtable algorithm,
+same zero-dependency constraint, now with file:line reporting and the
+shared suppression/baseline machinery. tools/nameslint.py remains as a
+thin shim over this module.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import symtable
+from typing import Dict, List
+
+from .engine import FileCtx, Finding, Project
+
+RULE = "undefined-name"
+
+# names the runtime injects without a visible assignment
+_IMPLICIT = {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__debug__", "__path__", "__class__",
+    "__annotations__",
+}
+
+
+def _module_globals(table: symtable.SymbolTable) -> set:
+    names = set()
+    for sym in table.get_symbols():
+        if sym.is_assigned() or sym.is_imported():
+            names.add(sym.get_name())
+    return names
+
+
+def _walk(table, module_names, hits: Dict[str, str], path: str):
+    for sym in table.get_symbols():
+        if not sym.is_referenced():
+            continue
+        name = sym.get_name()
+        if (
+            sym.is_global()
+            or (table.get_type() == "module" and not sym.is_assigned()
+                and not sym.is_imported())
+        ):
+            if (
+                name not in module_names
+                and not hasattr(builtins, name)
+                and name not in _IMPLICIT
+            ):
+                hits.setdefault(name, table.get_name())
+    for child in table.get_children():
+        _walk(child, module_names, hits, path)
+
+
+def _first_lines(tree: ast.AST, names: set) -> Dict[str, int]:
+    lines: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id in names:
+            lines[node.id] = min(lines.get(node.id, node.lineno), node.lineno)
+    return lines
+
+
+def check(ctx: FileCtx, project: Project) -> List[Finding]:
+    if "import *" in ctx.src:
+        return []  # global resolution unsound under star imports
+    try:
+        table = symtable.symtable(ctx.src, ctx.path, "exec")
+    except SyntaxError:
+        return []  # engine already reported parse-error
+    hits: Dict[str, str] = {}
+    _walk(table, _module_globals(table), hits, ctx.path)
+    if not hits:
+        return []
+    lines = _first_lines(ctx.tree, set(hits)) if ctx.tree is not None else {}
+    return [
+        Finding(
+            RULE, ctx.path, lines.get(name, 1),
+            f"undefined name '{name}' (referenced in scope '{scope}')",
+        )
+        for name, scope in sorted(hits.items())
+    ]

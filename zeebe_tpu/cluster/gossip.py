@@ -114,7 +114,7 @@ class Gossip(Actor):
         self.server = ServerTransport(host=host, port=port, request_handler=self._on_request)
         self.client = ClientTransport(default_timeout_ms=self.config.probe_timeout_ms)
         self.self_member = Member(member_id, self.server.address)
-        scheduler.submit_actor(self)
+        scheduler.submit_actor(self)  # zblint: disable=unobserved-actor-future (boot submit; start failures land in the scheduler failure ring)
 
     @property
     def address(self) -> RemoteAddress:

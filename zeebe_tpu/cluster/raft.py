@@ -97,6 +97,9 @@ class RaftPersistentStorage:
                 f,
             )
             f.flush()
+            # raft safety: term/vote must be durable before answering any
+            # RPC — an async fsync would reintroduce the double-vote window
+            # zblint: disable=actor-thread-blocking (deliberate sync fsync)
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
 
@@ -169,7 +172,7 @@ class Raft(Actor):
 
         self.server = ServerTransport(host=host, port=port, request_handler=self._on_request)
         self.client = ClientTransport(default_timeout_ms=1000)
-        scheduler.submit_actor(self)
+        scheduler.submit_actor(self)  # zblint: disable=unobserved-actor-future (boot submit; start failures land in the scheduler failure ring)
 
     # -- public API --------------------------------------------------------
     @property

@@ -634,7 +634,7 @@ class ExporterDirectorActor(Actor):
         self.can_ack = director.can_ack  # tracing's final-stage probe
         self._closing = False
         self._commit_listener = lambda _pos: self.schedule_pump()
-        scheduler.submit_actor(self)
+        scheduler.submit_actor(self)  # zblint: disable=unobserved-actor-future (boot submit; start failures land in the scheduler failure ring)
         self.director.log.on_commit(self._commit_listener)
 
     def on_actor_started(self) -> None:

@@ -678,6 +678,7 @@ class SnapshotController:
             except (SnapshotPartError, stateser.SnapshotFormatError) as e:
                 self._skip(meta, str(e))
                 continue
+            # zblint: disable=metrics-hot-loop (runs once: the loop returns right after)
             _set_gauge(
                 "snapshot_restore_seconds", time.perf_counter() - t0,
                 "Duration of the last snapshot recovery (read + streamed "

@@ -59,6 +59,35 @@ from zeebe_tpu.tracing.recorder import FLIGHT, record_event
 logger = logging.getLogger(__name__)
 
 
+def observe_append(
+    future: ActorFuture, what: str, partition_id: int
+) -> None:
+    """Attach a loss observer to a fire-and-forget raft append.
+
+    Since acked-means-committed (PR 10) a failed append future means the
+    records were DROPPED — deposed leader, truncated tail — and the only
+    trace is this future. Callers that are re-driven elsewhere (ticks,
+    sweeps, backlog probes) still route through here so the loss rate is
+    measurable instead of invisible.
+    """
+
+    def _done(f: ActorFuture) -> None:
+        exc = getattr(f, "_exception", None)
+        if exc is None:
+            return
+        count_event(
+            "raft_append_losses",
+            "Fire-and-forget raft appends whose future failed (records "
+            "dropped on leadership change or truncation)",
+        )
+        logger.warning(
+            "fire-and-forget append of %s on partition %d was lost: %r",
+            what, partition_id, exc,
+        )
+
+    future.on_complete(_done)
+
+
 class _AppendFailed(Exception):
     """Raft append failed (deposed mid-request); maps to NOT_LEADER."""
 
@@ -382,7 +411,10 @@ class PartitionServer:
                     (),
                 )
                 if stale:
-                    self.raft.append(stale)
+                    observe_append(
+                        self.raft.append(stale),
+                        "stale exporter-position sweep", self.partition_id,
+                    )
             except Exception as e:  # noqa: BLE001 - sweep must never
                 # wedge the leadership install; the pin merely persists
                 # until a later leader's sweep lands
@@ -606,7 +638,10 @@ class PartitionServer:
                 + engine.backlog_activations()
             )
         if commands:
-            self.raft.append(commands)
+            # re-driven by the next tick if lost, but the loss must count
+            observe_append(
+                self.raft.append(commands), "tick commands", self.partition_id
+            )
 
     # committed records drain into the engine in batches: the device
     # engine's throughput comes from SIMD batches (one kernel dispatch per
@@ -752,7 +787,10 @@ class PartitionServer:
             # keeps them lazy all the way into the log tail.
             from zeebe_tpu.protocol.columnar import as_log_batch
 
-            self.raft.append(as_log_batch(result.written))
+            observe_append(
+                self.raft.append(as_log_batch(result.written)),
+                "engine follow-up records", self.partition_id,
+            )
         for response in result.responses:
             self.broker.send_client_response(response, server=self)
         for target_pid, send in result.sends:
@@ -1170,7 +1208,7 @@ class ClusterBroker(Actor):
         )
         self.client_transport = ClientTransport()
 
-        self.scheduler.submit_actor(self)
+        self.scheduler.submit_actor(self)  # zblint: disable=unobserved-actor-future (boot submit; start failures land in the scheduler failure ring)
         self.actor_control = None  # set in on_actor_started
 
         # periodic snapshotting (reference snapshotPeriod)
@@ -1213,7 +1251,7 @@ class ClusterBroker(Actor):
                     RemoteAddress(hp.split(":")[0], int(hp.split(":")[1]))
                     for hp in self.cfg.cluster.initial_contact_points
                 ]
-            )
+            ).on_complete(self._on_join_result)
         self.actor.run_at_fixed_rate(500, self._maybe_bootstrap)
 
     def _publish_node_info(self) -> None:
@@ -1241,6 +1279,22 @@ class ClusterBroker(Actor):
 
     def join(self, contact_points: List[RemoteAddress]) -> ActorFuture:
         return self.gossip.join(contact_points)
+
+    def _on_join_result(self, future: ActorFuture) -> None:
+        """A node that exhausts its join retries is alive but invisible to
+        the cluster — without this, the only symptom is a topology that
+        never reaches the expected node count."""
+        exc = getattr(future, "_exception", None)
+        if exc is not None:
+            count_event(
+                "gossip_join_failures",
+                "Boot-time gossip joins that exhausted their retries",
+            )
+            logger.error(
+                "broker %s: join via configured contact points failed "
+                "(node is up but not in the cluster topology): %r",
+                self.node_id, exc,
+            )
 
     def open_partition(self, partition_id: int) -> ActorFuture:
         """Create/open a partition (log + raft endpoint, not yet clustered);
@@ -2075,7 +2129,7 @@ class ClusterBroker(Actor):
             else:
                 cursor = 0
             # durable audit record (+ ack reset on force_start)
-            server.raft.append([
+            observe_append(server.raft.append([
                 Record(
                     metadata=RecordMetadata(
                         record_type=RecordType.COMMAND,
@@ -2088,7 +2142,7 @@ class ClusterBroker(Actor):
                         force_start=force_start,
                     ),
                 )
-            ])
+            ]), "topic-subscriber audit record", partition_id)
             if conn is not None:
                 epoch = int(msg.get("epoch", -1))
 
@@ -2125,7 +2179,7 @@ class ClusterBroker(Actor):
                 server.pump_topic_subscriptions()
         elif action == "ack":
             position = int(msg.get("position", -1))
-            server.raft.append([
+            observe_append(server.raft.append([
                 Record(
                     key=subscriber_key,
                     metadata=RecordMetadata(
@@ -2135,7 +2189,7 @@ class ClusterBroker(Actor):
                     ),
                     value=TopicSubscriptionRecord(name=name, ack_position=position),
                 )
-            ])
+            ]), "topic-subscription ack", partition_id)
             pusher = server.topic_pushers.get(subscriber_key)
             if pusher is not None:
                 pusher["unacked"] = [p for p in pusher["unacked"] if p > position]
@@ -2235,7 +2289,7 @@ class ClusterBroker(Actor):
         from zeebe_tpu.protocol.enums import RecordType as RT
 
         for topic in self.cfg.topics:
-            server.raft.append([
+            observe_append(server.raft.append([
                 Record(
                     metadata=RecordMetadata(
                         record_type=RT.COMMAND,
@@ -2248,7 +2302,7 @@ class ClusterBroker(Actor):
                         replication_factor=topic.replication_factor,
                     ),
                 )
-            ])
+            ]), "default-topic CREATE", 0)
 
     # -- topic orchestration (reference TopicCreationService + NodeSelector
     # + CreatePartitionRequest → ManagementApiRequestHandler) ---------------
@@ -2342,7 +2396,7 @@ class ClusterBroker(Actor):
             from zeebe_tpu.protocol.records import TopicRecord
             from zeebe_tpu.protocol.enums import RecordType as RT
 
-            server.raft.append([
+            observe_append(server.raft.append([
                 Record(
                     key=record.key,
                     metadata=RecordMetadata(
@@ -2354,7 +2408,7 @@ class ClusterBroker(Actor):
                     ),
                     value=TopicRecord(name=value.name),
                 )
-            ])
+            ]), "topic CREATE_COMPLETE", 0)
         except Exception:  # noqa: BLE001 - orchestration retried on recovery
             import traceback
 
@@ -2711,7 +2765,10 @@ class ClusterBroker(Actor):
                 )
             )
             if backlog:
-                server.raft.append(backlog)
+                observe_append(
+                    server.raft.append(backlog),
+                    "job-subscription backlog", partition_id,
+                )
         elif action == "credits":
             server.engine.increase_job_credits(
                 int(msg["subscriber_key"]), int(msg.get("credits", 1))
@@ -2721,7 +2778,10 @@ class ClusterBroker(Actor):
             # immediately; device side via the tick's PROBE_JOB_BACKLOG
             backlog = server.engine.backlog_activations()
             if backlog:
-                server.raft.append(backlog)
+                observe_append(
+                    server.raft.append(backlog),
+                    "returned-credit backlog", partition_id,
+                )
         elif action == "remove":
             self._drop_job_subscription(partition_id, int(msg["subscriber_key"]))
         result.complete(msgpack.pack({"t": "ok"}))
@@ -2852,7 +2912,10 @@ class ClusterBroker(Actor):
                 return
             record.position = -1
             record.timestamp = -1
-            server.raft.append([record])
+            observe_append(
+                server.raft.append([record]),
+                "subscription-cmd record", partition_id,
+            )
             result.complete(msgpack.pack({"t": "ok"}))
 
         self.actor.run(do)
@@ -2877,7 +2940,10 @@ class ClusterBroker(Actor):
                 return
             record.position = -1
             record.timestamp = -1
-            server.raft.append([record])
+            observe_append(
+                server.raft.append([record]),
+                "subscription message record", partition_id,
+            )
 
         self.actor.run(do)
 
@@ -2975,12 +3041,13 @@ class ClusterBroker(Actor):
         stalled = tracer.check_commit_stalls(led)
         if not stalled:
             return
+        count_event(
+            "serving_commit_stalls",
+            "Sampled commands appended but uncommitted past the "
+            "commit-latency watchdog threshold",
+            delta=len(stalled),
+        )
         for span in stalled:
-            count_event(
-                "serving_commit_stalls",
-                "Sampled commands appended but uncommitted past the "
-                "commit-latency watchdog threshold",
-            )
             record_event(
                 "stall", "sampled command commit stall",
                 node=self.node_id, partition=span.partition,

@@ -533,7 +533,7 @@ class MetricsFileWriter(Actor):
         self.registry = registry
         self.path = path
         self.flush_period_ms = flush_period_ms
-        scheduler.submit_actor(self, io_bound=True)
+        scheduler.submit_actor(self, io_bound=True)  # zblint: disable=unobserved-actor-future (boot submit; start failures land in the scheduler failure ring)
 
     def on_actor_started(self) -> None:
         self.actor.run_at_fixed_rate(self.flush_period_ms, self.flush)

@@ -140,7 +140,7 @@ class ServiceContainer(Actor):
         self._registry: Dict[str, _Registration] = {}
         self._group_members: Dict[str, Set[str]] = {}
         self._group_listeners: Dict[str, List] = {}
-        scheduler.submit_actor(self)
+        scheduler.submit_actor(self)  # zblint: disable=unobserved-actor-future (boot submit; start failures land in the scheduler failure ring)
 
     # -- public API --------------------------------------------------------
     def create_service(self, name: str, service: Any) -> ServiceBuilder:

@@ -96,6 +96,11 @@ class AdmissionController:
             "Broker backlog observed by the last admission check "
             "(committed records awaiting the drain + pending responses)",
         )
+        self._probe_failures = g.counter(
+            "gateway_depth_probe_failures",
+            "Queue-depth probe calls that raised (admission fails open "
+            "with depth 0)",
+        )
         # sheds burst at per-command rate under exactly the overload a
         # flight dump wants to explain — rate-limit the ring entries so
         # they cannot evict the control-plane history (counters above
@@ -117,6 +122,7 @@ class AdmissionController:
             try:
                 depth = int(probe())
             except Exception:  # noqa: BLE001 - a probe bug must not shed
+                self._probe_failures.inc()
                 depth = 0
             self._depth_gauge.set(depth)
             if depth >= cfg.queue_depth_high:

@@ -55,6 +55,7 @@ class DevicePlan:
         self._assigned: Dict[int, int] = {}  # partition id → device index
         self._excluded: set = set()
         self._rr = 0  # round-robin tie-break cursor
+        self._device_gauges: Dict[int, object] = {}  # cached metric handles
 
     # -- queries -----------------------------------------------------------
     def healthy_indices(self) -> List[int]:
@@ -170,11 +171,15 @@ class DevicePlan:
     def _publish_load(self) -> None:
         load = self.load()
         for idx, n in load.items():
-            GLOBAL_REGISTRY.gauge(
-                "mesh_device_partitions",
-                "Leader partitions currently placed on each mesh device",
-                device=str(idx),
-            ).set(n)
+            handle = self._device_gauges.get(idx)
+            if handle is None:
+                handle = GLOBAL_REGISTRY.gauge(
+                    "mesh_device_partitions",
+                    "Leader partitions currently placed on each mesh device",
+                    device=str(idx),
+                )
+                self._device_gauges[idx] = handle
+            handle.set(n)
         GLOBAL_REGISTRY.gauge(
             "mesh_devices_healthy",
             "Mesh devices currently accepting partition placements",

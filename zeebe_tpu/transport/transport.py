@@ -623,8 +623,9 @@ class ClientTransport:
                         del self._pending[cid]
                     elif nearest is None or deadline < nearest:
                         nearest = deadline
+            if expired:
+                _count_event("transport_pending_expired", delta=len(expired))
             for _cid, future in expired:
-                _count_event("transport_pending_expired")
                 future.complete_exceptionally(TransportError("request timed out"))
             # pace to the nearest deadline (bounded): a fixed 10ms scan of
             # the pending table burned real CPU on single-core serving
