@@ -1273,6 +1273,47 @@ def run_device_config(build_fn, label, total_instances, wave, progress,
     }
 
 
+def run_config5_sweep(smoke=False, progress=lambda m: None):
+    """Round-8 acid test in one command: config 5 (multi-instance
+    subprocess, cardinality fan-out — the slowest device config, 6x
+    behind the next one pre-fusion) swept across wave sizes under the
+    autotuned fused-gather dispatch. The A/B is one env var:
+
+        python bench.py --config5-sweep              # tuned dispatch
+        ZB_PALLAS=0 python bench.py --config5-sweep  # XLA chain baseline
+
+    ``--smoke`` trims to two small waves (structural, non-timing).
+    Each row records the dispatch the wave ran under, so a sweep where
+    the autotuner sent the gather/emit families back to XLA is legible
+    in the output rather than a silent no-op A/B."""
+    from zeebe_tpu.tpu import autotune, pallas_ops as pops
+
+    autotune.ensure_autotuned(progress)
+    powers = (8, 9) if smoke else (10, 11, 12)
+    rows = []
+    for p in powers:
+        wave = 1 << p
+        total = wave * (3 if smoke else 8)
+        r = run_device_config(
+            build_graph_c5, f"5-multi-instance-w{wave}", total, wave,
+            progress, cap_factor=16,
+        )
+        r["wave_pow"] = p
+        r["dispatch"] = {
+            f: pops.use_pallas(f) for f in ("gather", "emit", "fused")
+        }
+        rows.append(r)
+        progress(
+            f"[config5-sweep] wave {wave}: "
+            f"{r['transitions_per_sec']:.0f} t/s"
+        )
+    return {
+        "config": "5-multi-instance-sweep",
+        "dispatch_source": autotune.dispatch_source(),
+        "sweep": rows,
+    }
+
+
 def run_message_ttl_storm(n_messages=8192, ttl_ms=30_000, batch=512):
     """ROADMAP-item-5 scenario storm 1: message-TTL storm. Publish a burst
     of short-TTL messages with no matching subscriptions, then advance the
@@ -1847,6 +1888,23 @@ def main():
     if "--host-path" in sys.argv:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         result = run_host_path(smoke="--smoke" in sys.argv)
+        print(json.dumps(result, indent=2))
+        return
+
+    if "--config5-sweep" in sys.argv:
+        # round-8 acid test: probe the backend like the kernel bench (a
+        # blanket JAX_PLATFORMS=cpu would silently run the on-chip A/B on
+        # the host), fall back to CPU when no device answers
+        backend, _status, err = _probe_backend(
+            timeout_sec=int(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
+        )
+        if err:
+            _progress(f"device unavailable ({err}); config-5 sweep on CPU")
+        if backend == "cpu":
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        result = run_config5_sweep(
+            smoke="--smoke" in sys.argv, progress=_progress
+        )
         print(json.dumps(result, indent=2))
         return
 

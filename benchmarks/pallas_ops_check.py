@@ -76,6 +76,102 @@ def check_fused_commit(rng, T, B):
         check(f"fused commit {name}", r, g)
 
 
+def check_fused_gather(rng, T, B):
+    """Phase-B/C mega-gather parity: fused_gather_rows (one pallas read
+    pass) vs the XLA concat-gather fallback, over every table normal
+    form the kernel feeds it — 2D i32/i64/f32/i8, 1D i32/i64/f32 — with
+    duplicate indices in the slot vectors (reads commute, so duplicates
+    are legal everywhere, unlike the commit pass)."""
+    K = 16
+    tbl_i32 = jnp.asarray(rng.integers(-(2**31), 2**31, (T, K)), jnp.int32)
+    tbl_i64 = jnp.asarray(
+        rng.integers(-(2**62), 2**62, (T, K), dtype=np.int64)
+    )
+    tbl_f32 = jax.lax.bitcast_convert_type(
+        jnp.asarray(rng.integers(-(2**31), 2**31, (T, K)), jnp.int32),
+        jnp.float32,
+    )
+    tbl_i8 = jnp.asarray(rng.integers(-128, 128, (T, K)), jnp.int8)
+    t1_i32 = jnp.asarray(rng.integers(-(2**31), 2**31, (T,)), jnp.int32)
+    t1_i64 = jnp.asarray(
+        rng.integers(-(2**62), 2**62, (T,), dtype=np.int64)
+    )
+    t1_f32 = jax.lax.bitcast_convert_type(
+        jnp.asarray(rng.integers(-(2**31), 2**31, (T,)), jnp.int32),
+        jnp.float32,
+    )
+    tables = [tbl_i32, tbl_i64, tbl_f32, tbl_i8, t1_i32, t1_i64, t1_f32]
+    # duplicate-heavy slots (rng.choice with replacement) + two ops sharing
+    # one table, mirroring the kernel's ei table read at 3 roles
+    slot_sets = [
+        jnp.asarray(rng.choice(T, B), jnp.int32) for _ in range(9)
+    ]
+    ops = [pops.GatherOp(0, slot_sets[0]), pops.GatherOp(0, slot_sets[1]),
+           pops.GatherOp(1, slot_sets[2]), pops.GatherOp(2, slot_sets[3]),
+           pops.GatherOp(3, slot_sets[4]), pops.GatherOp(4, slot_sets[5]),
+           pops.GatherOp(5, slot_sets[6]), pops.GatherOp(6, slot_sets[7])]
+    with pops.forced("xla"):
+        ref = pops.fused_gather_rows(tables, ops)
+    with pops.forced("pallas"):
+        got = pops.fused_gather_rows(tables, ops)
+    names = ("rows i32 a", "rows i32 b", "rows i64", "rows f32", "rows i8",
+             "lane i32", "lane i64", "lane f32")
+    for name, r, g in zip(names, ref, got):
+        # f32 compares as bits: NaN payloads must round-trip too
+        if r.dtype == jnp.float32:
+            r = jax.lax.bitcast_convert_type(r, jnp.int32)
+            g = jax.lax.bitcast_convert_type(g, jnp.int32)
+        check(f"fused gather {name}", r, g)
+
+    # duplicate-key first-occurrence mask path: slots produced by the
+    # kernel's _first_per_key dedup (duplicate commands on one entity →
+    # only the first masked row reads/commits); downstream consumes the
+    # gathered rows under that mask
+    from zeebe_tpu.tpu.kernel import _first_per_key
+
+    keys = jnp.asarray(rng.choice(16, B).astype(np.int64))
+    mask = jnp.asarray(rng.random(B) < 0.8)
+    first = _first_per_key(keys, mask)
+    slots = jnp.clip(keys.astype(jnp.int32), 0, T - 1)
+    with pops.forced("xla"):
+        (r,) = pops.fused_gather_rows([tbl_i64], [pops.GatherOp(0, slots)])
+    with pops.forced("pallas"):
+        (g,) = pops.fused_gather_rows([tbl_i64], [pops.GatherOp(0, slots)])
+    check("fused gather first-occurrence rows",
+          np.where(np.asarray(first)[:, None], np.asarray(r), -1),
+          np.where(np.asarray(first)[:, None], np.asarray(g), -1))
+
+    # emit-compact packed parity: batch.take_rows routes its two packed
+    # matrices through the "emit" family — pallas vs XLA on the same
+    # argsort permutation must be bit-identical per field
+    from zeebe_tpu.tpu import batch as rb
+    import dataclasses as _dc
+
+    b = rb.empty(B, 4)
+    b = _dc.replace(
+        b,
+        valid=jnp.asarray(rng.random(B) < 0.5),
+        key=jnp.asarray(rng.integers(-(2**62), 2**62, (B,), dtype=np.int64)),
+        elem=jnp.asarray(rng.integers(-(2**31), 2**31, (B,)), jnp.int32),
+        v_num=jax.lax.bitcast_convert_type(
+            jnp.asarray(rng.integers(-(2**31), 2**31, (B, 4)), jnp.int32),
+            jnp.float32,
+        ),
+        v_vt=jnp.asarray(rng.integers(-128, 128, (B, 4)), jnp.int8),
+        resp=jnp.asarray(rng.random(B) < 0.3),
+    )
+    with pops.forced("xla"):
+        ref_b = rb.compact(b)
+    with pops.forced("pallas"):
+        got_b = rb.compact(b)
+    for f in rb._FIELDS:
+        r, g = getattr(ref_b, f), getattr(got_b, f)
+        if r.dtype == jnp.float32:
+            r = jax.lax.bitcast_convert_type(r, jnp.int32)
+            g = jax.lax.bitcast_convert_type(g, jnp.int32)
+        check(f"emit compact {f}", r, g)
+
+
 def main():
     if jax.default_backend() != "tpu":
         # Mosaic is TPU-only: the CPU suite pins the XLA fallbacks (the
@@ -86,6 +182,7 @@ def main():
     rng = np.random.default_rng(7)
     T, B = 1 << 13, 1 << 11
     check_fused_commit(np.random.default_rng(11), T, B)
+    check_fused_gather(np.random.default_rng(13), T, B)
 
     # -- hashmap ops --------------------------------------------------------
     table = hashmap.make(T)

@@ -58,7 +58,63 @@ def op_census(wave_pow: int = 10) -> dict:
         "while_loops": len(re.findall(r"\bwhile\b", text)),
     }
     counts["gather_scatter_total"] = counts["gather"] + counts["scatter"]
+    counts["per_pass"] = _per_pass_attribution(lowered)
     return counts
+
+
+def _per_pass_attribution(lowered) -> dict:
+    """Attribute each lowered gather/scatter OP (not the headline regex
+    count, which also matches gather dimension_numbers attrs) to the
+    kernel's named passes via stablehlo location metadata. The step kernel
+    wraps its fused passes in ``jax.named_scope``: ``zb_lookups`` (indexed
+    lookup probes/verifies), ``zb_gather`` (phase-B mega-gather + boundary
+    scans), ``zb_emit`` (output-queue compaction); everything else lands in
+    ``other``. This makes the census diff in PERF_NOTES mechanical — a
+    regression names the pass that reintroduced the op."""
+    import re
+    from collections import defaultdict
+
+    try:
+        asm = lowered.compiler_ir().operation.get_asm(
+            enable_debug_info=True
+        )
+    except Exception as e:  # noqa: BLE001 - loc metadata is best-effort
+        # (jax API drift, e.g. as_text(debug_info=...) went away in
+        # 0.4.x); headline counts still gate — surface why the split is
+        # missing instead of silently dropping it
+        return {"error": repr(e)[:200]}
+    # #loc14 = loc("jit(f)/jit(main)/zb_gather/gather"(#loc8))
+    loc_paths = dict(
+        re.findall(r'(#loc\d+) = loc\("([^"]*)"', asm)
+    )
+    scopes = ("zb_lookups", "zb_gather", "zb_emit")
+    per = {"gather": defaultdict(int), "scatter": defaultdict(int)}
+
+    def _attr(op: str, locref: str) -> None:
+        path = loc_paths.get(locref, "")
+        scope = next((s for s in scopes if f"/{s}/" in path or
+                      path.endswith(s)), "other")
+        per[op][scope] += 1
+
+    # gathers print on one line ending loc(#locN); scatters carry a region,
+    # so their loc rides the closing "}) : ... loc(#locN)" line
+    pending = None
+    for line in asm.splitlines():
+        m = re.search(
+            r'"stablehlo\.(gather|scatter)".*?(?:loc\((#loc\d+)\))?$', line
+        )
+        if m and m.group(1):
+            if m.group(2):
+                _attr(m.group(1), m.group(2))
+            else:
+                pending = m.group(1)
+            continue
+        if pending:
+            c = re.match(r"\s*\}\).*loc\((#loc\d+)\)", line)
+            if c:
+                _attr(pending, c.group(1))
+                pending = None
+    return {op: dict(d) for op, d in per.items()}
 
 
 def main():

@@ -82,8 +82,9 @@ python benchmarks/pallas_ops_check.py
 echo "== autotune dispatch self-check (skips without a TPU) =="
 python -m zeebe_tpu.tpu.autotune
 
-echo "== on-chip checklist (pending PR 1/4/8/9 validations; skips and"
-echo "   records the skip without a TPU, writes onchip_report.json) =="
+echo "== on-chip checklist (pending PR 1/4/8/9/10 validations incl. the"
+echo "   round-8 mega-gather config-5 sweep; skips and records the skip"
+echo "   without a TPU, writes onchip_report.json) =="
 python tools/onchip_checklist.py --quick
 
 echo "CI GATE GREEN"

@@ -1,0 +1,186 @@
+"""Fused phase-B/C mega-gather + packed emission takes
+(zeebe_tpu/tpu/pallas_ops.fused_gather_rows, zeebe_tpu/tpu/batch.take_rows).
+
+CPU pins the semantics: off-TPU every family resolves to the XLA
+fallbacks, so the fused gather must equal direct indexing exactly — the
+same contract that makes the parity fuzzer meaningful for the TPU path.
+The on-chip pallas-vs-XLA leg lives in benchmarks/pallas_ops_check.py
+(check_fused_gather).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from zeebe_tpu import tpu as _tpu  # noqa: F401  (enables x64)
+from zeebe_tpu.tpu import autotune, batch as rb, pallas_ops as pops
+
+
+def _tables(rng, T, K):
+    i32 = jnp.asarray(rng.integers(-(2**31), 2**31, (T, K)), jnp.int32)
+    i64 = jnp.asarray(rng.integers(-(2**62), 2**62, (T, K), dtype=np.int64))
+    f32 = jax.lax.bitcast_convert_type(
+        jnp.asarray(rng.integers(-(2**31), 2**31, (T, K)), jnp.int32),
+        jnp.float32,
+    )
+    i8 = jnp.asarray(rng.integers(-128, 128, (T, K)), jnp.int8)
+    l32 = jnp.asarray(rng.integers(-(2**31), 2**31, (T,)), jnp.int32)
+    l64 = jnp.asarray(rng.integers(-(2**62), 2**62, (T,), dtype=np.int64))
+    lf32 = jax.lax.bitcast_convert_type(
+        jnp.asarray(rng.integers(-(2**31), 2**31, (T,)), jnp.int32),
+        jnp.float32,
+    )
+    return [i32, i64, f32, i8, l32, l64, lf32]
+
+
+def _bits(a):
+    return (jax.lax.bitcast_convert_type(a, jnp.int32)
+            if a.dtype == jnp.float32 else a)
+
+
+class TestFusedGatherFallback:
+    def test_matches_direct_indexing_all_dtypes(self):
+        """Every table normal form the kernel feeds the pass — 2D
+        i32/i64/f32/i8, 1D i32/i64/f32 — with duplicate-heavy index
+        vectors (reads commute, duplicates are always legal)."""
+        rng = np.random.default_rng(3)
+        T, B = 512, 192
+        tables = _tables(rng, T, 8)
+        ops = [pops.GatherOp(t, jnp.asarray(rng.choice(T, B), jnp.int32))
+               for t in range(len(tables))]
+        got = pops.fused_gather_rows(tables, ops)
+        for o, g in zip(ops, got):
+            want = tables[o.table][o.slots]
+            assert g.dtype == want.dtype
+            assert (np.asarray(_bits(g)) == np.asarray(_bits(want))).all()
+
+    def test_same_table_ops_share_one_gather(self):
+        """Grouping: N reads off one 2D table lower to ONE gather (concat
+        index vectors + static splits) — the census mechanism."""
+        rng = np.random.default_rng(5)
+        T, B = 256, 64
+        tbl = jnp.asarray(rng.integers(0, 100, (T, 8)), jnp.int32)
+        slots = [jnp.asarray(rng.choice(T, B), jnp.int32) for _ in range(3)]
+
+        def f(tbl, s0, s1, s2):
+            return pops.fused_gather_rows(
+                [tbl], [pops.GatherOp(0, s0), pops.GatherOp(0, s1),
+                        pops.GatherOp(0, s2)])
+
+        text = jax.jit(f).lower(tbl, *slots).as_text()
+        assert text.count('"stablehlo.gather"(') == 1
+        got = f(tbl, *slots)
+        for s, g in zip(slots, got):
+            assert (np.asarray(g) == np.asarray(tbl[s])).all()
+
+    def test_1d_tables_group_by_dtype(self):
+        """Two 1D i32 tables fold into one offset-indexed gather."""
+        rng = np.random.default_rng(7)
+        T, B = 256, 64
+        ta = jnp.asarray(rng.integers(0, 100, (T,)), jnp.int32)
+        tb = jnp.asarray(rng.integers(0, 100, (T,)), jnp.int32)
+        sa = jnp.asarray(rng.choice(T, B), jnp.int32)
+        sb = jnp.asarray(rng.choice(T, B), jnp.int32)
+
+        def f(ta, tb, sa, sb):
+            return pops.fused_gather_rows(
+                [ta, tb], [pops.GatherOp(0, sa), pops.GatherOp(1, sb)])
+
+        text = jax.jit(f).lower(ta, tb, sa, sb).as_text()
+        assert text.count('"stablehlo.gather"(') == 1
+        ga, gb = f(ta, tb, sa, sb)
+        assert (np.asarray(ga) == np.asarray(ta[sa])).all()
+        assert (np.asarray(gb) == np.asarray(tb[sb])).all()
+
+    def test_mixed_batch_sizes(self):
+        """Ops with different batch widths (the lookup stages fuse a 3B
+        probe with a B probe) still group correctly in the fallback."""
+        rng = np.random.default_rng(9)
+        T = 128
+        tbl = jnp.asarray(rng.integers(0, 100, (T, 4)), jnp.int32)
+        s_wide = jnp.asarray(rng.choice(T, 96), jnp.int32)
+        s_narrow = jnp.asarray(rng.choice(T, 32), jnp.int32)
+        gw, gn = pops.fused_gather_rows(
+            [tbl], [pops.GatherOp(0, s_wide), pops.GatherOp(0, s_narrow)])
+        assert (np.asarray(gw) == np.asarray(tbl[s_wide])).all()
+        assert (np.asarray(gn) == np.asarray(tbl[s_narrow])).all()
+
+    def test_empty_ops(self):
+        assert pops.fused_gather_rows([jnp.ones((4, 4), jnp.int32)], []) == []
+
+
+class TestTakeRows:
+    def _random_batch(self, rng, B, V):
+        b = rb.empty(B, V)
+        upd = {}
+        for f in rb._FIELDS:
+            a = getattr(b, f)
+            if a.dtype == jnp.float32:
+                upd[f] = jax.lax.bitcast_convert_type(
+                    jnp.asarray(rng.integers(-(2**31), 2**31, a.shape),
+                                jnp.int32), jnp.float32)
+            elif a.dtype == bool:
+                upd[f] = jnp.asarray(rng.integers(0, 2, a.shape), bool)
+            else:
+                info = np.iinfo(np.dtype(str(a.dtype)))
+                upd[f] = jnp.asarray(
+                    rng.integers(info.min, int(info.max) + 1, a.shape,
+                                 dtype=np.int64).astype(str(a.dtype)))
+        return dataclasses.replace(b, **upd)
+
+    def test_bit_identical_to_tree_map(self):
+        """take_rows == per-field a[idx] for every field and dtype,
+        including f32 NaN payload bit patterns."""
+        rng = np.random.default_rng(11)
+        B, V = 96, 4
+        b = self._random_batch(rng, B, V)
+        idx = jnp.asarray(rng.choice(B, B), jnp.int32)
+        got = rb.take_rows(b, idx)
+        want = jax.tree.map(lambda a: a[idx], b)
+        for f in rb._FIELDS:
+            g, w = getattr(got, f), getattr(want, f)
+            assert g.dtype == w.dtype, f
+            assert (np.asarray(_bits(g)) == np.asarray(_bits(w))).all(), f
+
+    def test_take_count(self):
+        """The packed form lowers to exactly TWO gathers (i32 + i8
+        matrices) — the 24→2 consolidation."""
+        b = rb.empty(64, 4)
+        idx = jnp.arange(64, dtype=jnp.int32)
+        text = jax.jit(rb.take_rows).lower(b, idx).as_text()
+        assert text.count('"stablehlo.gather"(') == 2
+
+    def test_compact_prefixes_valid_rows(self):
+        rng = np.random.default_rng(13)
+        b = self._random_batch(rng, 64, 4)
+        out = rb.compact(b)
+        v = np.asarray(out.valid)
+        n = int(v.sum())
+        assert v[:n].all() and not v[n:].any()
+        # stable order: valid rows keep their relative order
+        src = np.asarray(b.key)[np.asarray(b.valid)]
+        assert (np.asarray(out.key)[:n] == src).all()
+
+
+class TestDispatchFamilies:
+    def test_new_families_registered(self):
+        assert "gather" in pops.FAMILIES
+        assert "emit" in pops.FAMILIES
+
+    def test_off_tpu_stays_xla(self):
+        if jax.default_backend() == "tpu":
+            pytest.skip("CPU-only behavior")
+        with pops.forced("pallas"):
+            assert not pops.use_pallas("gather")
+            assert not pops.use_pallas("emit")
+
+    def test_autotune_benches_cover_new_families(self):
+        benches = autotune._benches()
+        assert "gather" in benches and "emit" in benches
+        with pops.forced("xla"):
+            out = jax.jit(benches["gather"])()
+            jax.block_until_ready(out)

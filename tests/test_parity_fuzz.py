@@ -397,3 +397,17 @@ def test_pinned_seeds(seed):
     # these seeds crashed (list-payload demotion, sweep stalls, key
     # collisions) — pinned forever in their original form
     run_case(seed, kinds=SEGMENT_KINDS_V1, force_list_payloads=True)
+
+
+# round-8 mega-pass pin: config-5-shaped models — MI fan-out ONLY
+# (device cardinality MI + collection MI subprocesses), the acid-test
+# shape for the fused phase-B/C gather pass (bench config
+# "5-multi-instance-subprocess"). Fan-out bursts stress exactly the
+# slices the pass absorbed: the 3-role ei row gather, emission-slot
+# assembly, and the packed output compaction.
+CONFIG5_SEEDS = [785858646, 785858653]
+
+
+@pytest.mark.parametrize("seed", CONFIG5_SEEDS)
+def test_pinned_config5_fanout(seed):
+    run_case(seed, kinds=("cardmi", "mi", "cardmi"))

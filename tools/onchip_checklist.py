@@ -1,7 +1,8 @@
 """One entry point for the pending ON-CHIP validations (PERF_NOTES
-rounds 6-9): the per-build autotune A/B, the pallas-vs-XLA parity gate,
-the serving-path bench, the shared-wave scheduler bench, and the mesh
-serving A/B — each queued across PRs 1/4/8/9 for "the next chip session".
+rounds 6-11): the per-build autotune A/B, the pallas-vs-XLA parity gate,
+the serving-path bench, the shared-wave scheduler bench, the mesh
+serving A/B, and the round-8 mega-gather config-5 sweep — each queued
+across PRs 1/4/8/9/10 for "the next chip session".
 Running them through one command that WRITES A REPORT is what keeps the
 checklist from rotting: ci.sh invokes this on every gate, it skips
 cleanly off-TPU, and on a chip session the JSON lands in
@@ -101,6 +102,15 @@ def main() -> int:
          {"ZB_BENCH_ENGINE": "tpu"}),
         # PR 9: mesh serving A/B across the real chips
         ("mesh_bench", [py, "bench.py", "--mesh"] + smoke, 7200),
+        # PR 10 (kernel round 8): the mega-gather/emit families — the
+        # autotune step above already tables their A/B and the
+        # pallas_ops_check step pins their parity; these two legs run the
+        # config-5 acid test fused vs. forced-XLA for the PERF_NOTES row
+        ("config5_sweep_fused",
+         [py, "bench.py", "--config5-sweep"] + smoke, 7200),
+        ("config5_sweep_xla",
+         [py, "bench.py", "--config5-sweep"] + smoke, 7200,
+         {"ZB_PALLAS": "0"}),
     ]
     failed = []
     for entry in steps:

@@ -204,6 +204,32 @@ def _benches() -> Dict[str, Callable[[], object]]:
             table = pops.delete(table, keys + i, active)
         return table.keys
 
+    def gather(t=tbl, r=t1):
+        # representative phase-B shape: several row reads off 2D tables
+        # plus lane reads off a 1D table, chained through the gathered rows
+        s = slots
+        for _ in range(_CHAIN // 2):
+            rows_a, rows_b, lanes = pops.fused_gather_rows(
+                [t, r],
+                [pops.GatherOp(0, s),
+                 pops.GatherOp(0, (s + 1) % _T),
+                 pops.GatherOp(1, s)],
+            )
+            s = (jnp.max(rows_a, axis=1) + jnp.max(rows_b, axis=1)
+                 + lanes) % _T
+        return s
+
+    def emit(t=tbl):
+        # representative phase-C shape: queue compaction — one packed row
+        # take at a data-dependent permutation, chained through the output
+        for _ in range(_CHAIN):
+            order = jnp.argsort(t[:_B, 0], stable=True).astype(jnp.int32)
+            (taken,) = pops.fused_gather_rows(
+                [t], [pops.GatherOp(0, order)], family="emit"
+            )
+            t = t.at[:_B].set(taken + 1)
+        return t
+
     def fused(t=tbl, r=t1):
         # representative phase-E shape: mixed set/add/max rows + a lane
         # write, chained through the output tables
@@ -227,6 +253,8 @@ def _benches() -> Dict[str, Callable[[], object]]:
         "insert": insert,
         "delete": delete,
         "fused": fused,
+        "gather": gather,
+        "emit": emit,
     }
 
 

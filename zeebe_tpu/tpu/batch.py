@@ -225,32 +225,71 @@ def columns_to_payload(
     return doc
 
 
+# row-take packing groups (schema-derived so a new field fails loudly here
+# instead of silently dropping from the packed takes)
+_I32_SCALARS = ["rtype", "vtype", "intent", "elem", "wf", "req_stream",
+                "type_id", "retries", "worker", "src", "rej"]
+_I64_SCALARS = ["key", "instance_key", "scope_key", "req", "aux_key",
+                "aux2_key", "deadline"]
+_I8_SCALARS = ["valid", "resp", "push"]
+assert set(_I32_SCALARS + _I64_SCALARS + _I8_SCALARS
+           + ["v_vt", "v_num", "v_str"]) == set(_FIELDS)
+
+
+def take_rows(batch: RecordBatch, idx: jax.Array) -> RecordBatch:
+    """``batch[idx]`` (row take along axis 0) as TWO packed row gathers
+    instead of one per field: an i32 mega-matrix (i32 scalars + v_str +
+    bitcast v_num + i64 lo/hi planes) and an i8 matrix (bool flags + v_vt).
+    A gather costs per-index issue, not bytes (PERF_NOTES round-4 cost
+    model), so the naive per-field tree.map paid ~24 serial gathers where
+    2 suffice. Bitcast/widen round-trips are exact — the result is
+    bit-identical to ``jax.tree.map(lambda a: a[idx], batch)`` — and the
+    takes route through the "emit" fused-gather family so the pallas
+    mega-pass picks them up on TPU."""
+    from zeebe_tpu.tpu import pallas_ops as pops
+
+    v = batch.num_vars
+    i32_mat = jnp.concatenate(
+        [jnp.stack([getattr(batch, n) for n in _I32_SCALARS], axis=-1),
+         batch.v_str,
+         jax.lax.bitcast_convert_type(batch.v_num, jnp.int32),
+         pops.i64_to_planes(
+             jnp.stack([getattr(batch, n) for n in _I64_SCALARS], axis=-1)
+         )],
+        axis=1,
+    )
+    i8_mat = jnp.concatenate(
+        [jnp.stack([getattr(batch, n).astype(jnp.int8) for n in _I8_SCALARS],
+                   axis=-1),
+         batch.v_vt],
+        axis=1,
+    )
+    t32, t8 = pops.fused_gather_rows(
+        [i32_mat, i8_mat],
+        [pops.GatherOp(0, idx), pops.GatherOp(1, idx)],
+        family="emit",
+    )
+    n32 = len(_I32_SCALARS)
+    i64_mat = pops.planes_to_i64(t32[:, n32 + 2 * v :])
+    out = {n: t32[:, i] for i, n in enumerate(_I32_SCALARS)}
+    out.update({n: i64_mat[:, i] for i, n in enumerate(_I64_SCALARS)})
+    out.update(
+        valid=t8[:, 0].astype(bool),
+        resp=t8[:, 1].astype(bool),
+        push=t8[:, 2].astype(bool),
+        v_vt=t8[:, 3:],
+        v_str=t32[:, n32 : n32 + v],
+        v_num=jax.lax.bitcast_convert_type(
+            t32[:, n32 + v : n32 + 2 * v], jnp.float32
+        ),
+    )
+    return RecordBatch(**out)
+
+
 def compact(batch: RecordBatch) -> RecordBatch:
     """Stable-reorder a batch so valid rows form a contiguous prefix
     (drive.enqueue's precondition). Used for batches whose valid rows are
     interleaved — e.g. the all_to_all exchange output, which groups rows by
-    source shard.
-
-    Scalar fields gather as ONE packed row take per dtype family instead
-    of one [B] gather per field: a gather costs per-index issue, not bytes
-    (PERF_NOTES round-4 cost model), so the naive tree.map paid ~24 serial
-    gather ops where 6 suffice."""
+    source shard. The reorder is ``take_rows``' two packed gathers."""
     order = jnp.argsort(~batch.valid, stable=True)
-    out = {}
-    for n in ("v_vt", "v_num", "v_str"):  # already whole-row gathers
-        out[n] = jnp.take(getattr(batch, n), order, axis=0)
-    # group the scalar fields by dtype from the schema itself (bool packs
-    # as i8), so a new RecordBatch field joins a packed take automatically
-    groups: Dict[Any, list] = {}
-    for f in _FIELDS:
-        if f not in out:
-            groups.setdefault(jnp.dtype(getattr(batch, f).dtype), []).append(f)
-    for dtype, names in groups.items():
-        pack = jnp.int8 if dtype == jnp.dtype(bool) else dtype
-        stacked = jnp.stack(
-            [getattr(batch, n).astype(pack) for n in names], axis=-1
-        )
-        taken = jnp.take(stacked, order, axis=0)
-        for i, n in enumerate(names):
-            out[n] = taken[:, i].astype(dtype)
-    return RecordBatch(**out)
+    return take_rows(batch, order)

@@ -160,30 +160,73 @@ def _first_per_key(keys, mask):
     return jnp.zeros((b,), bool).at[idx_sorted].set(first_sorted)
 
 
-def _indexed_lookup(index, key_col, fallback_map, keys, want, cap):
-    """key → (found, slot) via the direct-mapped index with hashmap
-    fallback; both paths verify against the table's own key column, so
-    stale index/map entries (deleted rows, reused slots) resolve to
-    not-found without any per-round index maintenance."""
-    icap = index.shape[0]
+def _indexed_lookup_multi(lookups):
+    """N parallel key → (found, slot) resolutions via the direct-mapped
+    indexes with hashmap fallback; both paths verify against the table's
+    own key column, so stale index/map entries (deleted rows, reused
+    slots) resolve to not-found without any per-round index maintenance.
+
+    Each lookup is ``(index, key_col, fallback_map, keys, want, cap)``;
+    returns ``[(found, slot), ...]`` in input order. The index probes and
+    the two key-column verifies run through ``pops.fused_gather_rows``,
+    so the N lookups share one gather per stage (the indexes are all i32,
+    the key columns all i64 — each stage's tables concatenate) instead of
+    issuing 3 gathers apiece."""
     # keys are stride-5 (keyspace: one residue class per entity family),
     # so indexing on key // 5 packs them densely — the collision-free
     # window is icap * 5 consecutive keys, not icap (a parallel-split /
     # multi-instance wave can allocate hundreds of thousands of keys;
     # indexing on the raw key wrapped the window within ONE wave and
     # silently dropped ~4% of fork-join completions at bench scale)
-    cand = index[((keys // 5) & (icap - 1)).astype(jnp.int32)]
-    cand_clip = jnp.clip(cand, 0, cap - 1)
-    hit = want & (cand >= 0) & (key_col[cand_clip] == keys)
-    miss = want & ~hit
+    cands = pops.fused_gather_rows(
+        [index for index, *_ in lookups],
+        [
+            pops.GatherOp(
+                i, ((keys // 5) & (index.shape[0] - 1)).astype(jnp.int32)
+            )
+            for i, (index, _kc, _fb, keys, _w, _c) in enumerate(lookups)
+        ],
+    )
+    cand_clips = [
+        jnp.clip(cand, 0, lk[5] - 1) for cand, lk in zip(cands, lookups)
+    ]
+    key_cols = [kc for _i, kc, *_ in lookups]
+    kc_hit = pops.fused_gather_rows(
+        key_cols, [pops.GatherOp(i, cc) for i, cc in enumerate(cand_clips)]
+    )
+    hits = [
+        lk[4] & (cand >= 0) & (kc == lk[3])
+        for lk, cand, kc in zip(lookups, cands, kc_hit)
+    ]
+    misses = [lk[4] & ~hit for lk, hit in zip(lookups, hits)]
     # fallback probe for clobbered index entries and genuinely absent
     # keys; with no misses the probe's while_loop exits after its first
     # condition check (cheaper than a lax.cond, whose operand copies cost
     # more than the empty loop — measured)
-    fb_found, fb_slot = pops.lookup(fallback_map, keys, miss)
-    fb_clip = jnp.clip(fb_slot, 0, cap - 1)
-    fb_ok = miss & fb_found & (key_col[fb_clip] == keys)
-    return hit | fb_ok, jnp.where(hit, cand_clip, fb_clip)
+    fbs = [
+        pops.lookup(lk[2], lk[3], miss) for lk, miss in zip(lookups, misses)
+    ]
+    fb_clips = [
+        jnp.clip(fb_slot, 0, lk[5] - 1)
+        for (_f, fb_slot), lk in zip(fbs, lookups)
+    ]
+    kc_fb = pops.fused_gather_rows(
+        key_cols, [pops.GatherOp(i, fc) for i, fc in enumerate(fb_clips)]
+    )
+    out = []
+    for lk, hit, miss, (fb_found, _s), fb_clip, kc, cand_clip in zip(
+        lookups, hits, misses, fbs, fb_clips, kc_fb, cand_clips
+    ):
+        fb_ok = miss & fb_found & (kc == lk[3])
+        out.append((hit | fb_ok, jnp.where(hit, cand_clip, fb_clip)))
+    return out
+
+
+def _indexed_lookup(index, key_col, fallback_map, keys, want, cap):
+    """Single-lookup form of ``_indexed_lookup_multi`` (tests, tools)."""
+    return _indexed_lookup_multi(
+        [(index, key_col, fallback_map, keys, want, cap)]
+    )[0]
 
 
 def _apply_mappings(graph, wf, elem, src_vt, src_num, src_sid, is_input):
@@ -296,16 +339,15 @@ def step_kernel(
         [wi_ev, wi_ev & (batch.scope_key >= 0),
          job_ev | timer_cmd | wisub_corr]
     )
-    ei3_found, ei3_slot = _indexed_lookup(
-        state.ei_index, state.ei_key, state.ei_map, keys3, want3, n_cap
-    )
+    with jax.named_scope("zb_lookups"):
+        (ei3_found, ei3_slot), (jb_found, jb_slot) = _indexed_lookup_multi([
+            (state.ei_index, state.ei_key, state.ei_map, keys3, want3, n_cap),
+            (state.job_index, state.job_key, state.job_map,
+             batch.key, job_cmd & (batch.key >= 0), m_cap),
+        ])
     ei_found, ei_slot = ei3_found[:b], ei3_slot[:b]
     sc_found, sc_slot = ei3_found[b : 2 * b], ei3_slot[b : 2 * b]
     aik_found, aik_slot = ei3_found[2 * b :], ei3_slot[2 * b :]
-    jb_found, jb_slot = _indexed_lookup(
-        state.job_index, state.job_key, state.job_map,
-        batch.key, job_cmd & (batch.key >= 0), m_cap,
-    )
     if graph.has_timers:
         tm_found, tm_slot = pops.lookup(
             state.timer_map, batch.key, timer_cmd & (batch.key >= 0)
@@ -337,20 +379,67 @@ def step_kernel(
     jb_clip = jnp.clip(jb_slot, 0, m_cap - 1)
     tm_clip = jnp.clip(tm_slot, 0, t_cap - 1)
 
-    # ONE row gather per slot vector feeds every phase-B/C column read —
-    # a [B, 6] row gather costs the same as a [B] column gather (the cost
-    # is per-index issue, not bytes), and phases read 2-3 columns per role.
-    # The same applies to the i64 planes (aik instance keys) and the job
-    # table: every per-role read below slices these gathered rows instead
-    # of issuing its own [B] column gather.
-    ei_rows = state.ei_i32[ei_clip]
-    sc_rows = state.ei_i32[sc_clip]
-    aik_rows = state.ei_i32[aik_clip]
-    aik_i64_rows = state.ei_i64[aik_clip]
-    jb_i32_rows = state.job_i32[jb_clip]
-    jb_i64_rows = state.job_i64[jb_clip]
+    # ONE fused gather pass feeds every phase-B/C read: each role's rows
+    # (element-instance i32/i64, payload, job, timer columns, message
+    # store) are pulled once per wave through pops.fused_gather_rows — on
+    # the pallas path a single serial launch with the tables VMEM-resident,
+    # on the XLA path one concatenated gather per table (a [B, 6] row
+    # gather costs the same as a [B] column gather: the cost is per-index
+    # issue, not bytes). Every per-role read below slices these gathered
+    # rows instead of issuing its own gather.
+    with jax.named_scope("zb_gather"):
+        g_tables = [
+            state.ei_i32, state.ei_i64, state.ei_pay,
+            state.job_i32, state.job_i64, state.job_pay,
+            state.timer_elem, state.timer_wf,
+        ]
+        g_ops = [
+            pops.GatherOp(0, ei_clip), pops.GatherOp(0, sc_clip),
+            pops.GatherOp(0, aik_clip),
+            pops.GatherOp(1, aik_clip), pops.GatherOp(1, ei_clip),
+            pops.GatherOp(2, sc_clip), pops.GatherOp(2, aik_clip),
+            pops.GatherOp(2, ei_clip),
+            pops.GatherOp(3, jb_clip), pops.GatherOp(4, jb_clip),
+            pops.GatherOp(5, jb_clip),
+            pops.GatherOp(6, tm_clip), pops.GatherOp(7, tm_clip),
+        ]
+        if graph.has_messages:
+            gm = len(g_tables)
+            g_tables += [
+                state.msg_i32, state.msg_key, state.msg_pay,
+                state.msub_i32, state.msub_i64,
+            ]
+            g_ops += [
+                pops.GatherOp(gm, mmsg_clip),
+                pops.GatherOp(gm + 1, mmsg_clip),
+                pops.GatherOp(gm + 2, mmsg_clip),
+                pops.GatherOp(gm + 3, msub_clip),
+                pops.GatherOp(gm + 4, msub_clip),
+            ]
+        g = pops.fused_gather_rows(g_tables, g_ops)
+    (ei_rows, sc_rows, aik_rows, aik_i64_rows, ei_i64_rows,
+     sc_pay_rows, aik_pay_rows, ei_pay_rows,
+     jb_i32_rows, jb_i64_rows, jb_pay_rows,
+     tm_elem_rows, tm_wf_rows) = g[:13]
+    if graph.has_messages:
+        (mmsg_i32_rows, mmsg_key_rows, mmsg_pay_rows,
+         msub_i32_rows, msub_i64_rows) = g[13:]
     inst_state = jnp.where(ei_found, ei_rows[:, EI_STATE], -1)
     scope_state = jnp.where(sc_found, sc_rows[:, EI_STATE], -1)
+
+    # second-level reads: scope-of-scope keys resolve through slots that
+    # only exist after the first gather pass lands (a row's parent slot is
+    # a COLUMN of its gathered row) — one more fused pass, one gather
+    scope_parent = jnp.where(sc_found, sc_rows[:, EI_SCOPE], -1)
+    inst_scope_slot = aik_rows[:, EI_SCOPE]
+    with jax.named_scope("zb_gather"):
+        sp_key_g, is_key_g = pops.fused_gather_rows(
+            [state.ei_key],
+            [pops.GatherOp(0, jnp.clip(scope_parent, 0, n_cap - 1)),
+             pops.GatherOp(0, jnp.clip(inst_scope_slot, 0, n_cap - 1))],
+        )
+    scope_parent_key = jnp.where(scope_parent >= 0, sp_key_g, -1)
+    inst_scope_key = jnp.where(inst_scope_slot >= 0, is_key_g, -1)
 
     # ---------------- B. routing + guards ----------------
     m_create = wi_cmd & (it == int(WI.CREATE)) & (batch.wf >= 0)
@@ -489,8 +578,8 @@ def step_kernel(
     # and continue at the boundary when ELEMENT_TERMINATED processes
     # the trigger's handler element comes from the TIMER TABLE (a
     # host-staged TRIGGER command does not carry element columns)
-    trig_elem = jnp.where(tm_found, state.timer_elem[tm_clip], batch.elem)
-    trig_wf = jnp.where(tm_found, state.timer_wf[tm_clip], 0)
+    trig_elem = jnp.where(tm_found, tm_elem_rows, batch.elem)
+    trig_wf = jnp.where(tm_found, tm_wf_rows, 0)
     if graph.has_boundaries:
         trig_elem_c = jnp.clip(trig_elem, 0, graph.elem_type.shape[1] - 1)
         trig_wf_c = jnp.clip(trig_wf, 0, graph.elem_type.shape[0] - 1)
@@ -538,7 +627,7 @@ def step_kernel(
         msgid = batch.aux2_key.astype(jnp.int32)  # interned message id, 0 none
         pub_dup = (
             msg_pub & mmsg_found & (msgid > 0)
-            & (state.msg_i32[mmsg_clip, MG_MSGID] == msgid)
+            & (mmsg_i32_rows[:, MG_MSGID] == msgid)
         )
         # one live slot per composite (the device store is hashmap-keyed):
         # a second TTL-store or OPEN on an occupied composite REJECTS that
@@ -554,10 +643,10 @@ def step_kernel(
         open_corr = open_ok & mmsg_found
         close_ok = (
             ms_close & msub_found
-            & (state.msub_i64[msub_clip, MSL_AIK] == batch.aux_key)
-            & (state.msub_i64[msub_clip, MSL_WIKEY] == batch.instance_key)
+            & (msub_i64_rows[:, MSL_AIK] == batch.aux_key)
+            & (msub_i64_rows[:, MSL_WIKEY] == batch.instance_key)
         )
-        del_ok = msg_del & mmsg_found & (state.msg_key[mmsg_clip] == batch.key)
+        del_ok = msg_del & mmsg_found & (mmsg_key_rows == batch.key)
         corr_live = wisub_corr & aik_found & (
             jnp.where(aik_found, aik_rows[:, EI_STATE], -1)
             == int(WI.ELEMENT_ACTIVATED)
@@ -687,7 +776,7 @@ def step_kernel(
         inmap_err = jnp.zeros((b,), bool)
 
     # output mapping: merge(record payload → scope payload)
-    scope_vt, scope_sid, scope_num = unpack_payload(state.ei_pay[sc_clip])
+    scope_vt, scope_sid, scope_num = unpack_payload(sc_pay_rows)
     scope_vt = scope_vt.astype(jnp.int8)
     no_scope = ~sc_found
     scope_vt = jnp.where(no_scope[:, None], VT_ABSENT, scope_vt)
@@ -919,10 +1008,7 @@ def step_kernel(
     pid_col = jnp.broadcast_to(jnp.asarray(partition_id, jnp.int32), (b,))
 
     # --- slot 0: workflow-instance emissions
-    scope_parent = jnp.where(sc_found, sc_rows[:, EI_SCOPE], -1)
-    scope_parent_key = jnp.where(
-        scope_parent >= 0, state.ei_key[jnp.clip(scope_parent, 0, n_cap - 1)], -1
-    )
+    # (scope_parent / scope_parent_key resolved in the phase-A fused pass)
     scope_elem = jnp.where(sc_found, sc_rows[:, EI_ELEM], -1)
 
     e0 = put(
@@ -967,7 +1053,7 @@ def step_kernel(
             consume_completer
             & (graph.mi_cardinality[sc_wf_c, sc_elem_c] > 0)
         )
-        sc_vt, sc_sid, sc_num = unpack_payload(state.ei_pay[sc_clip])
+        sc_vt, sc_sid, sc_num = unpack_payload(sc_pay_rows)
         e0["v_vt"] = jnp.where(
             mi_completer[:, None], sc_vt.astype(jnp.int8), e0["v_vt"]
         )
@@ -1101,7 +1187,7 @@ def step_kernel(
         req=batch.req, req_stream=batch.req_stream, resp=batch.req >= 0,
     )
     payload_nonempty = jnp.any(batch.v_vt != VT_ABSENT, axis=1)
-    jb_vt, jb_sid, jb_num = unpack_payload(state.job_pay[jb_clip])
+    jb_vt, jb_sid, jb_num = unpack_payload(jb_pay_rows)
     jb_vt = jb_vt.astype(jnp.int8)
     fail_vt = jnp.where(payload_nonempty[:, None], batch.v_vt, jb_vt)
     fail_num = jnp.where(payload_nonempty[:, None], batch.v_num, jb_num)
@@ -1168,17 +1254,12 @@ def step_kernel(
 
     # --- slot 0: job events → workflow / activation / incident
     wi_of_inst_vt, wi_of_inst_sid, wi_of_inst_num = unpack_payload(
-        state.ei_pay[aik_clip]
+        aik_pay_rows
     )
     wi_of_inst_vt = wi_of_inst_vt.astype(jnp.int8)
     inst_elem = aik_rows[:, EI_ELEM]
     inst_wf = aik_rows[:, EI_WF]
-    inst_scope_slot = aik_rows[:, EI_SCOPE]
-    inst_scope_key = jnp.where(
-        inst_scope_slot >= 0,
-        state.ei_key[jnp.clip(inst_scope_slot, 0, n_cap - 1)],
-        -1,
-    )
+    # (inst_scope_slot / inst_scope_key resolved in the phase-A fused pass)
     e0 = put(
         e0, jev_completed,
         valid=True, rtype=RT_EVENT, vtype=VT_WI,
@@ -1307,9 +1388,9 @@ def step_kernel(
             e2, pub_corr,
             valid=True, rtype=RT_CMD, vtype=VT_WISUB, intent=int(WS.CORRELATE),
             key=jnp.int64(-1),
-            wf=state.msub_i32[msub_clip, MS_PART],
-            instance_key=state.msub_i64[msub_clip, MSL_WIKEY],
-            aux_key=state.msub_i64[msub_clip, MSL_AIK],
+            wf=msub_i32_rows[:, MS_PART],
+            instance_key=msub_i64_rows[:, MSL_WIKEY],
+            aux_key=msub_i64_rows[:, MSL_AIK],
             type_id=batch.type_id, retries=batch.retries, worker=batch.worker,
             aux2_key=pid_col.astype(jnp.int64),  # message partition id
         )
@@ -1321,9 +1402,7 @@ def step_kernel(
             worker=batch.worker, instance_key=batch.instance_key,
             aux_key=batch.aux_key,
         )
-        stored_vt, stored_sid, stored_num = unpack_payload(
-            state.msg_pay[mmsg_clip]
-        )
+        stored_vt, stored_sid, stored_num = unpack_payload(mmsg_pay_rows)
         e1 = put(
             e1, open_corr,
             valid=True, rtype=RT_CMD, vtype=VT_WISUB, intent=int(WS.CORRELATE),
@@ -1474,12 +1553,19 @@ def step_kernel(
             ).astype(jnp.int32)
             c_found = c_idx < t_cap
             c_clipd = jnp.clip(c_idx, 0, t_cap - 1)
+            with jax.named_scope("zb_gather"):
+                c_key, c_due, c_ik, c_elem = pops.fused_gather_rows(
+                    [state.timer_key, state.timer_due,
+                     state.timer_instance_key, state.timer_elem],
+                    [pops.GatherOp(0, c_clipd), pops.GatherOp(1, c_clipd),
+                     pops.GatherOp(2, c_clipd), pops.GatherOp(3, c_clipd)],
+                )
             es = put(
                 es, c_found,
                 valid=True, rtype=RT_CMD, vtype=VT_TIMER, intent=int(TI.CANCEL),
-                key=state.timer_key[c_clipd], elem=state.timer_elem[c_clipd],
-                aux_key=batch.key, deadline=state.timer_due[c_clipd],
-                instance_key=state.timer_instance_key[c_clipd],
+                key=c_key, elem=c_elem,
+                aux_key=batch.key, deadline=c_due,
+                instance_key=c_ik,
             )
             cancel_mask = cancel_mask & (t_iota[None, :] != c_clipd[:, None])
             # disarm: message-boundary subscription closes (sends)
@@ -1543,7 +1629,7 @@ def step_kernel(
                 wf=pid_col,
             )
         # TERMINATE_JOB_TASK: cancel the instance's job, then TERMINATED
-        job_key_inst = jnp.where(ei_found, state.ei_job_key[ei_clip], -1)
+        job_key_inst = jnp.where(ei_found, ei_i64_rows[:, EIL_JOB_KEY], -1)
         tj_found, tj_slot = pops.lookup(
             state.job_map, job_key_inst, m_term_job & (job_key_inst > 0)
         )
@@ -1581,13 +1667,20 @@ def step_kernel(
             ).astype(jnp.int32)
             tc_found = tc_idx < t_cap
             tc_clipd = jnp.clip(tc_idx, 0, t_cap - 1)
+            with jax.named_scope("zb_gather"):
+                tc_key, tc_due, tc_ik, tc_elem = pops.fused_gather_rows(
+                    [state.timer_key, state.timer_due,
+                     state.timer_instance_key, state.timer_elem],
+                    [pops.GatherOp(0, tc_clipd), pops.GatherOp(1, tc_clipd),
+                     pops.GatherOp(2, tc_clipd), pops.GatherOp(3, tc_clipd)],
+                )
             es3 = eslot(2 * bdw + 1 + t)
             es3 = put(
                 es3, tc_found,
                 valid=True, rtype=RT_CMD, vtype=VT_TIMER, intent=int(TI.CANCEL),
-                key=state.timer_key[tc_clipd], elem=state.timer_elem[tc_clipd],
-                aux_key=batch.key, deadline=state.timer_due[tc_clipd],
-                instance_key=state.timer_instance_key[tc_clipd],
+                key=tc_key, elem=tc_elem,
+                aux_key=batch.key, deadline=tc_due,
+                instance_key=tc_ik,
             )
             tc_mask = tc_mask & (t_iota[None, :] != tc_clipd[:, None])
 
@@ -1604,7 +1697,7 @@ def step_kernel(
         )
         # ELEMENT_TERMINATED with a pending boundary: the token continues
         # at the boundary event with the stored trigger payload
-        cont_vt, cont_sid, cont_num = unpack_payload(state.ei_pay[ei_clip])
+        cont_vt, cont_sid, cont_num = unpack_payload(ei_pay_rows)
         e0 = put(
             e0, m_bd_continue,
             valid=True, rtype=RT_EVENT, vtype=VT_WI,
@@ -2241,31 +2334,50 @@ def step_kernel(
 
     idx = jnp.clip(take_idx, 0, be - 1)
 
-    def compact(a):
-        flat = a.reshape((be,) + a.shape[2:])
-        return jnp.take(flat, idx, axis=0)
+    # the compaction packs the whole emission record into TWO row gathers
+    # (an i32 mega-matrix: scalars + v_str + bitcast v_num + i64 planes;
+    # an i8 matrix: flags + v_vt) routed through the "emit" fused-gather
+    # family — the per-dtype-group takes before this dominated the
+    # emission tail at ~20ns/record of per-index issue apiece. The
+    # bitcast/widen round-trips are exact, so the packed take is
+    # bit-identical to per-field takes.
+    i32_names = ["rtype", "vtype", "intent", "elem", "wf", "req_stream",
+                 "type_id", "retries", "worker", "src", "rej"]
+    i64_names = ["key", "instance_key", "scope_key", "req", "aux_key",
+                 "aux2_key", "deadline"]
 
-    def compact_packed(names, dtype):
-        """One row gather for a group of same-dtype scalar fields instead
-        of one gather fusion per field (the compaction dominated the
-        emission tail as ~20 separate ~1ms gathers)."""
-        stacked = jnp.stack(
-            [em[n].reshape(be).astype(dtype) for n in names], axis=-1
+    def _flat(n):
+        return em[n].reshape((be,) + em[n].shape[2:])
+
+    with jax.named_scope("zb_emit"):
+        i32_mat = jnp.concatenate(
+            [jnp.stack([_flat(n).astype(jnp.int32) for n in i32_names],
+                       axis=-1),
+             _flat("v_str"),
+             jax.lax.bitcast_convert_type(_flat("v_num"), jnp.int32),
+             pops.i64_to_planes(
+                 jnp.stack([_flat(n) for n in i64_names], axis=-1)
+             )],
+            axis=1,
         )
-        taken = jnp.take(stacked, idx, axis=0)
-        return {n: taken[:, i] for i, n in enumerate(names)}
-
-    i32 = compact_packed(
-        ["rtype", "vtype", "intent", "elem", "wf", "req_stream",
-         "type_id", "retries", "worker", "src", "rej"],
-        jnp.int32,
+        i8_mat = jnp.concatenate(
+            [jnp.stack([_flat("resp").astype(jnp.int8),
+                        _flat("push").astype(jnp.int8)], axis=-1),
+             _flat("v_vt")],
+            axis=1,
+        )
+        taken_i32, taken_i8 = pops.fused_gather_rows(
+            [i32_mat, i8_mat],
+            [pops.GatherOp(0, idx), pops.GatherOp(1, idx)],
+            family="emit",
+        )
+    n32 = len(i32_names)
+    i32 = {n: taken_i32[:, i] for i, n in enumerate(i32_names)}
+    i64_mat = pops.planes_to_i64(
+        taken_i32[:, n32 + 2 * v : n32 + 2 * v + 2 * len(i64_names)]
     )
-    i64 = compact_packed(
-        ["key", "instance_key", "scope_key", "req", "aux_key", "aux2_key",
-         "deadline"],
-        jnp.int64,
-    )
-    flags = compact_packed(["resp", "push"], jnp.int8)
+    i64 = {n: i64_mat[:, i] for i, n in enumerate(i64_names)}
+    flags = {"resp": taken_i8[:, 0], "push": taken_i8[:, 1]}
 
     out = RecordBatch(
         valid=jnp.arange(be, dtype=jnp.int32) < count,
@@ -2277,9 +2389,11 @@ def step_kernel(
         wf=i32["wf"],
         instance_key=i64["instance_key"],
         scope_key=i64["scope_key"],
-        v_vt=compact(em["v_vt"]),
-        v_num=compact(em["v_num"]),
-        v_str=compact(em["v_str"]),
+        v_vt=taken_i8[:, 2:],
+        v_num=jax.lax.bitcast_convert_type(
+            taken_i32[:, n32 + v : n32 + 2 * v], jnp.float32
+        ),
+        v_str=taken_i32[:, n32 : n32 + v],
         req=i64["req"],
         req_stream=i32["req_stream"],
         aux_key=i64["aux_key"],

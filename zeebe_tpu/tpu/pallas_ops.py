@@ -63,7 +63,7 @@ _CHUNK = 2048  # records per grid step; scalars per chunk must fit SMEM
 
 FAMILIES = (
     "row_update", "row_max", "row_add", "lane", "vec64",
-    "lookup", "insert", "delete", "fused",
+    "lookup", "insert", "delete", "fused", "gather", "emit",
 )
 
 # family -> use pallas?  Written once by autotune.set_dispatch; until then
@@ -691,6 +691,243 @@ def fused_table_commit(
         o.reshape(tables[j].shape) if is1d[j] else o
         for j, o in enumerate(out)
     ]
+
+
+# ---------------------------------------------------------------------------
+# fused phase-B/C mega-gather
+# ---------------------------------------------------------------------------
+#
+# The read side of the round mirrors the write side: phases B/C open with
+# one row gather per (role, table) pair — element-instance rows for the
+# record/scope/activity keys, job rows, timer columns, payload rows — and
+# each XLA gather costs the same ~20ns/record per-index DMA issue as the
+# scatters fused_table_commit absorbed. ``fused_gather_rows`` collapses
+# every read of a wave into ONE pallas launch: the tables sit in VMEM, a
+# serial loop copies each requested row into a register-composed output
+# block, and the per-record cost of the whole read tail is one row copy.
+#
+# The XLA fallback is where the op-census win lives: reads commute, so
+# gathers against the SAME table concatenate their index vectors (one
+# gather + static splits replaces N gathers, elementwise-identical), and
+# 1D tables of one dtype concatenate along axis 0 with per-table index
+# offsets. The fallback is pure data movement — no masking, no RMW — so
+# fused-vs-unfused results are bit-identical by construction.
+
+
+@dataclasses.dataclass
+class GatherOp:
+    """One row (2D table) or lane (1D table) read inside a fused gather.
+
+    ``table`` indexes into the pass's table list; ``slots`` [B] i32 must
+    already be clipped into range (the step kernel clips every slot
+    vector once, right after the lookups).
+    """
+
+    table: int
+    slots: jax.Array
+
+
+def _gather_unfused(
+    tables: Sequence[jax.Array], ops: Sequence[GatherOp]
+) -> List[jax.Array]:
+    """XLA gather chain with per-table index concatenation: one gather per
+    2D table touched, one per 1D-table dtype group."""
+    results: List[Optional[jax.Array]] = [None] * len(ops)
+    by_table: dict = {}
+    for i, op in enumerate(ops):
+        by_table.setdefault(op.table, []).append(i)
+    oned: List[int] = []
+    for t_idx, op_ids in by_table.items():
+        tbl = tables[t_idx]
+        if tbl.ndim == 1:
+            oned.extend(op_ids)
+            continue
+        if len(op_ids) == 1:
+            i = op_ids[0]
+            results[i] = tbl[ops[i].slots]
+            continue
+        cat = jnp.concatenate([ops[i].slots for i in op_ids])
+        rows = tbl[cat]
+        off = 0
+        for i in op_ids:
+            n = ops[i].slots.shape[0]
+            results[i] = rows[off : off + n]
+            off += n
+    by_dtype: dict = {}
+    for i in oned:
+        by_dtype.setdefault(tables[ops[i].table].dtype, []).append(i)
+    for op_ids in by_dtype.values():
+        if len(op_ids) == 1:
+            i = op_ids[0]
+            results[i] = tables[ops[i].table][ops[i].slots]
+            continue
+        tbl_ids: List[int] = []
+        for i in op_ids:
+            if ops[i].table not in tbl_ids:
+                tbl_ids.append(ops[i].table)
+        offs = {}
+        off = 0
+        for t in tbl_ids:
+            offs[t] = off
+            off += tables[t].shape[0]
+        cat_tbl = (
+            tables[tbl_ids[0]] if len(tbl_ids) == 1
+            else jnp.concatenate([tables[t] for t in tbl_ids])
+        )
+        cat_idx = jnp.concatenate(
+            [ops[i].slots + offs[ops[i].table] for i in op_ids]
+        )
+        vals = cat_tbl[cat_idx]
+        off = 0
+        for i in op_ids:
+            n = ops[i].slots.shape[0]
+            results[i] = vals[off : off + n]
+            off += n
+    return results  # type: ignore[return-value]
+
+
+def fused_gather_rows(
+    tables: Sequence[jax.Array],
+    ops: Sequence[GatherOp],
+    family: str = "gather",
+    vmem_mb: int = 110,
+) -> List[jax.Array]:
+    """``[tables[op.table][op.slots] for op in ops]`` as ONE pallas serial
+    pass — or, off the pallas path, as one concatenated XLA gather per
+    table group. Tables may be i32/i64/f32/i8/bool, 1D or 2D; i64 crosses
+    the pallas boundary as (lo, hi) i32 planes, f32 as a bitcast, i8/bool
+    widened to i32 — all exact round-trips. Every result is elementwise
+    equal to direct indexing on both paths.
+
+    ``family`` selects the dispatch row ("gather" for the phase-B/C state
+    reads, "emit" for the output-queue compaction takes) so the autotuner
+    can pick per-shape winners.
+    """
+    ops = list(ops)
+    if not ops:
+        return []
+    b = ops[0].slots.shape[0]
+    fusable = (
+        use_pallas(family)
+        and all(op.slots.shape[0] == b for op in ops)
+        and all(t.ndim in (1, 2) for t in tables)
+        and all(t.shape[0] % LANES == 0 for t in tables if t.ndim == 1)
+        # every table must be VMEM-resident for the whole pass
+        and sum(t.size * 4 for t in tables) <= vmem_mb * 1024 * 1024 * 3 // 4
+    )
+    if not fusable:
+        return _gather_unfused(tables, ops)
+
+    c = _chunk(b)
+    ntab = len(tables)
+    n_ops = len(ops)
+
+    # normalize every table to i32 — 2D stays [T, K'] (i64 → planes, f32 →
+    # bitcast, i8 → widened), 1D folds to [T/128, 128] for lane extraction
+    # except 1D i64, which becomes a [T, 2] plane-row table
+    norm: List[jax.Array] = []
+    decode: List[Tuple[str, object]] = []  # per-table (mode, dtype)
+    for t in tables:
+        if t.ndim == 2:
+            if t.dtype == jnp.int64:
+                norm.append(i64_to_planes(t))
+                decode.append(("planes", t.dtype))
+            elif t.dtype == jnp.float32:
+                norm.append(lax.bitcast_convert_type(t, jnp.int32))
+                decode.append(("bitcast", t.dtype))
+            elif t.dtype == jnp.int32:
+                norm.append(t)
+                decode.append(("rows", t.dtype))
+            else:
+                norm.append(t.astype(jnp.int32))
+                decode.append(("widen", t.dtype))
+        else:
+            if t.dtype == jnp.int64:
+                norm.append(i64_to_planes(t[:, None]))
+                decode.append(("planes1d", t.dtype))
+            elif t.dtype == jnp.float32:
+                norm.append(
+                    lax.bitcast_convert_type(t, jnp.int32).reshape(
+                        t.shape[0] // LANES, LANES
+                    )
+                )
+                decode.append(("lane_bitcast", t.dtype))
+            else:
+                norm.append(
+                    t.astype(jnp.int32).reshape(t.shape[0] // LANES, LANES)
+                )
+                decode.append(("lane", t.dtype))
+
+    lane_modes = ("lane", "lane_bitcast")
+    in_specs = [_smem_spec(c) for _ in ops]
+    in_specs += [_vmem_full_spec(nt.shape) for nt in norm]
+    out_specs = []
+    out_shape = []
+    for op in ops:
+        mode = decode[op.table][0]
+        if mode in lane_modes:
+            out_specs.append(_smem_spec(c))
+            out_shape.append(jax.ShapeDtypeStruct((b,), jnp.int32))
+        else:
+            k = norm[op.table].shape[1]
+            out_specs.append(_vmem_rows_spec(c, k))
+            out_shape.append(jax.ShapeDtypeStruct((b, k), jnp.int32))
+
+    meta = [(op.table, decode[op.table][0] in lane_modes) for op in ops]
+
+    def kernel(*refs):
+        t_refs = refs[n_ops : n_ops + ntab]
+        o_refs = refs[n_ops + ntab :]
+        lane_iota = lax.broadcasted_iota(jnp.int32, (LANES,), 0)
+        for j, (tab, is_lane) in enumerate(meta):
+            s_ref = refs[j]
+            t_ref = t_refs[tab]
+            o_ref = o_refs[j]
+
+            def body(i, _, s_ref=s_ref, t_ref=t_ref, o_ref=o_ref,
+                     is_lane=is_lane):
+                s = s_ref[i]
+                if is_lane:
+                    r = s >> 7
+                    sel = lane_iota == (s & (LANES - 1))
+                    o_ref[i] = jnp.max(
+                        jnp.where(sel, t_ref[r, :], jnp.int32(-(2**31)))
+                    )
+                else:
+                    o_ref[i, :] = t_ref[s, :]
+                return jnp.int32(0)
+
+            lax.fori_loop(jnp.int32(0), jnp.int32(c), body, jnp.int32(0))
+
+    out = _pallas_call(
+        kernel,
+        grid=(b // c,),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shape),
+        aliases={},
+        vmem_mb=vmem_mb,
+    )(*[op.slots.astype(jnp.int32) for op in ops], *norm)
+
+    results: List[jax.Array] = []
+    for j, op in enumerate(ops):
+        mode, dt = decode[op.table]
+        o = out[j]
+        if mode == "planes":
+            results.append(planes_to_i64(o))
+        elif mode == "planes1d":
+            results.append(planes_to_i64(o)[:, 0])
+        elif mode == "bitcast":
+            results.append(lax.bitcast_convert_type(o, dt))
+        elif mode == "widen":
+            results.append(o.astype(dt))
+        elif mode == "lane_bitcast":
+            results.append(lax.bitcast_convert_type(o, dt))
+        elif mode == "lane":
+            results.append(o.astype(dt))
+        else:
+            results.append(o)
+    return results
 
 
 # ---------------------------------------------------------------------------

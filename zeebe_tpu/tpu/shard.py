@@ -352,9 +352,9 @@ def build_sharded_drive(
                         0, be - 1,
                     )
                     n_p = jnp.sum(m, dtype=jnp.int32)
-                    block = jax.tree.map(
-                        lambda a: jnp.take(a, idx, axis=0), out
-                    )
+                    # two packed row gathers instead of a per-field tree.map
+                    # (batch.take_rows, PERF_NOTES round-4 cost model)
+                    block = rb.take_rows(out, idx)
                     block = dataclasses.replace(
                         block,
                         valid=jnp.arange(exchange_slots, dtype=jnp.int32)
