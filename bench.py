@@ -217,7 +217,7 @@ def run_device_config_c4(total_instances, wave, progress):
     )
     queue = drive.make_queue(8 * wave * max(graph.emit_width // 2, 1), num_vars)
     enqueue_jit = jax.jit(drive.enqueue, donate_argnums=(0,))
-    tick = jax.jit(kernel_mod.tick_kernel)
+    tick = kernel_mod.tick_jit  # donates state: callers rebind
 
     from zeebe_tpu.tpu import hashmap
 
@@ -238,7 +238,7 @@ def run_device_config_c4(total_instances, wave, progress):
             queue, stage_c4_publishes(meta, wave, num_vars, base))
         state, queue, t2 = drive.run_to_quiescence(
             graph, state, queue, now, wave, sync=sync)
-        trig, _count = tick(state, now + 31_000)
+        state, trig, _count = tick(state, now + 31_000)
         queue = enqueue_jit(queue, trig)
         state, queue, t3 = drive.run_to_quiescence(
             graph, state, queue, now + 31_000, wave, sync=sync)

@@ -50,6 +50,15 @@ def op_census(wave_pow: int = 10) -> dict:
         graph, state, batch, jnp.asarray(0, jnp.int64),
         synthetic_workers=True,
     )
+    return census_counts(lowered)
+
+
+def census_counts(lowered) -> dict:
+    """The census numbers for an already-lowered step program — shared
+    with zbaudit's ``op-census`` pass so the audit and this profiler gate
+    the SAME lowering rather than paying two traces."""
+    import re
+
     text = lowered.as_text()
     counts = {
         "gather": len(re.findall(r"\bgather\b", text)),

@@ -12,11 +12,17 @@ cd "$(dirname "$0")"
 
 echo "== zblint (project lint suite: undefined names, discarded actor"
 echo "   futures, blocking calls on actors, metrics hot loops + doc drift,"
-echo "   dirty-family coverage, swallowed excepts; docs/operations/lint.md) =="
+echo "   dirty-family coverage, swallowed excepts, unregistered jax.jit;"
+echo "   docs/operations/lint.md) =="
 python -m tools.zblint
 
 echo "== compileall (syntax gate) =="
 python -m compileall -q zeebe_tpu tests benchmarks tools bench.py __graft_entry__.py
+
+echo "== zbaudit (IR-level audit of every registered jit entry point:"
+echo "   HBM model, dtype flow, host boundary + donation, collective"
+echo "   volume, recompile signatures, op census; docs/operations/iraudit.md) =="
+python -m tools.zbaudit
 
 if [ "$1" = "fast" ]; then
   echo "CI GATE (fast) GREEN"
@@ -72,9 +78,6 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 
 echo "== full test suite (tier-1; run './ci.sh slow' for the slow tier) =="
 python -m pytest tests/ -x -q -m "not slow" --ignore=tests/test_chaos.py --ignore=tests/test_exporters.py
-
-echo "== op-census budget gate (lowered step program gather/scatter) =="
-python tools/census_gate.py
 
 echo "== pallas ops + mega-pass parity (skips without a TPU) =="
 python benchmarks/pallas_ops_check.py

@@ -59,7 +59,9 @@ def _engine(n_jobs, sub_specs, job_type="work"):
 class TestTypedBacklogProbe:
     def test_backlog_bit_set_on_type_match(self):
         eng = _engine(2, [(1, "work", 5)])
-        mask = int(_due_probe_jit(eng.state, jnp.asarray(0, jnp.int64)))
+        # the probe donates state (aliased pass-through): rebind
+        eng.state, mask = _due_probe_jit(eng.state, jnp.asarray(0, jnp.int64))
+        mask = int(mask)
         assert mask & PROBE_JOB_BACKLOG
         assert not mask & PROBE_DEADLINES
 
@@ -68,14 +70,16 @@ class TestTypedBacklogProbe:
         any credited subscription kept the bit set, paying a full
         device→host backlog pull every tick for nothing."""
         eng = _engine(1, [(1, "other-type", 5)])
-        mask = int(_due_probe_jit(eng.state, jnp.asarray(0, jnp.int64)))
+        eng.state, mask = _due_probe_jit(eng.state, jnp.asarray(0, jnp.int64))
+        mask = int(mask)
         assert not mask & PROBE_JOB_BACKLOG
         # and the pull it gates would indeed have found nothing
         assert eng.device_backlog_activations() == []
 
     def test_exhausted_credits_keep_bit_clear(self):
         eng = _engine(2, [(1, "work", 0)])
-        mask = int(_due_probe_jit(eng.state, jnp.asarray(0, jnp.int64)))
+        eng.state, mask = _due_probe_jit(eng.state, jnp.asarray(0, jnp.int64))
+        mask = int(mask)
         assert not mask & PROBE_JOB_BACKLOG
 
 

@@ -1,0 +1,481 @@
+"""zbaudit suite tests: every pass proves it fires on a seeded
+anti-pattern (positive) and stays quiet on the sanctioned idiom
+(negative); plus the baseline ratchet, the HBM model vs measured
+device-buffer bytes, the donation parity pins the boundary pass forced
+on ``kernel.tick`` / ``engine.due_probe``, the runtime recompile guard,
+and the live-tree-clean gate pin (the exact CI invocation).
+
+Fixtures go through :func:`tools.zbaudit.audit_program`, which builds an
+``AuditedEntry`` WITHOUT touching the jit registry — so nothing here can
+trip the coverage pass on the live tree.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from zeebe_tpu import tpu as _tpu  # noqa: F401  (enables x64)
+from zeebe_tpu.tpu import (
+    batch as rb,
+    engine as engine_mod,
+    kernel,
+    state as state_mod,
+)
+from zeebe_tpu.tpu.shard import _shard_map
+
+from tools.zbaudit import audit, audit_program, load_budget
+from tools.zbaudit import passes as passes_mod
+from tools.zbaudit.core import write_audit_baseline
+from tools.zblint.engine import Finding, apply_baseline, load_baseline
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def f32(*shape):
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+NOW = jax.ShapeDtypeStruct((), jnp.int64)
+
+
+# -- seeded anti-patterns ----------------------------------------------------
+
+class TestDtypeFlow:
+    def test_f64_leak_fires(self):
+        def leaky(x):
+            return jnp.asarray(x, jnp.float64) * 2.0
+
+        entry = audit_program("fixture.f64", leaky, f32(8))
+        result = audit(passes=["dtype-flow"], entries=[entry], budget={})
+        assert "dtype-f64" in rules_of(result.findings)
+
+    def test_f32_program_is_quiet(self):
+        entry = audit_program("fixture.f32", lambda x: x * 2.0, f32(8))
+        result = audit(passes=["dtype-flow"], entries=[entry], budget={})
+        assert result.findings == []
+
+    def test_i64_ratchet_fires_over_budget(self):
+        def keys(k):
+            return k + jnp.int64(1)
+
+        entry = audit_program(
+            "fixture.i64", keys, jax.ShapeDtypeStruct((8,), jnp.int64)
+        )
+        budget = {"dtype": {"i64_budget": {"fixture.i64": 0}}}
+        result = audit(passes=["dtype-flow"], entries=[entry], budget=budget)
+        assert "dtype-i64" in rules_of(result.findings)
+
+    def test_i64_under_budget_emits_ratchet_hint(self):
+        entry = audit_program(
+            "fixture.i64", lambda k: k + jnp.int64(1),
+            jax.ShapeDtypeStruct((8,), jnp.int64),
+        )
+        budget = {"dtype": {"i64_budget": {"fixture.i64": 100}}}
+        result = audit(passes=["dtype-flow"], entries=[entry], budget=budget)
+        assert result.findings == []
+        assert result.report["dtype"]["ratchet_hints"]
+
+
+class TestBoundary:
+    def test_undonated_state_arg_fires(self):
+        def step(state, now):
+            return state + now
+
+        entry = audit_program(
+            "fixture.undonated", step, f32(64), NOW, state_args=(0,),
+        )
+        result = audit(passes=["boundary"], entries=[entry], budget={})
+        assert "boundary-donation" in rules_of(result.findings)
+
+    def test_donated_passthrough_is_quiet_and_aliased(self):
+        def step(state, now):
+            return state, jnp.sum(state) + now
+
+        entry = audit_program(
+            "fixture.donated", step, f32(64), NOW,
+            state_args=(0,), donate_argnums=(0,),
+        )
+        result = audit(passes=["boundary"], entries=[entry], budget={})
+        assert result.findings == []
+        assert result.report["boundary"]["fixture.donated"][
+            "alias_materialized"
+        ]
+
+    def test_donation_without_aliasing_fires(self):
+        # output shape differs from the donated arg: XLA cannot alias,
+        # the declared donation buys nothing
+        def shrink(state):
+            return jnp.sum(state)
+
+        entry = audit_program(
+            "fixture.noalias", shrink, f32(64),
+            state_args=(0,), donate_argnums=(0,),
+        )
+        result = audit(passes=["boundary"], entries=[entry], budget={})
+        assert "boundary-alias" in rules_of(result.findings)
+
+    def test_host_callback_fires(self):
+        def hostly(x):
+            return jax.pure_callback(
+                lambda a: a, jax.ShapeDtypeStruct((8,), jnp.float32), x
+            )
+
+        entry = audit_program("fixture.callback", hostly, f32(8))
+        result = audit(passes=["boundary"], entries=[entry], budget={})
+        assert "boundary-callback" in rules_of(result.findings)
+
+    def test_suppressed_donation_gap_is_quiet(self):
+        entry = audit_program(
+            "fixture.waived", lambda s, n: s + n, f32(64), NOW,
+            state_args=(0,), suppress=("boundary-donation",),
+        )
+        result = audit(passes=["boundary"], entries=[entry], budget={})
+        assert result.findings == []
+
+
+class TestCollectiveVolume:
+    @staticmethod
+    def _psum_program():
+        mesh = Mesh(np.asarray(jax.devices()), ("partitions",))
+        return _shard_map(
+            lambda x: jax.lax.psum(x, "partitions"),
+            mesh=mesh, in_specs=P("partitions"), out_specs=P(),
+        )
+
+    def test_oversized_collective_fires(self):
+        n = len(jax.devices())
+        entry = audit_program(
+            "fixture.bigcoll", self._psum_program(), f32(n, 256),
+            collective=True,
+        )
+        budget = {"collective": {"per_round_budget_bytes": 1}}
+        result = audit(
+            passes=["collective-volume"], entries=[entry], budget=budget
+        )
+        assert "collective-volume" in rules_of(result.findings)
+
+    def test_collective_in_noncollective_entry_fires(self):
+        n = len(jax.devices())
+        entry = audit_program(
+            "fixture.sneaky", self._psum_program(), f32(n, 4),
+            collective=False,
+        )
+        result = audit(
+            passes=["collective-volume"], entries=[entry],
+            budget={"collective": {"per_round_budget_bytes": 1 << 30}},
+        )
+        assert "collective-unexpected" in rules_of(result.findings)
+
+    def test_under_budget_collective_is_quiet(self):
+        n = len(jax.devices())
+        entry = audit_program(
+            "fixture.smallcoll", self._psum_program(), f32(n, 4),
+            collective=True,
+        )
+        result = audit(
+            passes=["collective-volume"], entries=[entry],
+            budget={"collective": {"per_round_budget_bytes": 1 << 30}},
+        )
+        assert result.findings == []
+
+
+class TestHbmBudget:
+    SMALL = {
+        "default_config": {
+            "capacity": 64, "num_vars": 8, "sub_capacity": 8, "wave": 16,
+        },
+        "hbm": {"device_budget_bytes": 16, "capacity_table": [64]},
+    }
+
+    def test_oversized_entry_fires(self):
+        entry = audit_program("fixture.fat", lambda x: x + 1.0, f32(1024))
+        result = audit(
+            passes=["hbm-budget"], entries=[entry], budget=self.SMALL
+        )
+        assert any(
+            f.rule == "hbm-budget" and "fixture.fat" in f.message
+            for f in result.findings
+        )
+
+    def test_within_budget_is_quiet(self):
+        budget = {
+            "default_config": self.SMALL["default_config"],
+            "hbm": {"device_budget_bytes": 1 << 40, "capacity_table": [64]},
+        }
+        entry = audit_program("fixture.thin", lambda x: x + 1.0, f32(8))
+        result = audit(passes=["hbm-budget"], entries=[entry], budget=budget)
+        assert result.findings == []
+
+
+class TestOpCensus:
+    @staticmethod
+    def _gather_entry():
+        def lookup(table, idx):
+            return table[idx]
+
+        return audit_program(
+            "kernel.step", lookup, f32(64),
+            jax.ShapeDtypeStruct((8,), jnp.int32),
+        )
+
+    def test_over_budget_census_fires(self, tmp_path, monkeypatch):
+        fake = tmp_path / "census_budget.json"
+        fake.write_text(json.dumps({
+            "backend": "cpu", "gather": 0, "scatter": 0,
+            "gather_scatter_total": 0,
+        }))
+        # os.path.join(REPO_ROOT, <absolute>) resolves to the absolute path
+        monkeypatch.setattr(passes_mod, "CENSUS_BUDGET_PATH", str(fake))
+        result = audit(
+            passes=["op-census"], entries=[self._gather_entry()], budget={}
+        )
+        assert "op-census" in rules_of(result.findings)
+
+    def test_under_budget_emits_ratchet_hint(self, tmp_path, monkeypatch):
+        fake = tmp_path / "census_budget.json"
+        fake.write_text(json.dumps({
+            "backend": "cpu", "gather": 1000, "scatter": 1000,
+            "gather_scatter_total": 1000,
+        }))
+        monkeypatch.setattr(passes_mod, "CENSUS_BUDGET_PATH", str(fake))
+        result = audit(
+            passes=["op-census"], entries=[self._gather_entry()], budget={}
+        )
+        assert result.findings == []
+        assert result.report["op-census"]["ratchet_hints"]
+
+    def test_mismatched_backend_skips_gate(self, tmp_path, monkeypatch):
+        fake = tmp_path / "census_budget.json"
+        fake.write_text(json.dumps({"backend": "tpu", "gather": 0}))
+        monkeypatch.setattr(passes_mod, "CENSUS_BUDGET_PATH", str(fake))
+        result = audit(
+            passes=["op-census"], entries=[self._gather_entry()], budget={}
+        )
+        assert result.findings == []
+        assert "skipped" in result.report["op-census"]
+
+
+class TestSignatureGuard:
+    def test_cache_over_declared_max_fires(self):
+        entry = audit_program(
+            "fixture.churner", lambda x: x * 2.0, f32(4), max_signatures=1,
+        )
+        # compile two distinct signatures against a declared max of 1
+        entry.entry.fn(jnp.zeros((4,), jnp.float32))
+        entry.entry.fn(jnp.zeros((9,), jnp.float32))
+        result = audit(
+            passes=["signature-guard"], entries=[entry], budget={}
+        )
+        assert "signature-cache" in rules_of(result.findings)
+
+    def test_cache_within_max_is_quiet(self):
+        entry = audit_program(
+            "fixture.stable", lambda x: x * 2.0, f32(4), max_signatures=2,
+        )
+        entry.entry.fn(jnp.zeros((4,), jnp.float32))
+        result = audit(
+            passes=["signature-guard"], entries=[entry], budget={}
+        )
+        assert result.findings == []
+
+
+# -- baseline ratchet --------------------------------------------------------
+
+class TestBaselineRatchet:
+    def test_round_trip_and_ratchet(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        f1 = Finding("hbm-budget", "zeebe_tpu/tpu/kernel.py", 10, "msg-a")
+        f2 = Finding("dtype-i64", "zeebe_tpu/tpu/drive.py", 20, "msg-b")
+        write_audit_baseline(path, [f1, f2])
+        baseline = load_baseline(path)
+        surfaced, baselined = apply_baseline([f1, f2], baseline)
+        assert surfaced == [] and baselined == 2
+        # a NEW finding is not grandfathered
+        f3 = Finding("boundary-callback", "zeebe_tpu/tpu/shard.py", 5, "new")
+        surfaced, baselined = apply_baseline([f1, f3], baseline)
+        assert [f.rule for f in surfaced] == ["boundary-callback"]
+        # ratchet down: rewrite after fixing f2 — f2 would now surface
+        write_audit_baseline(path, [f1])
+        surfaced, _ = apply_baseline([f1, f2], load_baseline(path))
+        assert [f.rule for f in surfaced] == ["dtype-i64"]
+
+    def test_baseline_comment_names_zbaudit(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        write_audit_baseline(path, [])
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        assert "zbaudit" in doc["comment"]
+        assert doc["entries"] == {}
+
+    def test_checked_in_baseline_is_empty(self):
+        # the live tree audits clean: nothing is grandfathered
+        path = os.path.join(REPO_ROOT, "tools", "zbaudit_baseline.json")
+        with open(path, encoding="utf-8") as f:
+            assert json.load(f)["entries"] == {}
+
+
+# -- HBM model accuracy ------------------------------------------------------
+
+class TestHbmModel:
+    def test_model_matches_measured_device_bytes(self):
+        """The closed-form state model vs real committed buffers: put the
+        default-config state on device and sum buffer bytes (issue
+        acceptance: within 10%; the model is an exact leaf-bytes sum, so
+        this pins equality modulo backend padding)."""
+        budget = load_budget()
+        report = {}
+        passes_mod.pass_hbm([], budget, report)
+        model = report["hbm"]
+        dc = budget["default_config"]
+        state = state_mod.make_state(
+            capacity=dc["capacity"], num_vars=dc["num_vars"],
+            job_capacity=dc["capacity"], sub_capacity=dc["sub_capacity"],
+        )
+        measured = sum(
+            jax.device_put(leaf).nbytes for leaf in jax.tree.leaves(state)
+        )
+        modeled = model["state_bytes_at_default_capacity"]
+        assert abs(modeled - measured) / measured < 0.10
+
+    def test_capacity_table_is_linear_in_capacity(self):
+        budget = load_budget()
+        report = {}
+        passes_mod.pass_hbm([], budget, report)
+        model = report["hbm"]
+        slope = model["bytes_per_capacity_row"]
+        fixed = model["fixed_bytes"]
+        assert slope > 0
+        for cap, total in model["capacity_table"].items():
+            predicted = slope * int(cap) + fixed
+            assert abs(predicted - total) / total < 0.01
+
+
+# -- donation parity pins ----------------------------------------------------
+
+def _timer_state(capacity=64, num_vars=8, due=3):
+    """EngineState with ``due`` timers due at t<=10 (seeded directly,
+    like test_job_backlog_probe seeds jobs)."""
+    state = state_mod.make_state(
+        capacity=capacity, num_vars=num_vars, job_capacity=capacity,
+        sub_capacity=8,
+    )
+    timer_key = np.asarray(state.timer_key).copy()
+    timer_due = np.asarray(state.timer_due).copy()
+    for i in range(due):
+        timer_key[i] = 100 + 7 * i
+        timer_due[i] = 10
+    return dataclasses.replace(
+        state,
+        timer_key=jnp.asarray(timer_key), timer_due=jnp.asarray(timer_due),
+    )
+
+
+class TestDonationParity:
+    def test_tick_donated_matches_undonated(self):
+        """kernel.tick donates its (read-only) state: the triggered batch
+        must be bit-identical to the un-donated reference and the
+        passthrough state bit-identical to the input."""
+        state = _timer_state()
+        now = jnp.asarray(100, jnp.int64)
+        snapshot = [np.asarray(leaf) for leaf in jax.tree.leaves(state)]
+        # un-donated reference first (it leaves `state` alive)
+        ref_out, ref_count = kernel.tick_kernel(state, now)
+        state2, out, count = kernel.tick_jit(state, now)
+        assert int(count) == int(ref_count) == 3
+        for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(ref_out)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(state2), snapshot):
+            np.testing.assert_array_equal(np.asarray(a), b)
+
+    def test_due_probe_donated_matches_undonated(self):
+        eng = engine_mod.TpuPartitionEngine(capacity=64, sub_capacity=8)
+        now = jnp.asarray(0, jnp.int64)
+        ref = int(engine_mod._due_probe_kernel(eng.state, now))
+        eng.state, mask = engine_mod._due_probe_jit(eng.state, now)
+        assert int(mask) == ref
+        # the rebound state is alive and probes identically again
+        eng.state, mask2 = engine_mod._due_probe_jit(eng.state, now)
+        assert int(mask2) == ref
+
+
+# -- runtime recompile guard -------------------------------------------------
+
+class TestRecompileGuard:
+    def test_step_waves_of_varying_record_count_share_one_signature(self):
+        """The serving-latency cliff zbaudit's signature guard exists
+        for: waves carry a varying VALID count inside a fixed wave shape,
+        so stepping different record counts must not recompile."""
+        import bench
+
+        graph, _meta = bench.build_graph()
+        num_vars = max(graph.num_vars, 8)
+        graph = dataclasses.replace(graph, num_vars=num_vars)
+        state = state_mod.make_state(
+            capacity=128, num_vars=num_vars, job_capacity=128,
+            sub_capacity=8,
+        )
+        wave = rb.empty(16, num_vars)
+        state, _em, _stats = kernel.step_jit(
+            graph, state, wave, jnp.asarray(0, jnp.int64),
+            synthetic_workers=False,
+        )
+        before = kernel.step_jit._cache_size()
+        for count, now in ((1, 1000), (3, 2000)):
+            wave = rb.empty(16, num_vars)
+            wave = dataclasses.replace(
+                wave,
+                valid=wave.valid.at[:count].set(True),
+                rtype=wave.rtype.at[:count].set(kernel.RT_CMD),
+            )
+            state, _em, _stats = kernel.step_jit(
+                graph, state, wave, jnp.asarray(now, jnp.int64),
+                synthetic_workers=False,
+            )
+        assert kernel.step_jit._cache_size() == before
+
+
+# -- the gate itself ---------------------------------------------------------
+
+class TestGate:
+    def test_unknown_pass_rejected(self):
+        with pytest.raises(ValueError, match="unknown zbaudit pass"):
+            audit(passes=["no-such-pass"], entries=[], budget={})
+
+    def test_budget_file_parses_with_required_sections(self):
+        budget = load_budget()
+        for section in ("default_config", "audit_config", "hbm", "dtype",
+                        "collective"):
+            assert section in budget
+
+    def test_live_tree_audits_clean(self, tmp_path):
+        """The CI invocation, in a clean subprocess (the in-process
+        registry carries compile-cache state from other tests): exit 0,
+        zero findings, every driver entry built."""
+        out = str(tmp_path / "report.json")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.zbaudit", "--json", "--out", out],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        with open(out, encoding="utf-8") as f:
+            doc = json.load(f)
+        assert doc["findings"] == []
+        for name in ("kernel.step", "kernel.tick", "engine.due_probe",
+                     "drive.round", "drive.quiesce", "shard.sharded_step",
+                     "shard.frame_exchange", "shard.sharded_drive"):
+            assert name in doc["entries"]
+        assert doc["report"]["hbm"]["serving_peak_bytes"] > 0

@@ -444,6 +444,101 @@ class TestUndefinedName:
         assert shim.main([]) == 0
 
 
+# -- jit-registry ------------------------------------------------------------
+
+class TestJitRegistry:
+    RULE = "jit-registry"
+
+    def test_bare_jax_jit_call_fires(self):
+        findings = lint_source(src("""
+            import jax
+
+            step = jax.jit(lambda s: s)
+        """), rules=[self.RULE])
+        assert rules_of(findings) == {self.RULE}
+        assert "register_jit" in findings[0].message
+
+    def test_jit_decorator_fires(self):
+        findings = lint_source(src("""
+            import jax
+
+            @jax.jit
+            def step(s):
+                return s
+        """), rules=[self.RULE])
+        assert rules_of(findings) == {self.RULE}
+
+    def test_partial_jit_decorator_fires(self):
+        findings = lint_source(src("""
+            from functools import partial
+            import jax
+
+            @partial(jax.jit, static_argnames=("n",))
+            def step(s, n):
+                return s
+        """), rules=[self.RULE])
+        assert rules_of(findings) == {self.RULE}
+
+    def test_from_import_alias_fires(self):
+        findings = lint_source(src("""
+            from jax import jit
+
+            step = jit(lambda s: s)
+        """), rules=[self.RULE])
+        assert rules_of(findings) == {self.RULE}
+
+    def test_register_jit_is_quiet(self):
+        findings = lint_source(src("""
+            from zeebe_tpu.tpu import jit_registry
+
+            step = jit_registry.register_jit("m.step", lambda s: s)
+        """), rules=[self.RULE])
+        assert findings == []
+
+    def test_registry_module_is_exempt(self):
+        findings = lint_source(src("""
+            import jax
+
+            jitted = jax.jit(lambda s: s)
+        """), path="zeebe_tpu/tpu/jit_registry.py", rules=[self.RULE])
+        assert findings == []
+
+    def test_outside_package_is_quiet(self):
+        findings = lint_source(src("""
+            import jax
+
+            probe = jax.jit(lambda s: s)
+        """), path="benchmarks/probe.py", rules=[self.RULE])
+        assert findings == []
+
+    def test_inline_disable(self):
+        findings = lint_source(src("""
+            import jax
+
+            probe = jax.jit(lambda s: s)  # zblint: disable=jit-registry
+        """), rules=[self.RULE])
+        assert findings == []
+
+    def test_one_finding_per_site(self):
+        # the Call and its Attribute func must not double-report
+        findings = lint_source(src("""
+            import jax
+
+            a = jax.jit(lambda s: s)
+            b = jax.jit(lambda s: s)
+        """), rules=[self.RULE])
+        assert len(findings) == 2
+
+    def test_jax_numpy_jit_free_code_is_quiet(self):
+        findings = lint_source(src("""
+            import jax.numpy as jnp
+
+            def step(s):
+                return jnp.sum(s)
+        """), rules=[self.RULE])
+        assert findings == []
+
+
 # -- suppression mechanics ---------------------------------------------------
 
 class TestSuppression:

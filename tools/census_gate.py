@@ -1,14 +1,16 @@
 #!/usr/bin/env python
-"""CI budget gate over the lowered step program's gather/scatter census.
+"""Thin shim: the census gate is now zbaudit's ``op-census`` pass.
 
-The kernel-perf rounds won by REDUCING the per-record gather/scatter
-count (PERF_NOTES rounds 4-6: round cost ~ ops/record x ~20ns/element),
-and an unrelated engine/graph change can silently re-inflate it without
-failing any functional test. This gate runs
-``benchmarks/profile_round.py --census`` and fails when any budgeted
-count rises above ``benchmarks/census_budget.json``; improvements print a
-reminder to ratchet the budget down so the win is locked in.
+The gather/scatter budget still lives in
+``benchmarks/census_budget.json`` with the same ratchet semantics; the
+counting moved into ``tools/zbaudit`` (which lowers ONE step program and
+runs every IR pass over it — see docs/operations/iraudit.md). This entry
+point survives for muscle memory and old scripts; it runs the op-census
+family in a subprocess so the budget's pinned backend applies before jax
+initializes, exactly like the old gate did.
 """
+
+from __future__ import annotations
 
 import json
 import os
@@ -17,55 +19,21 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BUDGET_PATH = os.path.join(REPO, "benchmarks", "census_budget.json")
-GATED = ("gather", "scatter", "gather_scatter_total")
 
 
 def main() -> int:
-    with open(BUDGET_PATH) as f:
+    with open(BUDGET_PATH, encoding="utf-8") as f:
         budget = json.load(f)
-    env = dict(os.environ)
-    # the budget is pinned to the CPU lowering: deterministic on every CI
-    # container, and op-count regressions show identically there
-    env["JAX_PLATFORMS"] = budget.get("backend", "cpu")
     out = subprocess.run(
         [
-            sys.executable,
-            os.path.join(REPO, "benchmarks", "profile_round.py"),
-            "--census",
+            sys.executable, "-m", "tools.zbaudit",
+            "--passes", "op-census",
+            "--backend", budget.get("backend", "cpu"),
         ],
-        capture_output=True,
-        text=True,
-        env=env,
         timeout=900,
         cwd=REPO,
     )
-    if out.returncode != 0:
-        sys.stdout.write(out.stdout)
-        sys.stderr.write(out.stderr)
-        print("census gate: profile_round.py --census failed")
-        return 1
-    census = json.loads(out.stdout.strip().splitlines()[-1])
-    print(f"census: {json.dumps(census)}")
-    failures = []
-    for key in GATED:
-        have, allowed = int(census.get(key, 0)), int(budget[key])
-        if have > allowed:
-            failures.append(f"  {key}: {have} > budget {allowed}")
-        elif have < allowed:
-            print(
-                f"census {key} improved ({have} < budget {allowed}) — "
-                "ratchet benchmarks/census_budget.json down to lock it in"
-            )
-    if failures:
-        print("CENSUS BUDGET EXCEEDED (kernel op-count regression):")
-        print("\n".join(failures))
-        print(
-            "If the increase is intentional, raise "
-            "benchmarks/census_budget.json in the same change and say why."
-        )
-        return 1
-    print("census gate OK")
-    return 0
+    return out.returncode
 
 
 if __name__ == "__main__":

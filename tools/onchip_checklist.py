@@ -60,6 +60,64 @@ def run_step(name, argv, timeout_sec, env=None):
     return step
 
 
+def _audit_summary(doc):
+    """The model numbers worth diffing across backends from one zbaudit
+    --json report: finding count, per-entry modeled HBM peaks, per-entry
+    collective bytes/round, and the step-program op census."""
+    rep = doc.get("report", {})
+    return {
+        "findings": len(doc.get("findings", [])),
+        "hbm_peak_bytes": {
+            k: v.get("peak_bytes")
+            for k, v in (rep.get("hbm", {}).get("entries") or {}).items()
+        },
+        "collective_bytes_per_round": {
+            k: v.get("total_bytes_per_round")
+            for k, v in (rep.get("collective") or {}).items()
+        },
+        "census_counts": (rep.get("op-census") or {}).get("counts"),
+    }
+
+
+def zbaudit_reaudit(report, py, timeout_sec=1800):
+    """The PR-14 TPU re-audit leg: run the IR audit against the REAL
+    lowering (``--backend tpu``) and against the CPU reference, then diff
+    the model numbers into the report — the off-chip audit gates CI, so
+    what matters on a chip session is exactly where the tpu lowering
+    diverges from the numbers the budget was ratcheted on."""
+    docs = {}
+    steps = []
+    for backend in ("tpu", "cpu"):
+        out = os.path.join(ROOT, f"zbaudit_{backend}_report.json")
+        step = run_step(
+            f"zbaudit_{backend}",
+            [py, "-m", "tools.zbaudit", "--backend", backend,
+             "--json", "--out", out],
+            timeout_sec,
+        )
+        steps.append(step)
+        if step["rc"] == 0:
+            try:
+                with open(out, encoding="utf-8") as f:
+                    docs[backend] = _audit_summary(json.load(f))
+            except (OSError, ValueError) as e:
+                step["rc"] = -2
+                step["tail"] += f"\nreport unreadable: {e}"
+    diff = {}
+    if "tpu" in docs and "cpu" in docs:
+        for section in ("hbm_peak_bytes", "collective_bytes_per_round"):
+            t, c = docs["tpu"][section], docs["cpu"][section]
+            diff[section] = {
+                k: {"tpu": t.get(k), "cpu": c.get(k)}
+                for k in sorted(set(t) | set(c)) if t.get(k) != c.get(k)
+            }
+        t, c = docs["tpu"]["census_counts"], docs["cpu"]["census_counts"]
+        if t != c:
+            diff["census_counts"] = {"tpu": t, "cpu": c}
+    report["zbaudit"] = {**docs, "tpu_vs_cpu_diff": diff}
+    return steps
+
+
 def main() -> int:
     out_path = DEFAULT_OUT
     if "--out" in sys.argv:
@@ -120,6 +178,13 @@ def main() -> int:
         report["steps"].append(step)
         if step["rc"] != 0:
             failed.append(name)
+    # PR 14: re-run the IR audit against the real tpu lowering and diff
+    # its model numbers (HBM peaks, collective volumes, op census)
+    # against the CPU reference the budgets were ratcheted on
+    for step in zbaudit_reaudit(report, py):
+        report["steps"].append(step)
+        if step["rc"] != 0:
+            failed.append(step["name"])
     report["status"] = "failed" if failed else "ok"
     report["failed"] = failed
     report["completed"] = time.strftime("%Y-%m-%dT%H:%M:%S")

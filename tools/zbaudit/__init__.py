@@ -1,0 +1,135 @@
+"""zbaudit — IR-level static analysis of the compiled step program.
+
+zblint guards the Python-AST layer; zbaudit guards the layer that
+actually determines accelerator throughput: the traced + lowered step
+program. It enumerates every registered jit entry point
+(``zeebe_tpu.tpu.jit_registry``), lowers each one CPU-side (no compile,
+no device run), and applies six passes — HBM footprint model, dtype-flow
+lint, host-boundary/donation audit, collective-volume model,
+recompile-signature guard, and the op census (the old
+``tools/census_gate.py``, folded in).
+
+Run ``python -m tools.zbaudit``; docs in docs/operations/iraudit.md.
+
+Public API::
+
+    result = audit()                      # all passes, all entries
+    result = audit(passes=["op-census"])  # one budget family
+    entry = audit_program("t", fn, args)  # one ad-hoc program (tests)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+from tools.zbaudit.core import (  # noqa: F401  (public re-exports)
+    BASELINE_PATH,
+    BUDGET_PATH,
+    REPO_ROOT,
+    AuditedEntry,
+    Finding,
+)
+
+
+@dataclasses.dataclass
+class AuditResult:
+    entries: List[AuditedEntry]
+    findings: List[Finding]  # pre-baseline, sorted
+    report: Dict[str, object]
+
+
+def load_budget(path: Optional[str] = None) -> dict:
+    import json
+    import os
+
+    from tools.zbaudit import core
+
+    p = path or os.path.join(core.REPO_ROOT, core.BUDGET_PATH)
+    with open(p, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def audit(
+    passes: Optional[Sequence[str]] = None,
+    budget: Optional[dict] = None,
+    entries: Optional[List[AuditedEntry]] = None,
+) -> AuditResult:
+    """Build (or accept) audited entries and run the selected passes."""
+    from tools.zbaudit import passes as passes_mod
+    from tools.zbaudit.entries import build_entries
+
+    budget = budget if budget is not None else load_budget()
+    selected = list(passes) if passes is not None else list(passes_mod.PASSES)
+    unknown = [p for p in selected if p not in passes_mod.PASSES]
+    if unknown:
+        raise ValueError(
+            f"unknown zbaudit pass(es) {unknown}; "
+            f"known: {sorted(passes_mod.PASSES)}"
+        )
+    complete = entries is None and passes is None
+    if entries is None:
+        needed = None
+        if passes is not None:
+            needed = set()
+            for p in selected:
+                sub = passes_mod.PASS_ENTRIES.get(p)
+                if sub is None:
+                    needed = None
+                    break
+                needed |= sub
+        entries = build_entries(budget, names=needed)
+    report: Dict[str, object] = {"complete": complete}
+    findings: List[Finding] = []
+    for name in selected:
+        findings.extend(passes_mod.PASSES[name](entries, budget, report))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return AuditResult(entries=entries, findings=findings, report=report)
+
+
+def audit_program(
+    name: str,
+    fn,
+    *args,
+    state_args=(),
+    donate_argnums=(),
+    static_argnames=(),
+    collective: bool = False,
+    max_signatures: int = 1,
+    suppress=(),
+    **kwargs,
+) -> AuditedEntry:
+    """Trace + lower one ad-hoc program into an AuditedEntry WITHOUT
+    touching the global registry (so test fixtures never trip the
+    coverage pass on the live tree). The fixture backbone for
+    tests/test_zbaudit.py's seeded anti-patterns."""
+    import jax
+
+    from zeebe_tpu.tpu.jit_registry import JitEntry, _as_tuple
+
+    from tools.zbaudit.core import rel_src
+    from tools.zbaudit.entries import _trace_lower
+
+    jit_kwargs = {}
+    if donate_argnums:
+        jit_kwargs["donate_argnums"] = tuple(donate_argnums)
+    if static_argnames:
+        jit_kwargs["static_argnames"] = tuple(static_argnames)
+    jitted = jax.jit(fn, **jit_kwargs)
+    entry = JitEntry(
+        name=name,
+        fn=jitted,
+        wrapped=fn,
+        state_args=_as_tuple(state_args),
+        donate_argnums=_as_tuple(donate_argnums),
+        static_argnames=_as_tuple(static_argnames),
+        collective=collective,
+        max_signatures=max_signatures,
+        suppress=_as_tuple(suppress),
+    )
+    traced, lowered = _trace_lower(jitted, *args, **kwargs)
+    path, line = rel_src(fn)
+    return AuditedEntry(
+        name=name, entry=entry, traced=traced, lowered=lowered,
+        path=path, line=line,
+    )

@@ -1,0 +1,124 @@
+"""CLI: ``python -m tools.zbaudit`` — the ci.sh IR-audit gate.
+
+Environment is pinned BEFORE jax imports (XLA parses XLA_FLAGS once per
+process, PR-9 note): the default run forces 8 virtual CPU devices so the
+mesh entries (``shard.*``) trace with real collectives. Exit 1 when any
+finding survives the ratchet baseline (tools/zbaudit_baseline.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="zbaudit",
+        description="IR-level static analysis of the lowered step program "
+        "(docs/operations/iraudit.md)",
+    )
+    ap.add_argument("--json", action="store_true",
+                    help="emit the findings + model report as JSON")
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON report to this path")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated pass subset (default: all)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline path (default tools/zbaudit_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="surface baselined findings too")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="re-write the baseline from current findings "
+                    "(ratchet-down only: review the diff)")
+    ap.add_argument("--budget", default=None,
+                    help="budget path (default tools/zbaudit_budget.json)")
+    ap.add_argument("--backend", default=None,
+                    help="JAX_PLATFORMS for the audit (default: inherited "
+                    "env, else cpu)")
+    ap.add_argument("--devices", type=int, default=8,
+                    help="virtual CPU device count for the mesh entries")
+    args = ap.parse_args(argv)
+
+    backend = args.backend or os.environ.get("JAX_PLATFORMS") or "cpu"
+    os.environ["JAX_PLATFORMS"] = backend
+    if backend == "cpu" and args.devices > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={args.devices}"
+            ).strip()
+
+    from tools.zbaudit import BASELINE_PATH, REPO_ROOT, audit, load_budget
+    from tools.zbaudit.core import write_audit_baseline
+    from tools.zblint.engine import apply_baseline, load_baseline
+
+    t0 = time.perf_counter()
+    budget = load_budget(args.budget)
+    selected = (
+        [p.strip() for p in args.passes.split(",") if p.strip()]
+        if args.passes else None
+    )
+    result = audit(passes=selected, budget=budget)
+    baseline_path = args.baseline or os.path.join(REPO_ROOT, BASELINE_PATH)
+
+    if args.write_baseline:
+        entries = write_audit_baseline(baseline_path, result.findings)
+        print(
+            f"zbaudit: wrote {sum(entries.values())} finding(s) across "
+            f"{len(entries)} key(s) to {baseline_path}"
+        )
+        return 0
+
+    if args.no_baseline:
+        surfaced, baselined = result.findings, 0
+    else:
+        surfaced, baselined = apply_baseline(
+            result.findings, load_baseline(baseline_path)
+        )
+    elapsed = time.perf_counter() - t0
+
+    if args.json or args.out:
+        doc = {
+            "passes": selected or "all",
+            "backend": backend,
+            "entries": sorted(a.name for a in result.entries),
+            "findings": [dataclasses.asdict(f) for f in surfaced],
+            "baselined": baselined,
+            "report": result.report,
+            "elapsed_s": round(elapsed, 2),
+        }
+        text = json.dumps(doc, indent=2, default=str)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                f.write(text + "\n")
+        if args.json:
+            print(text)
+    if not args.json:
+        for f in surfaced:
+            print(f.render())
+        hints = []
+        for section in ("dtype", "op-census"):
+            hints.extend(
+                (result.report.get(section) or {}).get("ratchet_hints", ())
+            )
+        for h in hints:
+            print(f"zbaudit: ratchet hint: {h}")
+        print(
+            f"zbaudit: {len(surfaced)} finding(s) surfaced "
+            f"({baselined} baselined) over {len(result.entries)} entries, "
+            f"{len(selected) if selected else 6} pass(es) in {elapsed:.1f}s"
+        )
+    return 1 if surfaced else 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ))
+    sys.exit(main())

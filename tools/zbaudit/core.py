@@ -1,0 +1,166 @@
+"""zbaudit core: the audited-entry model and pass plumbing.
+
+zblint (tools/zblint) mechanizes review findings at the Python-AST layer;
+zbaudit applies the same contract — stable finding keys, inline-visible
+suppression, a ratchet-down baseline — one layer down, to the TRACED AND
+LOWERED step program (jaxpr + StableHLO text). Everything here is
+CPU-lowerable: no device execution, so the suite runs in the bare CI
+image exactly like zblint.
+
+An :class:`AuditedEntry` pairs one registered jit entry point
+(``zeebe_tpu.tpu.jit_registry.JitEntry``) with its traced jaxpr and
+lowered StableHLO for a representative argument configuration. Passes
+(tools/zbaudit/passes.py) walk those artifacts and emit
+``tools.zblint.engine.Finding`` objects whose ``path``/``line`` point at
+the entry point's def site, so a finding reads like a lint hit on the
+kernel that caused it.
+
+Suppression lives on the REGISTRATION, not on a source line: an entry
+registered with ``suppress=("boundary-donation",)`` and a justification
+in ``notes`` waives that rule for that program — the IR-level analogue
+of a zblint inline disable, equally visible in review.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional
+
+from tools.zblint.engine import (  # noqa: F401  (re-exported for passes)
+    Finding,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+BASELINE_PATH = os.path.join("tools", "zbaudit_baseline.json")
+BUDGET_PATH = os.path.join("tools", "zbaudit_budget.json")
+CENSUS_BUDGET_PATH = os.path.join("benchmarks", "census_budget.json")
+
+
+@dataclasses.dataclass
+class AuditedEntry:
+    """One registered entry point, traced and lowered for audit."""
+
+    name: str
+    entry: Any  # jit_registry.JitEntry
+    traced: Any = None  # jax.stages.Traced (jaxpr source)
+    lowered: Any = None  # jax.stages.Lowered (StableHLO source)
+    config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    path: str = "zeebe_tpu"  # repo-relative def site of the wrapped fn
+    line: int = 1
+    _text: Optional[str] = dataclasses.field(default=None, repr=False)
+
+    @property
+    def text(self) -> str:
+        """Lowered StableHLO text (cached: as_text re-prints the module)."""
+        if self._text is None:
+            self._text = self.lowered.as_text() if self.lowered else ""
+        return self._text
+
+    @property
+    def jaxpr(self):
+        """The ClosedJaxpr of the traced call (None when trace failed)."""
+        return self.traced.jaxpr if self.traced is not None else None
+
+    def suppresses(self, rule: str) -> bool:
+        """True when the registration waives ``rule`` (exact id or its
+        pass-family prefix, e.g. ``boundary`` covers ``boundary-donation``)."""
+        for s in self.entry.suppress:
+            if rule == s or rule.startswith(s + "-"):
+                return True
+        return False
+
+    def finding(self, rule: str, message: str) -> Finding:
+        return Finding(rule, self.path, self.line, f"{self.name}: {message}")
+
+
+def write_audit_baseline(path: str, findings: List[Finding]) -> Dict[str, int]:
+    """zblint's baseline format with zbaudit's ratchet contract spelled
+    out (same loader: tools.zblint.engine.load_baseline)."""
+    import json
+
+    entries: Dict[str, int] = {}
+    for f in findings:
+        entries[f.key] = entries.get(f.key, 0) + 1
+    doc = {
+        "version": 1,
+        "comment": (
+            "Grandfathered zbaudit findings. This file only ratchets DOWN: "
+            "fix a finding, then `python -m tools.zbaudit --write-baseline` "
+            "to shrink it. New entry points must audit clean or register "
+            "with suppress=(...) and a justification in notes= "
+            "(docs/operations/iraudit.md)."
+        ),
+        "entries": {k: entries[k] for k in sorted(entries)},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return entries
+
+
+def rel_src(fn) -> tuple:
+    """(repo-relative path, first line) of a callable's def site; falls
+    back to the package root for builtins/partials."""
+    import inspect
+
+    target = fn
+    for attr in ("__wrapped__", "func"):
+        inner = getattr(target, attr, None)
+        if inner is not None and getattr(target, "__code__", None) is None:
+            target = inner
+    try:
+        path = inspect.getsourcefile(target)
+        line = target.__code__.co_firstlineno
+        rel = os.path.relpath(path, REPO_ROOT).replace(os.sep, "/")
+        if not rel.startswith(".."):
+            return rel, line
+    except (TypeError, AttributeError, OSError):
+        pass
+    return "zeebe_tpu", 1
+
+
+def iter_eqns(jaxpr):
+    """Yield every eqn in a (Closed)Jaxpr, recursing through call/control
+    primitives (pjit, while, cond/branches, scan, shard_map, ...)."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)  # ClosedJaxpr -> Jaxpr
+    for eqn in getattr(inner, "eqns", ()):
+        yield eqn
+        for sub in _sub_jaxprs(eqn.params):
+            yield from iter_eqns(sub)
+
+
+def _sub_jaxprs(params: dict):
+    for v in params.values():
+        for cand in v if isinstance(v, (tuple, list)) else (v,):
+            if hasattr(cand, "eqns") or hasattr(cand, "jaxpr"):
+                yield cand
+
+
+def aval_bytes(aval) -> int:
+    """Bytes of one abstract value (0 for non-array avals)."""
+    try:
+        size = 1
+        for d in aval.shape:
+            size *= int(d)
+        return size * aval.dtype.itemsize
+    except (AttributeError, TypeError):
+        return 0
+
+
+def tree_bytes(tree) -> int:
+    """Total bytes across a pytree of arrays / ShapeDtypeStructs / avals."""
+    import jax
+
+    return sum(aval_bytes(leaf) for leaf in jax.tree.leaves(tree))
+
+
+def fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.2f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024.0
+    return f"{n}B"

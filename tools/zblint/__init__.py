@@ -10,6 +10,7 @@ Mechanizes the bug classes this repo kept rediscovering by hand review
   dirty-family-audit        engine-state writes without a dirty mark
   swallowed-exception       broad excepts that do nothing at all
   undefined-name            the round-4 NameError class (ex-nameslint)
+  jit-registry              raw jax.jit that tools/zbaudit cannot see
 
 Usage:  python -m tools.zblint [--json] [--write-baseline] [--no-baseline]
                                [--rules a,b] [paths...]
@@ -27,6 +28,7 @@ from . import (
     rule_dirty,
     rule_excepts,
     rule_futures,
+    rule_jitreg,
     rule_metrics,
     rule_names,
 )
@@ -50,6 +52,7 @@ RULES = {
     rule_dirty.RULE: rule_dirty,
     rule_excepts.RULE: rule_excepts,
     rule_names.RULE: rule_names,
+    rule_jitreg.RULE: rule_jitreg,
 }
 
 

@@ -1,0 +1,88 @@
+"""jit-registry: every ``jax.jit`` in zeebe_tpu/ must route through
+``zeebe_tpu.tpu.jit_registry.register_jit``.
+
+The PR-14 IR-audit gate (tools/zbaudit, docs/operations/iraudit.md) can
+only analyze the entry points it can enumerate: a bare ``jax.jit`` is a
+compiled program that no HBM/dtype/donation/collective pass ever sees,
+and its cache growth escapes the recompile-signature guard. The registry
+also carries the audit metadata (``state_args``/``donate_argnums``/
+``collective``/``suppress``) that the boundary pass gates on, so a raw
+jit site has no place to declare its donation contract either.
+
+Flagged spellings: ``jax.jit(...)`` calls, ``@jax.jit`` /
+``@partial(jax.jit, ...)`` decorators, and ``jit`` imported via
+``from jax import jit``. The registry module itself is exempt (it is
+the one place allowed to call ``jax.jit``), as is anything outside the
+package (tests/benchmarks legitimately jit throwaway probes). Escape
+hatch for the rare intentional raw site: ``# zblint: disable=jit-registry``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .engine import FileCtx, Finding, Project, attr_chain
+
+RULE = "jit-registry"
+PACKAGE_ONLY = True
+SKIP_TESTS = True
+
+_EXEMPT_PATHS = ("zeebe_tpu/tpu/jit_registry.py",)
+
+
+def _jit_names(tree: ast.AST) -> set:
+    """Local names that alias jax.jit (`from jax import jit [as j]`)."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for alias in node.names:
+                if alias.name == "jit":
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+def _is_jit_ref(node: ast.AST, aliases: set) -> bool:
+    chain = attr_chain(node)
+    if chain is not None:
+        if chain[-1:] == ["jit"] and len(chain) >= 2 and chain[0] == "jax":
+            return True
+        if len(chain) == 1 and chain[0] in aliases:
+            return True
+    return False
+
+
+def check(ctx: FileCtx, project: Project) -> List[Finding]:
+    norm = ctx.path.replace("\\", "/")
+    if norm in _EXEMPT_PATHS:
+        return []
+    aliases = _jit_names(ctx.tree)
+    findings = []
+    for node in ast.walk(ctx.tree):
+        ref = None
+        if isinstance(node, ast.Call) and _is_jit_ref(node.func, aliases):
+            ref = node
+        elif isinstance(node, (ast.Attribute, ast.Name)) and _is_jit_ref(
+            node, aliases
+        ):
+            # bare reference: decorator (`@jax.jit`), partial argument
+            # (`partial(jax.jit, ...)`), or an alias being passed around
+            ref = node
+        if ref is None:
+            continue
+        findings.append(Finding(
+            RULE, ctx.path, ref.lineno,
+            "raw jax.jit bypasses the IR-audit registry; use "
+            "zeebe_tpu.tpu.jit_registry.register_jit (zbaudit cannot "
+            "see this program)",
+        ))
+    # a Call whose func is a flagged Attribute would double-report: the
+    # walk visits both nodes. Dedup on line keeps one finding per site.
+    seen = set()
+    out = []
+    for f in findings:
+        if f.line in seen:
+            continue
+        seen.add(f.line)
+        out.append(f)
+    return out

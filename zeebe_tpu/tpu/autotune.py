@@ -36,7 +36,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from zeebe_tpu.tpu import hashmap, pallas_ops as pops
+from zeebe_tpu.tpu import hashmap, jit_registry, pallas_ops as pops
 
 _CHAIN = 8   # dependent ops per timed call (amortizes dispatch overhead)
 _REPS = 5    # timed repetitions; min is the reported cost
@@ -258,6 +258,22 @@ def _benches() -> Dict[str, Callable[[], object]]:
     }
 
 
+def audit_candidates() -> Dict[str, Callable]:
+    """Register and return one jitted program per microbench family, for
+    ``tools/zbaudit`` to lower and audit. ``measure()`` registers the
+    ``.xla``/``.pallas`` timing arms only when it actually runs; this
+    enumerates the same workloads without timing anything."""
+    return {
+        family: jit_registry.register_jit(
+            f"autotune.{family}",
+            fn,
+            max_signatures=1,
+            notes="boot microbench candidate; carries no engine state",
+        )
+        for family, fn in _benches().items()
+    }
+
+
 def measure(progress: Optional[Callable[[str], None]] = None):
     """Run the per-family A/B microbench on the current backend. Returns
     (decisions, timings_us) — decisions maps family -> use pallas."""
@@ -265,8 +281,16 @@ def measure(progress: Optional[Callable[[str], None]] = None):
     timings: Dict[str, dict] = {}
     benches = _benches()
     for family, fn in benches.items():
-        jitted_x = jax.jit(fn)
-        jitted_p = jax.jit(fn)
+        # two jit instances so each dispatch arm traces (and caches) its
+        # own program — a shared cache would reuse the first arm's trace
+        jitted_x = jit_registry.register_jit(
+            f"autotune.{family}.xla", fn, max_signatures=1,
+            notes="boot microbench candidate (XLA arm); no state args",
+        )
+        jitted_p = jit_registry.register_jit(
+            f"autotune.{family}.pallas", fn, max_signatures=1,
+            notes="boot microbench candidate (pallas arm); no state args",
+        )
         if family == "fused":
             # the fused baseline is the UNFUSED chain under the already-
             # tuned per-family winners — with the fused family pinned OFF

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from zeebe_tpu.tpu import batch as rb
+from zeebe_tpu.tpu import jit_registry
 from zeebe_tpu.tpu.batch import RecordBatch
 from zeebe_tpu.tpu.graph import DeviceGraph
 from zeebe_tpu.tpu.kernel import step_kernel
@@ -143,19 +144,19 @@ def drive_round(
     return state, queue, stats
 
 
-drive_jit = jax.jit(
+drive_jit = jit_registry.register_jit(
+    "drive.round",
     drive_round,
+    state_args=(1,),
     static_argnames=("batch_size", "synthetic_workers"),
     donate_argnums=(1, 2),
+    max_signatures=4,
+    notes="one signature per (batch_size, synthetic_workers) a process "
+    "drives; batch_size is fixed per bench/serving config",
 )
 
 
-@partial(
-    jax.jit,
-    static_argnames=("batch_size", "synthetic_workers", "max_rounds"),
-    donate_argnums=(1, 2),
-)
-def _quiesce_device(graph, state, queue, now, batch_size, synthetic_workers, max_rounds):
+def _quiesce_device_fn(graph, state, queue, now, batch_size, synthetic_workers, max_rounds):
     """The whole drive-to-quiescence loop as ONE device program
     (``lax.while_loop``): no host round-trips between rounds. Off a local
     chip every per-round scalar sync is a full network round trip (the
@@ -193,6 +194,18 @@ def _quiesce_device(graph, state, queue, now, batch_size, synthetic_workers, max
         return s, q, t
 
     return jax.lax.while_loop(cond, body, (state, queue, totals0))
+
+
+_quiesce_device = jit_registry.register_jit(
+    "drive.quiesce",
+    _quiesce_device_fn,
+    state_args=(1,),
+    static_argnames=("batch_size", "synthetic_workers", "max_rounds"),
+    donate_argnums=(1, 2),
+    max_signatures=4,
+    notes="one signature per (batch_size, synthetic_workers, max_rounds) "
+    "combination a process drives",
+)
 
 
 # NOTE: an earlier revision compiled this program with

@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -55,6 +55,7 @@ from zeebe_tpu.protocol.records import (
 )
 from zeebe_tpu.tpu import batch as rb
 from zeebe_tpu.tpu import graph as graph_mod
+from zeebe_tpu.tpu import jit_registry
 from zeebe_tpu.tpu import kernel, state as state_mod
 from zeebe_tpu.tpu.batch import PayloadError, RecordBatch
 from zeebe_tpu.tpu.conditions import DeviceIneligible
@@ -82,8 +83,9 @@ PROBE_DEADLINES = 1  # bit0: some job/timer/message deadline is due
 PROBE_JOB_BACKLOG = 2  # bit1: assignable jobs exist AND credits are free
 
 
-@jax.jit
-def _due_probe_jit(state: "state_mod.EngineState", now: jax.Array) -> jax.Array:
+def _due_probe_kernel(
+    state: "state_mod.EngineState", now: jax.Array
+) -> jax.Array:
     """i32 bitmask scalar (PROBE_*): is ANY device-side deadline due at
     ``now``, and is there job backlog a free credit could assign? One
     fused reduction over the relevant columns — launched asynchronously
@@ -119,6 +121,28 @@ def _due_probe_jit(state: "state_mod.EngineState", now: jax.Array) -> jax.Array:
         (job_due | timer_due | msg_due).astype(jnp.int32) * PROBE_DEADLINES
         + backlog.astype(jnp.int32) * PROBE_JOB_BACKLOG
     )
+
+
+def _due_probe_entry(
+    state: "state_mod.EngineState", now: jax.Array
+) -> Tuple["state_mod.EngineState", jax.Array]:
+    """Donating entry for the probe: the reduction only READS state, so it
+    passes the tables through and declares the input donated — without the
+    alias, every async probe launch kept a full second copy of the ~50
+    state tables resident until the poll completed (zbaudit boundary
+    pass). Callers must rebind: ``state, mask = _due_probe_jit(state, now)``."""
+    return state, _due_probe_kernel(state, now)
+
+
+_due_probe_jit = jit_registry.register_jit(
+    "engine.due_probe",
+    _due_probe_entry,
+    state_args=(0,),
+    donate_argnums=(0,),
+    max_signatures=2,
+    notes="state shape is fixed per engine; one extra signature allowed "
+    "for a capacity-resized engine in the same process",
+)
 
 
 def _host_unpack_payload(pay: np.ndarray):
@@ -1014,7 +1038,8 @@ class TpuPartitionEngine:
         broker sweeps those (cheap dict scans) every tick via
         ``host_deadline_commands``."""
         now = jnp.asarray(self.clock(), jnp.int64)
-        return _due_probe_jit(self.state, now)
+        self.state, mask = _due_probe_jit(self.state, now)
+        return mask
 
     def backlog_activations(self) -> List[Record]:
         """Host-oracle side only (cheap dict scans — call freely). The

@@ -1,0 +1,131 @@
+"""Registry of the device plane's jit entry points, for IR-level audit.
+
+Every ``jax.jit`` in ``zeebe_tpu/`` routes through :func:`register_jit`
+(enforced by the ``jit-registry`` zblint rule) so ``tools/zbaudit`` can
+enumerate the full set of compiled programs a serving run produces and
+statically audit each one — HBM footprint, dtype flow, host boundary and
+donation/aliasing, collective volume, recompile signatures — without
+guessing at call sites. The registry records the audit-relevant contract
+alongside the jitted callable:
+
+- ``state_args``: positions carrying an ``EngineState`` (or other large
+  resident pytree). The boundary pass asserts each is donated — an
+  un-donated state arg doubles peak HBM for the duration of the step.
+- ``collective``: the program is built under ``shard_map`` and is
+  expected to contain collectives; the collective-volume pass models its
+  per-round bytes, and non-collective entries are asserted collective-free.
+- ``max_signatures``: ceiling on distinct compiled signatures a serving
+  run may produce for this entry (the recompile-signature guard compares
+  the live ``_cache_size()`` against it).
+- ``suppress``: zbaudit pass names deliberately waived for this entry,
+  with ``notes`` saying why — same contract as a zblint inline disable,
+  but attached to the program rather than a source line.
+
+Re-registering a name is allowed (per-mesh builders like
+``shard.build_sharded_step`` construct a fresh program per topology);
+the latest registration wins and ``instances`` counts how many times the
+entry was built this process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+__all__ = ["JitEntry", "register_jit", "entries", "get", "signature_report"]
+
+
+@dataclasses.dataclass
+class JitEntry:
+    """One registered jit entry point plus its audit contract."""
+
+    name: str
+    fn: Any  # the jitted callable (jax.stages.Wrapped)
+    wrapped: Callable  # the underlying python function
+    state_args: Tuple[int, ...] = ()
+    donate_argnums: Tuple[int, ...] = ()
+    static_argnames: Tuple[str, ...] = ()
+    collective: bool = False
+    max_signatures: int = 1
+    suppress: Tuple[str, ...] = ()
+    notes: str = ""
+    instances: int = 1
+
+    def cache_size(self) -> Optional[int]:
+        """Live compiled-signature count, or None when jax doesn't expose
+        one (API drift / freshly built entry)."""
+        try:
+            return int(self.fn._cache_size())
+        except (AttributeError, TypeError):  # private API; absence is data
+            return None
+
+
+REGISTRY: Dict[str, JitEntry] = {}
+
+
+def _as_tuple(v) -> tuple:
+    if v is None:
+        return ()
+    if isinstance(v, (str, int)):
+        return (v,)
+    return tuple(v)
+
+
+def register_jit(
+    name: str,
+    fn: Callable,
+    *,
+    state_args=(),
+    collective: bool = False,
+    max_signatures: int = 1,
+    suppress=(),
+    notes: str = "",
+    **jit_kwargs,
+):
+    """``jax.jit`` with an audit registration — the only sanctioned way to
+    create a jit entry point inside ``zeebe_tpu/`` (zblint ``jit-registry``).
+
+    ``jit_kwargs`` pass through to ``jax.jit`` verbatim (``donate_argnums``,
+    ``static_argnames``, ...). Returns the jitted callable.
+    """
+    jitted = jax.jit(fn, **jit_kwargs)
+    prev = REGISTRY.get(name)
+    REGISTRY[name] = JitEntry(
+        name=name,
+        fn=jitted,
+        wrapped=fn,
+        state_args=_as_tuple(state_args),
+        donate_argnums=_as_tuple(jit_kwargs.get("donate_argnums")),
+        static_argnames=_as_tuple(jit_kwargs.get("static_argnames")),
+        collective=collective,
+        max_signatures=int(max_signatures),
+        suppress=_as_tuple(suppress),
+        notes=notes,
+        instances=(prev.instances + 1) if prev is not None else 1,
+    )
+    return jitted
+
+
+def entries() -> Dict[str, JitEntry]:
+    """Snapshot of the registry (name → entry)."""
+    return dict(REGISTRY)
+
+
+def get(name: str) -> Optional[JitEntry]:
+    return REGISTRY.get(name)
+
+
+def signature_report() -> Dict[str, dict]:
+    """Per-entry live compile-cache occupancy vs the declared ceiling —
+    the runtime leg of zbaudit's recompile-signature guard (the static leg
+    lowers each entry; this one reads what the process actually compiled)."""
+    out = {}
+    for name, e in sorted(REGISTRY.items()):
+        out[name] = {
+            "cache_size": e.cache_size(),
+            "max_signatures": e.max_signatures,
+            "instances": e.instances,
+        }
+    return out

@@ -42,6 +42,7 @@ from zeebe_tpu.protocol.intents import (
 from zeebe_tpu.tpu import batch as rb
 from zeebe_tpu.tpu import graph as graph_mod
 from zeebe_tpu.tpu import hashmap
+from zeebe_tpu.tpu import jit_registry
 from zeebe_tpu.tpu import pallas_ops as pops
 from zeebe_tpu.tpu.batch import RecordBatch
 from zeebe_tpu.tpu.conditions import ERROR as TRI_ERROR
@@ -2451,8 +2452,15 @@ def step_kernel(
     return new_state, out, stats
 
 
-step_jit = jax.jit(
-    step_kernel, donate_argnums=(1,), static_argnames=("synthetic_workers",)
+step_jit = jit_registry.register_jit(
+    "kernel.step",
+    step_kernel,
+    state_args=(1,),
+    donate_argnums=(1,),
+    static_argnames=("synthetic_workers",),
+    max_signatures=4,
+    notes="one signature per (synthetic_workers, wave shape) pair a "
+    "serving process uses; the scheduler packs fixed-size waves",
 )
 
 
@@ -2521,4 +2529,24 @@ def tick_kernel(state: EngineState, now) -> Tuple[RecordBatch, jax.Array]:
     return out, count
 
 
-tick_jit = jax.jit(tick_kernel)
+def _tick_entry(
+    state: EngineState, now
+) -> Tuple[EngineState, RecordBatch, jax.Array]:
+    """Donating wrapper for ``tick_kernel``: the scan only READS state, so
+    the entry passes it through unchanged and declares the input donated —
+    XLA aliases the ~50 state tables input→output instead of keeping a
+    second resident copy live across the tick (zbaudit boundary pass).
+    Callers must rebind: ``state, out, count = tick_jit(state, now)``."""
+    out, count = tick_kernel(state, now)
+    return state, out, count
+
+
+tick_jit = jit_registry.register_jit(
+    "kernel.tick",
+    _tick_entry,
+    state_args=(0,),
+    donate_argnums=(0,),
+    max_signatures=2,
+    notes="state shape is fixed per engine; one extra signature allowed "
+    "for a capacity-resized engine in the same process",
+)

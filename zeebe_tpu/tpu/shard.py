@@ -43,6 +43,7 @@ if _shard_map is None:
 from zeebe_tpu.engine import keyspace
 from zeebe_tpu.protocol.enums import RecordType, ValueType
 from zeebe_tpu.tpu import batch as rb
+from zeebe_tpu.tpu import jit_registry
 from zeebe_tpu.tpu import state as state_mod
 from zeebe_tpu.tpu.batch import RecordBatch
 from zeebe_tpu.tpu.graph import DeviceGraph
@@ -197,7 +198,20 @@ def build_sharded_step(mesh: Mesh, exchange_slots: int = 128):
         )
         return fn(graph, state, batch, sends, now)
 
-    return jax.jit(sharded_step), nparts
+    return (
+        jit_registry.register_jit(
+            "shard.sharded_step",
+            sharded_step,
+            state_args=(1,),
+            collective=True,
+            max_signatures=2,
+            suppress=("boundary-donation",),
+            notes="state donation deferred: mesh A/B harnesses reuse the "
+            "pre-step state for parity runs (ROADMAP item 3 picks this up "
+            "when tables carry sharding specs natively)",
+        ),
+        nparts,
+    )
 
 
 def build_frame_exchange(mesh: Mesh, slots: int, frame_bytes: int):
@@ -224,13 +238,19 @@ def build_frame_exchange(mesh: Mesh, slots: int, frame_bytes: int):
         return out_buf[None], out_lens[None], out_pids[None]
 
     spec = P(axis)
-    fn = jax.jit(_shard_map(
-        shard_fn,
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=(spec, spec, spec),
-        check_vma=False,
-    ))
+    fn = jit_registry.register_jit(
+        "shard.frame_exchange",
+        _shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=(spec, spec, spec),
+            check_vma=False,
+        ),
+        collective=True,
+        max_signatures=2,
+        notes="pure permutation of wire frames; carries no engine state",
+    )
     n = mesh.devices.shape[0]
 
     def exchange(buf, lens, pids):
@@ -430,7 +450,16 @@ def build_sharded_drive(
         )
         return fn(graph, state, queue, now)
 
-    return jax.jit(drive)
+    return jit_registry.register_jit(
+        "shard.sharded_drive",
+        drive,
+        state_args=(1,),
+        collective=True,
+        max_signatures=2,
+        suppress=("boundary-donation",),
+        notes="state donation deferred with shard.sharded_step (parity "
+        "A/B harnesses reuse the pre-drive state)",
+    )
 
 
 def make_partitioned_queue(num_partitions: int, capacity: int, num_vars: int):
