@@ -786,7 +786,7 @@ def _ensure_mesh_devices(n):
 
 def run_mesh_serving(mesh=True, partitions=8, devices=8, clients=8,
                      instances_per_client=8, resident=0, duration_sec=120,
-                     capacity=None, seed=11):
+                     capacity=None, seed=11, sharded=0):
     """MESH-SHARDED serving: one broker, ``partitions`` leader partitions
     placed across ``devices`` devices (scheduler/placement.DevicePlan), the
     shared-wave drain dispatching different partitions' segments to
@@ -824,6 +824,9 @@ def run_mesh_serving(mesh=True, partitions=8, devices=8, clients=8,
     cfg.engine.capacity = capacity
     cfg.mesh.enabled = mesh
     cfg.mesh.devices = devices
+    # sharded-STATE serving: each leader partition's tables block-shard
+    # over a span of `sharded` devices instead of committing to one
+    cfg.mesh.sharded_partitions = int(sharded)
     broker = ClusterBroker(
         cfg, tempfile.mkdtemp(),
         engine_factory=engine_factory_from_config(cfg),
@@ -854,6 +857,8 @@ def run_mesh_serving(mesh=True, partitions=8, devices=8, clients=8,
                                reason="CONNECTION_INFLIGHT").value,
                 "shed_queue": c("gateway_commands_shed",
                                 reason="QUEUE_DEPTH").value,
+                "sharded_waves": c("serving_sharded_waves_total").value,
+                "shard_exchange": c("mesh_shard_exchange_bytes_total").value,
             }
             for d in range(devices):
                 out[f"dev{d}"] = c(
@@ -991,6 +996,11 @@ def run_mesh_serving(mesh=True, partitions=8, devices=8, clients=8,
         return {
             "config": "mesh-serving",
             "mesh": mesh,
+            "sharded_state": int(sharded),
+            "sharded_waves": int(c1["sharded_waves"] - c0["sharded_waves"]),
+            "shard_exchange_bytes": int(
+                c1["shard_exchange"] - c0["shard_exchange"]
+            ),
             "partitions": partitions,
             "devices": devices,
             "resident_instances": resident_created,
@@ -1172,6 +1182,150 @@ def run_mesh_ab(smoke=False, partitions=8, devices=8, resident=0,
         "mesh": mesh,
         "single_device_baseline": single,
         "throughput_ratio_mesh_over_single": (
+            round(speedup, 2) if speedup else None
+        ),
+    }
+
+
+def _sharded_state_parity(shards):
+    """Deterministic sharded-STATE leg (the smoke's non-timing asserts):
+    the same single-partition workload drained once with the engine's
+    tables block-sharded over ``shards`` devices and once on the default
+    single device must produce BIT-IDENTICAL frames AND raw on-disk
+    segment bytes — and the sharded drain must stamp the routing metrics
+    (per-shard row split, cross-shard gather bytes, sharded wave count)."""
+    import itertools
+    import tempfile
+
+    from zeebe_tpu.engine.interpreter import WorkflowRepository
+    from zeebe_tpu.gateway import JobWorker, ZeebeClient
+    from zeebe_tpu.gateway import workers as workers_mod
+    from zeebe_tpu.models.bpmn.builder import Bpmn
+    from zeebe_tpu.protocol import codec
+    from zeebe_tpu.protocol.intents import WorkflowInstanceIntent
+    from zeebe_tpu.protocol.records import WorkflowInstanceRecord
+    from zeebe_tpu.runtime import Broker, ControlledClock
+    from zeebe_tpu.runtime.metrics import GLOBAL_REGISTRY
+    from zeebe_tpu.tpu import TpuPartitionEngine
+
+    def run(data_dir, state_shards):
+        workers_mod._subscriber_keys = itertools.count(1)
+        clock = ControlledClock(start_ms=1_000_000)
+        repo = WorkflowRepository()
+
+        def factory(pid):
+            return TpuPartitionEngine(
+                pid, 1, repository=repo, clock=clock, capacity=1024,
+                state_shards=state_shards,
+            )
+
+        broker = Broker(
+            num_partitions=1, data_dir=data_dir, clock=clock,
+            engine_factory=factory,
+        )
+        broker.wave_size = 128
+        try:
+            client = ZeebeClient(broker)
+            client.deploy_model(
+                Bpmn.create_process("shst")
+                .start_event("s")
+                .service_task("w", type="shst-svc")
+                .end_event("e")
+                .done()
+            )
+            JobWorker(broker, "shst-svc", lambda ctx: {"ok": True})
+            for burst in range(3):
+                for i in range(24):
+                    broker.write_command(
+                        0,
+                        WorkflowInstanceRecord(
+                            bpmn_process_id="shst",
+                            payload={"b": burst, "i": i},
+                        ),
+                        WorkflowInstanceIntent.CREATE,
+                    )
+                broker.run_until_idle()
+            frames = [codec.encode_record(r) for r in broker.records(0)]
+        finally:
+            broker.close()
+        pdir = os.path.join(data_dir, "partition-0")
+        raw = []
+        for name in sorted(os.listdir(pdir)):
+            if name.startswith("segment-") and name.endswith(".log"):
+                with open(os.path.join(pdir, name), "rb") as f:
+                    raw.append(f.read())
+        return frames, raw
+
+    c = GLOBAL_REGISTRY.counter
+    waves0 = c("serving_sharded_waves_total").value
+    bytes0 = c("mesh_shard_exchange_bytes_total").value
+    with tempfile.TemporaryDirectory() as root:
+        frames_sh, raw_sh = run(os.path.join(root, "sh"), shards)
+        waves1 = c("serving_sharded_waves_total").value
+        bytes1 = c("mesh_shard_exchange_bytes_total").value
+        frames_un, raw_un = run(os.path.join(root, "un"), 1)
+    assert len(frames_sh) > 100, f"workload too small ({len(frames_sh)})"
+    assert frames_sh == frames_un, "frames diverged under sharded state"
+    assert raw_sh and raw_sh == raw_un, (
+        "raw segment bytes diverged under sharded state"
+    )
+    sharded_waves = int(waves1 - waves0)
+    exchange_bytes = int(bytes1 - bytes0)
+    assert sharded_waves > 0, "no waves took the sharded step program"
+    assert exchange_bytes > 0, "no cross-shard gather bytes accounted"
+    shard_rows = [
+        int(GLOBAL_REGISTRY.gauge("mesh_shard_rows", device=str(d)).value)
+        for d in range(shards)
+    ]
+    return {
+        "shards": shards,
+        "records": len(frames_sh),
+        "sharded_waves": sharded_waves,
+        "shard_exchange_bytes": exchange_bytes,
+        "last_wave_shard_rows": shard_rows,
+        "bit_identical": True,
+    }
+
+
+def run_sharded_state_ab(smoke=False, shards=8, partitions=2, clients=8,
+                         instances_per_client=8, resident=0):
+    """Sharded-STATE A/B (ISSUE 19): partitions whose tables block-shard
+    over a span of devices vs single-device placement at EQUAL offered
+    load (same scheduler, same traffic), plus the deterministic
+    in-process bit-identity leg. ``--smoke`` keeps the non-timing asserts
+    at CI scale."""
+    devices = _ensure_mesh_devices(shards)
+    if devices < 2:
+        raise RuntimeError(
+            f"sharded-state bench needs >= 2 devices, have {devices}"
+        )
+    shards = min(shards, devices)
+    parity = _sharded_state_parity(4 if smoke else shards)
+    if smoke:
+        kw = dict(partitions=2, devices=devices, clients=4,
+                  instances_per_client=3, duration_sec=60)
+        sh = run_mesh_serving(mesh=True, sharded=min(4, devices), **kw)
+        assert sh["shed"] == 0, f"nominal load shed {sh['shed']} commands"
+        assert sh["completed"] == sh["instances"], (
+            f"lost instances: {sh['completed']}/{sh['instances']}"
+        )
+        assert sh["sharded_waves"] > 0, "no waves took the sharded program"
+        return {"config": "sharded-state-smoke", "parity": parity,
+                "sharded": sh}
+    kw = dict(partitions=partitions, devices=devices, clients=clients,
+              instances_per_client=instances_per_client, resident=resident)
+    sh = run_mesh_serving(mesh=True, sharded=shards, **kw)
+    single = run_mesh_serving(mesh=True, sharded=0, **kw)
+    speedup = (
+        sh["records_per_sec"] / single["records_per_sec"]
+        if single["records_per_sec"] else None
+    )
+    return {
+        "config": "sharded-state-ab",
+        "parity": parity,
+        "sharded": sh,
+        "single_device_baseline": single,
+        "throughput_ratio_sharded_over_single": (
             round(speedup, 2) if speedup else None
         ),
     }
@@ -1920,6 +2074,44 @@ def main():
         if "--trickle" in sys.argv:
             kw["trickle_ms"] = 25
         result = run_multi_tenant_ab(engine=engine, **kw)
+        print(json.dumps(result, indent=2))
+        return
+
+    if "--sharded-state" in sys.argv:
+        # mesh-SHARDED partition state A/B (ISSUE 19): each partition's
+        # tables block-shard over a device span vs single-device
+        # placement at equal offered load. Same backend-probe +
+        # virtual-mesh bootstrap contract as --mesh.
+        backend, _status, err = _probe_backend(
+            timeout_sec=int(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
+        )
+        if err:
+            _progress(f"device unavailable ({err}); sharded-state on CPU")
+
+        def _arg(name, default):
+            if name in sys.argv:
+                return int(sys.argv[sys.argv.index(name) + 1])
+            return default
+
+        if backend == "cpu":
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                n = _arg("--shards", 8)
+                os.environ["XLA_FLAGS"] = (
+                    flags
+                    + f" --xla_force_host_platform_device_count={n}"
+                ).strip()
+                os.execv(sys.executable, [sys.executable] + sys.argv)
+
+        result = run_sharded_state_ab(
+            smoke="--smoke" in sys.argv,
+            shards=_arg("--shards", 8),
+            partitions=_arg("--partitions", 2),
+            clients=_arg("--clients", 8),
+            instances_per_client=_arg("--instances", 8),
+            resident=_arg("--resident", 0),
+        )
         print(json.dumps(result, indent=2))
         return
 

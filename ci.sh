@@ -76,6 +76,12 @@ echo "   single-device drain, zero sheds at nominal load) =="
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
   python bench.py --mesh --smoke > /dev/null
 
+echo "== sharded-state smoke (one partition's tables block-sharded over"
+echo "   the mesh span: frames AND raw segment bytes bit-identical to the"
+echo "   single-device engine, sharded waves observed, zero sheds) =="
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python bench.py --sharded-state --smoke > /dev/null
+
 echo "== full test suite (tier-1; run './ci.sh slow' for the slow tier) =="
 python -m pytest tests/ -x -q -m "not slow" --ignore=tests/test_chaos.py --ignore=tests/test_exporters.py
 

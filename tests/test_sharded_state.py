@@ -1,0 +1,695 @@
+"""Mesh-sharded partition state (ISSUE 19): one partition's
+instance/job/timer/message tables block-shard over a mesh span, the step
+gathers them per wave and keeps local row blocks on write — and the hard
+contract is the same as mesh placement (test_mesh.py): sharding is a
+WHERE change, never a WHAT change. Logs (frames AND raw segment bytes)
+are bit-identical to the single-device engine, key-hash routing is
+deterministic and host/device-agreed, snapshots round-trip across shard
+counts, and a fixed-seed crash-stop replays to the identical log."""
+
+import dataclasses
+import itertools
+import os
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from zeebe_tpu.runtime.metrics import GLOBAL_REGISTRY, event_count
+from zeebe_tpu.scheduler import PartitionFeed, WaveScheduler
+from zeebe_tpu.scheduler.placement import DevicePlan
+from zeebe_tpu.tpu import shard
+from zeebe_tpu.tpu import state as state_mod
+
+SEED = 0x5A4DED
+
+
+# ---------------------------------------------------------------------------
+# key-hash routing: deterministic, host == device
+# ---------------------------------------------------------------------------
+
+
+def _key_corpus():
+    rng = np.random.default_rng(SEED)
+    keys = np.concatenate([
+        np.arange(0, 256, dtype=np.int64),
+        rng.integers(1, 1 << 62, size=256, dtype=np.int64),
+        np.array([0, 1, (1 << 62) - 1, np.iinfo(np.int64).max], np.int64),
+    ])
+    return keys
+
+
+class TestKeyHashRouting:
+    def test_host_and_device_hash_agree(self):
+        """shard_of_key (device) and shard_of_key_host (wave staging) are
+        the same function — the routing plane has ONE hash."""
+        keys = _key_corpus()
+        for ns in (2, 3, 4, 8):
+            dev = np.asarray(shard.shard_of_key(jnp.asarray(keys), ns))
+            host = shard.shard_of_key_host(keys, ns)
+            np.testing.assert_array_equal(dev, host)
+            assert host.min() >= 0 and host.max() < ns
+
+    def test_routing_is_deterministic_and_key_only(self):
+        """Same key → same shard, independent of position in the wave or
+        of any other key in it."""
+        keys = _key_corpus()
+        a = shard.shard_of_key_host(keys, 8)
+        b = shard.shard_of_key_host(keys, 8)
+        np.testing.assert_array_equal(a, b)
+        perm = np.random.default_rng(SEED + 1).permutation(len(keys))
+        np.testing.assert_array_equal(
+            shard.shard_of_key_host(keys[perm], 8), a[perm]
+        )
+
+    def test_row_counts_match_host_and_respect_valid(self):
+        keys = _key_corpus()
+        valid = np.random.default_rng(SEED + 2).random(len(keys)) < 0.7
+        for ns in (2, 8):
+            dev = np.asarray(
+                shard.shard_row_counts(jnp.asarray(keys), jnp.asarray(valid), ns)
+            )
+            host = shard.shard_row_counts_host(keys, valid, ns)
+            np.testing.assert_array_equal(dev, host)
+            assert host.sum() == valid.sum()
+
+    def test_hash_spreads_sequential_keys(self):
+        """Entity keys are near-sequential (per-partition counters); the
+        Fibonacci hash must still spread them instead of striping."""
+        counts = shard.shard_row_counts_host(
+            np.arange(1, 4097, dtype=np.int64), np.ones(4096, bool), 8
+        )
+        assert counts.min() > 0
+        assert counts.max() < 2 * counts.mean()
+
+
+# ---------------------------------------------------------------------------
+# spec tree + exchange model
+# ---------------------------------------------------------------------------
+
+
+class TestStateShardingSpecs:
+    def _state(self):
+        return state_mod.make_state(
+            capacity=256, num_vars=8, job_capacity=256, sub_capacity=8
+        )
+
+    def _zipped(self, state, ns):
+        specs = shard.state_partition_specs(state, ns)
+        leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+        spec_leaves = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )
+        assert len(leaves) == len(spec_leaves)
+        return [
+            (jax.tree_util.keystr(path), leaf, s)
+            for (path, leaf), s in zip(leaves, spec_leaves)
+        ]
+
+    def test_row_tables_shard_and_scalars_replicate(self):
+        state = self._state()
+        sharded = {
+            name for name, _, s in self._zipped(state, 8)
+            if tuple(s) == (shard.STATE_AXIS,)
+        }
+        # the big row-table families are sharded...
+        for fam in ("ei_i32", "job_i32", "timer_key", "ei_pay"):
+            assert any(fam in n for n in sharded), f"{fam} not sharded"
+        # ...and every scalar/rank-0 leaf stays replicated
+        for name, leaf, s in self._zipped(state, 8):
+            if np.ndim(leaf) == 0:
+                assert tuple(s) == (), f"scalar {name} got spec {s}"
+
+    def test_sharded_leaves_divide_evenly(self):
+        state = self._state()
+        for name, leaf, s in self._zipped(state, 8):
+            if tuple(s) == (shard.STATE_AXIS,):
+                assert leaf.shape[0] % 8 == 0, name
+
+    def test_non_divisible_tables_fall_back_replicated(self):
+        """num_shards that doesn't divide a table's rows must NOT shard it
+        (correctness never depends on which leaves shard)."""
+        state = self._state()
+        for name, leaf, s in self._zipped(state, 7):
+            if tuple(s) == (shard.STATE_AXIS,):
+                assert leaf.shape[0] % 7 == 0, name
+
+    def test_exchange_bytes_scale_with_span(self):
+        """One wave's gather volume is sharded_bytes * (D-1): zero on a
+        single device, linear in the span beyond it."""
+        state = self._state()
+        assert shard.state_exchange_bytes(state, 1) == 0
+        eb2 = shard.state_exchange_bytes(state, 2)
+        eb8 = shard.state_exchange_bytes(state, 8)
+        assert eb2 > 0
+        assert eb8 == 7 * eb2
+
+
+# ---------------------------------------------------------------------------
+# DevicePlan spans
+# ---------------------------------------------------------------------------
+
+
+class TestDevicePlanSpans:
+    def test_span_assignment_sticky_and_sorted(self):
+        plan = DevicePlan(devices=list("abcdefgh"))
+        got = plan.assign_span(0, 4)
+        assert got == sorted(got) and len(got) == 4
+        assert plan.assign_span(0, 4) == got  # sticky
+        assert plan.device_indices(0) == got
+        assert plan.devices_for(0) == [plan.devices[i] for i in got]
+        assert plan.device_index(0) == got[0]  # primary
+
+    def test_spans_balance_across_the_mesh(self):
+        plan = DevicePlan(devices=list("abcdefgh"))
+        s0 = plan.assign_span(0, 4)
+        s1 = plan.assign_span(1, 4)
+        assert not set(s0) & set(s1), "second span landed on loaded devices"
+        load = plan.load()
+        assert all(load[i] == 1 for i in range(8))
+
+    def test_span_of_one_degenerates_to_assign(self):
+        plan = DevicePlan(devices=list("ab"))
+        assert plan.assign_span(3, 1) == [plan.device_index(3)]
+        assert plan.device_indices(3) == [plan.device_index(3)]
+
+    def test_release_frees_the_whole_span(self):
+        plan = DevicePlan(devices=list("abcd"))
+        plan.assign_span(0, 4)
+        plan.release(0)
+        assert plan.device_indices(0) == []
+        assert all(v == 0 for v in plan.load().values())
+
+    def test_exclude_respans_sharded_victims(self):
+        plan = DevicePlan(devices=list("abcdefgh"))
+        span = plan.assign_span(0, 4)
+        victim = span[1]
+        moves = plan.exclude(victim)
+        assert 0 in moves
+        new_span = plan.device_indices(0)
+        assert len(new_span) == 4
+        assert victim not in new_span
+        assert moves[0] == new_span[0]
+
+    def test_span_larger_than_healthy_mesh_raises(self):
+        plan = DevicePlan(devices=list("ab"))
+        plan.exclude(0)
+        with pytest.raises(RuntimeError, match="exceeds the 1 healthy"):
+            plan.assign_span(0, 2)
+
+
+# ---------------------------------------------------------------------------
+# scheduler: a sharded segment's wave counts its WHOLE span active
+# ---------------------------------------------------------------------------
+
+
+class _Rec:
+    __slots__ = ("position",)
+
+    def __init__(self, position):
+        self.position = position
+
+
+class _SpanFeed(PartitionFeed):
+    def __init__(self, pid, n, span):
+        self.partition_id = pid
+        self.device_index = span[0]
+        self.device_indices = tuple(span)
+        self.cursor = 0
+        self.limit_n = n
+
+    def backlog(self):
+        return self.limit_n - self.cursor
+
+    def take(self, limit):
+        take = min(limit, self.limit_n - self.cursor)
+        out = [_Rec(self.cursor + i) for i in range(take)]
+        self.cursor += take
+        return out
+
+    def dispatch(self, records):
+        return list(records), 0.0, 0.0
+
+    def collect(self, pending):
+        return 0.0, 0.0
+
+    def rewind(self, position):
+        self.cursor = min(self.cursor, position)
+
+
+class TestSchedulerSpanAccounting:
+    def test_wave_devices_gauge_counts_the_span(self):
+        ws = WaveScheduler(wave_size=64)
+        ws.register(_SpanFeed(0, 16, (0, 2, 5)))
+        ws.drain()
+        assert GLOBAL_REGISTRY.gauge("serving_wave_devices").value == 3
+
+
+# ---------------------------------------------------------------------------
+# engine guards
+# ---------------------------------------------------------------------------
+
+
+class TestShardedEngineGuards:
+    def test_pinned_device_conflicts_with_sharding(self):
+        from zeebe_tpu.tpu import TpuPartitionEngine
+
+        with pytest.raises(ValueError, match="cannot also be pinned"):
+            TpuPartitionEngine(
+                0, 1, state_shards=2, device=jax.devices()[0], device_index=0
+            )
+
+    def test_span_larger_than_devices_raises(self):
+        from zeebe_tpu.tpu import TpuPartitionEngine
+
+        with pytest.raises(ValueError, match="needs that many devices"):
+            TpuPartitionEngine(0, 1, state_shards=64)
+
+    def test_sharded_engine_refuses_live_migration(self):
+        """place_on is the single-device fallback path; a sharded engine
+        is pinned to its span and rebuilds via snapshot → restore."""
+        from zeebe_tpu.tpu import TpuPartitionEngine
+
+        engine = TpuPartitionEngine(0, 1, capacity=256, state_shards=2)
+        assert engine.device_indices == [0, 1]
+        assert engine._shard_exchange_bytes > 0
+        with pytest.raises(RuntimeError, match="pinned to its mesh span"):
+            engine.place_on(jax.devices()[0], 0)
+
+
+# ---------------------------------------------------------------------------
+# serving parity: sharded tables, identical logs
+# ---------------------------------------------------------------------------
+
+
+def _sharded_workload(data_dir, state_shards, engine_box=None):
+    """Single-partition device-engine workload (service task + timer —
+    instance, job AND timer tables all see traffic); returns
+    (frames, raw segment bytes)."""
+    from zeebe_tpu.engine.interpreter import WorkflowRepository
+    from zeebe_tpu.gateway import JobWorker, ZeebeClient
+    from zeebe_tpu.gateway import workers as workers_mod
+    from zeebe_tpu.models.bpmn.builder import Bpmn
+    from zeebe_tpu.protocol import codec
+    from zeebe_tpu.protocol.intents import WorkflowInstanceIntent
+    from zeebe_tpu.protocol.records import WorkflowInstanceRecord
+    from zeebe_tpu.runtime import Broker, ControlledClock
+    from zeebe_tpu.tpu import TpuPartitionEngine
+
+    workers_mod._subscriber_keys = itertools.count(1)
+    clock = ControlledClock(start_ms=1_000_000)
+    repo = WorkflowRepository()
+
+    def factory(pid):
+        engine = TpuPartitionEngine(
+            pid, 1, repository=repo, clock=clock, capacity=1 << 10,
+            state_shards=state_shards,
+        )
+        if engine_box is not None:
+            engine_box.append(engine)
+        return engine
+
+    broker = Broker(
+        num_partitions=1, data_dir=data_dir, clock=clock,
+        engine_factory=factory,
+    )
+    broker.wave_size = 128
+    try:
+        client = ZeebeClient(broker)
+        client.deploy_model(
+            Bpmn.create_process("shst")
+            .start_event("s")
+            .service_task("w", type="shst-svc")
+            .timer_catch_event("cool", duration_ms=5_000)
+            .end_event("e")
+            .done()
+        )
+        JobWorker(broker, "shst-svc", lambda ctx: {"ok": True})
+        for burst in range(2):
+            for i in range(16):
+                broker.write_command(
+                    0,
+                    WorkflowInstanceRecord(
+                        bpmn_process_id="shst", payload={"b": burst, "i": i}
+                    ),
+                    WorkflowInstanceIntent.CREATE,
+                )
+            broker.run_until_idle()
+            clock.advance(10_000)
+            broker.tick()
+            broker.run_until_idle()
+        frames = [codec.encode_record(r) for r in broker.records(0)]
+    finally:
+        broker.close()
+    blobs = []
+    pdir = os.path.join(data_dir, "partition-0")
+    for name in sorted(os.listdir(pdir)):
+        if name.startswith("segment-") and name.endswith(".log"):
+            with open(os.path.join(pdir, name), "rb") as f:
+                blobs.append(f.read())
+    return frames, blobs
+
+
+class TestShardedServingParity:
+    def test_sharded_vs_single_device_logs_bit_identical(self, tmp_path):
+        """THE parity pin (acceptance): frames AND raw on-disk segment
+        bytes identical with the tables sharded over all 8 devices — and
+        the waves actually rode the sharded step (metrics prove it)."""
+        waves0 = GLOBAL_REGISTRY.counter("serving_sharded_waves_total").value
+        bytes0 = GLOBAL_REGISTRY.counter("mesh_shard_exchange_bytes_total").value
+        box = []
+        frames_sh, raw_sh = _sharded_workload(
+            str(tmp_path / "sh"), 8, engine_box=box
+        )
+        d_waves = (
+            GLOBAL_REGISTRY.counter("serving_sharded_waves_total").value - waves0
+        )
+        d_bytes = (
+            GLOBAL_REGISTRY.counter("mesh_shard_exchange_bytes_total").value
+            - bytes0
+        )
+        frames_un, raw_un = _sharded_workload(str(tmp_path / "un"), 1)
+        assert len(frames_sh) > 100
+        assert frames_sh == frames_un, "frames diverged under sharding"
+        assert raw_sh and raw_sh == raw_un, "raw segment bytes diverged"
+        # the sharded run really ran sharded
+        engine = box[0]
+        assert engine.device_indices == list(range(8))
+        assert engine.sharded_waves > 0
+        assert d_waves >= engine.sharded_waves
+        assert d_bytes >= engine.sharded_waves * engine._shard_exchange_bytes
+        # per-shard routing gauges populated for the whole span
+        for d in range(8):
+            assert (
+                GLOBAL_REGISTRY.gauge("mesh_shard_rows", device=str(d)).value
+                >= 0
+            )
+
+
+# ---------------------------------------------------------------------------
+# cross-shard correlation: sharded partition, same wire bytes
+# ---------------------------------------------------------------------------
+
+
+def _correlation_workload(data_dir, sharded):
+    """Two partitions, every subscription OPEN/CORRELATE forced across
+    them; partition 0 optionally shards its tables over 4 devices."""
+    from zeebe_tpu.engine.interpreter import WorkflowRepository
+    from zeebe_tpu.gateway import ZeebeClient
+    from zeebe_tpu.gateway import workers as workers_mod
+    from zeebe_tpu.models.bpmn.builder import Bpmn
+    from zeebe_tpu.protocol import codec
+    from zeebe_tpu.runtime import Broker, ControlledClock
+    from zeebe_tpu.tpu import TpuPartitionEngine
+
+    workers_mod._subscriber_keys = itertools.count(1)
+    clock = ControlledClock(start_ms=1_000_000)
+    repo = WorkflowRepository()
+
+    def factory(pid):
+        if sharded and pid == 0:
+            return TpuPartitionEngine(
+                pid, 2, repository=repo, clock=clock, capacity=1 << 10,
+                state_shards=4, shard_devices=jax.devices()[:4],
+            )
+        return TpuPartitionEngine(
+            pid, 2, repository=repo, clock=clock, capacity=1 << 10
+        )
+
+    broker = Broker(
+        num_partitions=2, data_dir=data_dir, clock=clock,
+        engine_factory=factory,
+    )
+    try:
+        client = ZeebeClient(broker)
+        client.deploy_model(
+            Bpmn.create_process("xshard")
+            .start_event("s")
+            .receive_task("wait", message_name="paid",
+                          correlation_key="$.oid")
+            .end_event("e")
+            .done()
+        )
+        for i in range(6):
+            # "k-i" hashes to partition i % 2; creating on the OTHER
+            # partition forces the subscription hop across partitions —
+            # for even i the subscription lands IN the sharded tables
+            client.create_instance(
+                "xshard", {"oid": f"k-{i}"}, partition_id=(i + 1) % 2
+            )
+        broker.run_until_idle()
+        for i in range(6):
+            client.publish_message("paid", f"k-{i}")
+        broker.run_until_idle()
+        return [
+            [codec.encode_record(r) for r in broker.records(pid)]
+            for pid in range(2)
+        ]
+    finally:
+        broker.close()
+
+
+@pytest.mark.slow
+class TestCrossShardCorrelation:
+    def test_correlation_parity_with_sharded_partition(self, tmp_path):
+        """Cross-partition message correlation with one side's tables
+        mesh-sharded produces EXACTLY the transport path's logs — the
+        budgeted cross-shard gathers never change a correlation."""
+        frames_sh = _correlation_workload(str(tmp_path / "sh"), True)
+        frames_un = _correlation_workload(str(tmp_path / "un"), False)
+        assert sum(len(f) for f in frames_sh) > 50
+        for pid, (a, b) in enumerate(zip(frames_sh, frames_un)):
+            assert a == b, f"partition {pid} diverged (sharded vs plain)"
+
+
+# ---------------------------------------------------------------------------
+# snapshot / restore across shard counts
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestShardedSnapshotRestore:
+    # lookup structures re-derive from live rows at restore
+    DERIVED = {
+        "ei_map", "ei_index", "job_map", "job_index",
+        "free_ei", "free_ei_pop", "free_ei_push",
+        "free_job", "free_job_pop", "free_job_push",
+    }
+
+    def _assert_states_equal(self, ea, eb):
+        norm_a = state_mod.rebuild_lookup_state(ea.state)
+        norm_b = state_mod.rebuild_lookup_state(eb.state)
+        for f in dataclasses.fields(ea.state):
+            if f.name.startswith("sub_"):
+                continue  # transient worker subscriptions drop on restore
+            src_a = norm_a if f.name in self.DERIVED else ea.state
+            src_b = norm_b if f.name in self.DERIVED else eb.state
+            a, b = getattr(src_a, f.name), getattr(src_b, f.name)
+            if hasattr(a, "keys"):
+                np.testing.assert_array_equal(
+                    np.asarray(a.keys), np.asarray(b.keys), err_msg=f.name
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(a.vals), np.asarray(b.vals), err_msg=f.name
+                )
+            else:
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b), err_msg=f.name
+                )
+
+    def test_round_trip_across_shard_counts(self, tmp_path):
+        """A snapshot taken from an 8-way sharded engine restores
+        bit-exactly into a 4-way sharded engine AND into a plain
+        single-device engine: the snapshot is shard-layout-free."""
+        from zeebe_tpu.engine.interpreter import WorkflowRepository
+        from zeebe_tpu.runtime import ControlledClock
+        from zeebe_tpu.tpu import TpuPartitionEngine
+
+        box = []
+        _sharded_workload(str(tmp_path / "w"), 8, engine_box=box)
+        engine = box[0]
+        snap = engine.snapshot_state()
+
+        clock = ControlledClock(start_ms=1_000_000)
+        for shards in (4, 1):
+            restored = TpuPartitionEngine(
+                0, 1, repository=WorkflowRepository(), clock=clock,
+                capacity=1 << 10, state_shards=shards,
+            )
+            restored.restore_state(snap)
+            self._assert_states_equal(engine, restored)
+            if shards > 1:
+                # the restored engine is still sharded end to end
+                assert restored._mesh is not None
+                assert restored._state_step is not None
+                assert restored._shard_exchange_bytes > 0
+                assert len(restored.state.ei_i32.devices()) == shards
+
+
+# ---------------------------------------------------------------------------
+# fixed-seed chaos: crash-stop replay + (slow) leader flap on a span
+# ---------------------------------------------------------------------------
+
+
+def _chaos_run(data_dir, state_shards, crash):
+    """Seeded two-burst workload with an optional crash-stop between the
+    bursts (close + reopen from the same log dir: replay rebuilds the
+    sharded tables). Returns the final frame list."""
+    from zeebe_tpu.engine.interpreter import WorkflowRepository
+    from zeebe_tpu.gateway import JobWorker, ZeebeClient
+    from zeebe_tpu.gateway import workers as workers_mod
+    from zeebe_tpu.models.bpmn.builder import Bpmn
+    from zeebe_tpu.protocol import codec
+    from zeebe_tpu.protocol.intents import WorkflowInstanceIntent
+    from zeebe_tpu.protocol.records import WorkflowInstanceRecord
+    from zeebe_tpu.runtime import Broker, ControlledClock
+    from zeebe_tpu.tpu import TpuPartitionEngine
+
+    rnd = random.Random(SEED)
+    clock = ControlledClock(start_ms=1_000_000)
+
+    def boot():
+        workers_mod._subscriber_keys = itertools.count(1)
+        repo = WorkflowRepository()
+        broker = Broker(
+            num_partitions=1, data_dir=data_dir, clock=clock,
+            engine_factory=lambda pid: TpuPartitionEngine(
+                pid, 1, repository=repo, clock=clock, capacity=1 << 10,
+                state_shards=state_shards,
+            ),
+        )
+        broker.wave_size = 128
+        JobWorker(broker, "chaos-svc", lambda ctx: {"ok": True})
+        return broker
+
+    def burst(broker, b):
+        for i in range(12):
+            broker.write_command(
+                0,
+                WorkflowInstanceRecord(
+                    bpmn_process_id="chaos",
+                    payload={"b": b, "i": i, "r": rnd.randrange(1_000_000)},
+                ),
+                WorkflowInstanceIntent.CREATE,
+            )
+        broker.run_until_idle()
+
+    broker = boot()
+    try:
+        ZeebeClient(broker).deploy_model(
+            Bpmn.create_process("chaos")
+            .start_event("s")
+            .service_task("w", type="chaos-svc")
+            .end_event("e")
+            .done()
+        )
+        burst(broker, 0)
+        if crash:
+            broker.close()
+            broker = boot()
+            # replay alone must rebuild the state: running to quiescence
+            # appends NOTHING new (no duplicated side effects)
+            n_records = len(broker.records(0))
+            broker.run_until_idle()
+            assert len(broker.records(0)) == n_records
+        burst(broker, 1)
+        return [codec.encode_record(r) for r in broker.records(0)]
+    finally:
+        broker.close()
+
+
+@pytest.mark.slow
+class TestShardedChaos:
+    def test_fixed_seed_crash_stop_replays_identically(self, tmp_path):
+        """Acceptance chaos leg: a crash-stop mid-run on a 4-way sharded
+        partition replays from the log and finishes with EXACTLY the
+        frames of a single-device run under the SAME seeded fault
+        schedule (same-schedule control isolates the sharding variable;
+        transient gateway request ids reset on ANY restart, sharded or
+        not, so a no-crash oracle can never be byte-identical)."""
+        frames_sharded = _chaos_run(str(tmp_path / "c"), 4, crash=True)
+        frames_single = _chaos_run(str(tmp_path / "u"), 1, crash=True)
+        assert len(frames_sharded) > 100
+        assert frames_sharded == frames_single
+
+
+@pytest.mark.slow
+class TestShardedClusterFlap:
+    """Cluster-level leader flap with a sharded span (slow tier with the
+    other device-engine cluster suites)."""
+
+    def test_leader_flap_releases_and_respans(self, tmp_path):
+        import time
+
+        from zeebe_tpu.gateway.cluster_client import ClusterClient
+        from zeebe_tpu.models.bpmn.builder import Bpmn
+        from zeebe_tpu.runtime.cluster_broker import ClusterBroker
+        from zeebe_tpu.runtime.config import BrokerCfg
+        from zeebe_tpu.runtime.engines import engine_factory_from_config
+
+        cfg = BrokerCfg()
+        cfg.network.client_port = 0
+        cfg.network.management_port = 0
+        cfg.network.subscription_port = 0
+        cfg.metrics.port = 0
+        cfg.metrics.enabled = False
+        cfg.cluster.partitions = 1
+        cfg.engine.type = "tpu"
+        cfg.engine.capacity = 1 << 10
+        cfg.mesh.sharded_partitions = 4
+        broker = ClusterBroker(
+            cfg, os.path.join(str(tmp_path), "b0"),
+            engine_factory=engine_factory_from_config(cfg),
+        )
+        client = None
+        try:
+            broker.open_partition(0).join(60)
+            broker.bootstrap_partition(0, {})
+            deadline = time.monotonic() + 60
+            while (
+                time.monotonic() < deadline
+                and not broker.partitions[0].is_leader
+            ):
+                time.sleep(0.02)
+            assert broker.partitions[0].is_leader
+
+            plan = broker.device_plan
+            span = plan.device_indices(0)
+            assert len(span) == 4
+            engine = broker.partitions[0].engine
+            assert engine.device_indices == span
+            assert engine._mesh is not None
+
+            client = ClusterClient(
+                [broker.client_address], num_partitions=1,
+                request_timeout_ms=120_000,
+            )
+            client.deploy_model(
+                Bpmn.create_process("flap").start_event("s").end_event("e")
+                .done()
+            )
+            assert client.create_instance(
+                "flap", partition_id=0
+            ).value.workflow_instance_key > 0
+
+            # leader flap: uninstall frees the WHOLE span, reinstall
+            # re-spans and serving continues on the sharded engine
+            server = broker.partitions[0]
+            term = server.raft.term
+            broker.actor.call(server._uninstall_leader).join(10)
+            assert plan.device_indices(0) == []
+            broker.actor.call(lambda: server._install_leader(term)).join(60)
+            new_span = plan.device_indices(0)
+            assert len(new_span) == 4
+            assert broker.partitions[0].engine.device_indices == new_span
+            assert client.create_instance(
+                "flap", partition_id=0
+            ).value.workflow_instance_key > 0
+        finally:
+            if client is not None:
+                client.close()
+            broker.close()

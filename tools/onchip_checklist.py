@@ -160,6 +160,12 @@ def main() -> int:
          {"ZB_BENCH_ENGINE": "tpu"}),
         # PR 9: mesh serving A/B across the real chips
         ("mesh_bench", [py, "bench.py", "--mesh"] + smoke, 7200),
+        # ISSUE 19: mesh-SHARDED partition state — tables block-shard
+        # over a span of real chips, bit-identity + A/B vs single-device
+        # placement at equal offered load (the gathers ride real ICI
+        # here; the CPU run only models them)
+        ("sharded_state_bench",
+         [py, "bench.py", "--sharded-state"] + smoke, 7200),
         # PR 10 (kernel round 8): the mega-gather/emit families — the
         # autotune step above already tables their A/B and the
         # pallas_ops_check step pins their parity; these two legs run the

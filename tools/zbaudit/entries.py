@@ -32,6 +32,7 @@ DRIVER_NAMES = (
     "shard.sharded_step",
     "shard.frame_exchange",
     "shard.sharded_drive",
+    "shard.state_step",
 )
 AUTOTUNE_PREFIX = "autotune."
 
@@ -143,7 +144,7 @@ def build_entries(
             )
 
     shard_names = ("shard.sharded_step", "shard.frame_exchange",
-                   "shard.sharded_drive")
+                   "shard.sharded_drive", "shard.state_step")
     if any(wanted(n) for n in shard_names) and len(jax.devices()) >= 2:
         mesh = Mesh(np.asarray(jax.devices()), ("partitions",))
         nparts = mesh.devices.shape[0]
@@ -217,6 +218,22 @@ def build_entries(
                 "shard.sharded_drive", drive_fn,
                 mgraph, stack(mstate), stack(squeue), now_sds,
                 config={**shard_cfg, "num_vars": mnv, "graph": "config4"},
+            )
+        if wanted("shard.state_step"):
+            # mesh-SHARDED single-partition state (engine state_shards):
+            # ONE partition's tables block-shard over every device; the
+            # step gathers them per wave (the budgeted cross-shard read)
+            # and keeps local row blocks on write. Audited at the census
+            # geometry so the collective pass prices the real gathers;
+            # `state_shards` in the config switches the HBM pass to the
+            # per-device residency model (total / D for sharded leaves).
+            smesh = Mesh(np.asarray(jax.devices()), (shard.STATE_AXIS,))
+            sstep = shard.build_state_step(smesh, state_sds)
+            pid_sds = jax.ShapeDtypeStruct((), jnp.int32)
+            add(
+                "shard.state_step", sstep,
+                graph, state_sds, batch_sds, now_sds, pid_sds,
+                config={**census_cfg, "state_shards": nparts},
             )
 
     if names is None or any(n.startswith(AUTOTUNE_PREFIX) for n in names):

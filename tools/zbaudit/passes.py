@@ -128,11 +128,28 @@ def pass_hbm(audited: List[AuditedEntry], budget: dict, report: dict):
         out_b = sum(aval_bytes(v.aval) for v in jx.outvars)
         aliased = _aliased_bytes(a.text)
         peak = in_b + out_b - aliased
-        model["entries"][a.name] = {
+        entry_model = {
             "arg_bytes": in_b, "out_bytes": out_b,
             "aliased_bytes": aliased, "peak_bytes": peak,
             "config": a.config,
         }
+        # mesh-SHARDED state entries (engine state_shards): the global
+        # args/outputs spread over D devices, so the RESIDENT footprint
+        # per device is total/D; the transient gather-for-compute view
+        # (one full state copy during the step) is priced separately so
+        # the gate still sees the true per-device high-water mark
+        shards = int(a.config.get("state_shards", 0) or 0)
+        if shards > 1:
+            resident = peak // shards
+            gathered = in_b  # the gathered full-table view, freed per wave
+            peak = resident + gathered
+            entry_model.update({
+                "state_shards": shards,
+                "resident_bytes_per_device": resident,
+                "gathered_bytes": gathered,
+                "peak_bytes_per_device": peak,
+            })
+        model["entries"][a.name] = entry_model
         if device_budget and peak > device_budget and not a.suppresses(
             "hbm-budget"
         ):
@@ -286,7 +303,13 @@ def pass_collective(audited: List[AuditedEntry], budget: dict, report: dict):
     ``psum`` in the program body executes once per round). Budget-gated
     for collective entries; non-collective entries must be
     collective-free."""
-    limit = budget.get("collective", {}).get("per_round_budget_bytes")
+    ccfg = budget.get("collective", {})
+    limit = ccfg.get("per_round_budget_bytes")
+    # per-entry overrides: the sharded-STATE step gathers whole tables by
+    # design, orders of magnitude above the frame-exchange budget — each
+    # such entry carries its own ratcheted ceiling instead of inflating
+    # the global one
+    per_entry: Dict[str, int] = ccfg.get("per_entry_budget_bytes", {})
     findings: List[Finding] = []
     per: Dict[str, dict] = {}
     for a in audited:
@@ -305,12 +328,13 @@ def pass_collective(audited: List[AuditedEntry], budget: dict, report: dict):
             total += b
         per[a.name] = {"per_prim": vol, "total_bytes_per_round": total}
         if a.entry.collective:
-            if (limit is not None and total > int(limit)
+            entry_limit = per_entry.get(a.name, limit)
+            if (entry_limit is not None and total > int(entry_limit)
                     and not a.suppresses("collective-volume")):
                 findings.append(a.finding(
                     "collective-volume",
                     f"{fmt_bytes(total)} per round over ICI exceeds the "
-                    f"budget {fmt_bytes(int(limit))} (shrink exchange "
+                    f"budget {fmt_bytes(int(entry_limit))} (shrink exchange "
                     "slots/frames or ratchet the budget with a reason)",
                 ))
         elif vol and not a.suppresses("collective-unexpected"):

@@ -152,6 +152,14 @@ class _BrokerFeed:
         metrics; -1 = unplaced/host engine)."""
         return getattr(self.partition.engine, "device_index", -1)
 
+    @property
+    def device_indices(self):
+        """Span of a sharded-state engine (every plan index its wave
+        computes on); empty for single-device engines."""
+        return tuple(
+            getattr(self.partition.engine, "device_indices", ()) or ()
+        )
+
     def backlog(self) -> int:
         p = self.partition
         return max(0, p.log.commit_position - p.next_read_position + 1)

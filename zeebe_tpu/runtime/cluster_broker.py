@@ -485,6 +485,15 @@ class PartitionServer:
             return -1
         return getattr(self.engine, "device_index", -1)
 
+    @property
+    def device_indices(self):
+        """Every plan index this partition occupies — the span of a
+        sharded-state engine, else empty (scheduler falls back to
+        ``device_index``)."""
+        if self.engine is None:
+            return ()
+        return tuple(getattr(self.engine, "device_indices", ()) or ())
+
     def backlog(self) -> int:
         if not self.is_leader:
             return 0
@@ -1359,6 +1368,20 @@ class ClusterBroker(Actor):
             return None, -1
         idx = plan.assign(partition_id)
         return plan.devices[idx], idx
+
+    def planned_span(self, partition_id: int):
+        """(devices, plan indices) for a SHARDED-state leader partition —
+        a span of ``[mesh] shardedPartitions`` devices its row tables
+        block-shard over. ([], []) when the mesh is disabled or sharding
+        is off; the factory then falls back to ``planned_device``."""
+        span = int(getattr(self.cfg.mesh, "sharded_partitions", 0))
+        if span <= 1:
+            return [], []
+        plan = self._mesh_plan()
+        if plan is None:
+            return [], []
+        indices = plan.assign_span(partition_id, span)
+        return [plan.devices[i] for i in indices], indices
 
     def _mesh_exchange(self):
         """The all_to_all frame exchange, built once over the plan's

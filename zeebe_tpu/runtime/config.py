@@ -144,6 +144,13 @@ class MeshCfg:
     exchange: bool = True
     exchange_slots: int = 32  # frames per (src, dst) device pair per round
     exchange_frame_bytes: int = 1024  # slot width; larger frames fall back
+    # mesh-SHARDED partition state: each leader partition's row tables
+    # block-shard over a span of this many devices (engine
+    # ``state_shards``) — the wave's step gathers the tables over ICI,
+    # computes on the whole span at once, and keeps local row blocks.
+    # 0/1 = single-device placement (the default); replays are
+    # bit-identical either way (tests/test_sharded_state.py pins it)
+    sharded_partitions: int = 0
 
 
 @dataclasses.dataclass
@@ -290,6 +297,7 @@ _ENV_OVERRIDES = {
         lambda v: v.strip().lower() in ("1", "true", "yes"),
     ),
     "ZEEBE_MESH_DEVICES": ("mesh", "devices", int),
+    "ZEEBE_MESH_SHARDED_PARTITIONS": ("mesh", "sharded_partitions", int),
     "ZEEBE_TRACING_ENABLED": (
         "tracing",
         "enabled",

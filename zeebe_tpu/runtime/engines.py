@@ -50,9 +50,24 @@ def engine_factory_from_config(
             # other partitions' waves on other devices
             device = None
             device_index = -1
-            planned = getattr(broker, "planned_device", None)
-            if planned is not None:
-                device, device_index = planned(partition_id)
+            shard_devices = None
+            device_indices = None
+            state_shards = 1
+            # sharded-state span first ([mesh] shardedPartitions > 1):
+            # the partition's tables block-shard over the span instead of
+            # committing to one device
+            spanned = getattr(broker, "planned_span", None)
+            if spanned is not None:
+                shard_devs, shard_idx = spanned(partition_id)
+                if shard_devs:
+                    shard_devices = shard_devs
+                    device_indices = shard_idx
+                    device_index = shard_idx[0]
+                    state_shards = len(shard_devs)
+            if state_shards == 1:
+                planned = getattr(broker, "planned_device", None)
+                if planned is not None:
+                    device, device_index = planned(partition_id)
             engine = TpuPartitionEngine(
                 partition_id,
                 broker.cfg.cluster.partitions,
@@ -63,6 +78,9 @@ def engine_factory_from_config(
                 sub_capacity=sub_capacity,
                 device=device,
                 device_index=device_index,
+                state_shards=state_shards,
+                shard_devices=shard_devices,
+                device_indices=device_indices,
             )
             import jax as _jax
 

@@ -467,6 +467,46 @@ def observe_mesh_wave(devices_active: int) -> None:
     )
 
 
+_SHARDED_WAVE_HANDLES: Dict[str, Metric] = {}
+_SHARD_ROW_HANDLES: Dict[str, Metric] = {}
+
+
+def observe_sharded_wave(shard_rows, exchange_bytes: int) -> None:
+    """Record one wave dispatched through a SHARDED-state partition:
+    ``shard_rows`` is the per-shard row count of the staged batch under
+    key-hash routing (the balance signal operators watch for hot shards),
+    ``exchange_bytes`` the wave's cross-shard table-gather volume."""
+    h = _SHARDED_WAVE_HANDLES
+    if not h:
+        g = GLOBAL_REGISTRY
+        h.update(
+            waves=g.counter(
+                "serving_sharded_waves_total",
+                "Waves dispatched through the mesh-sharded step program",
+            ),
+            exchange=g.counter(
+                "mesh_shard_exchange_bytes_total",
+                "Cross-shard collective bytes moved by sharded-state waves "
+                "(table gathers over the mesh axis)",
+            ),
+        )
+    h["waves"].inc()
+    if exchange_bytes > 0:
+        h["exchange"].inc(exchange_bytes)
+    for i, rows in enumerate(shard_rows):
+        key = str(i)
+        m = _SHARD_ROW_HANDLES.get(key)
+        if m is None:
+            m = GLOBAL_REGISTRY.gauge(
+                "mesh_shard_rows",
+                "Rows of the most recent sharded wave routed to each "
+                "shard by key hash",
+                device=key,
+            )
+            _SHARD_ROW_HANDLES[key] = m
+        m.set(int(rows))
+
+
 def render_with_global(registry: MetricsRegistry, now_ms: Optional[int] = None) -> str:
     """A registry's Prometheus dump with the global event counters appended
     (skipped when the registry IS the global one — no duplicate series)."""

@@ -53,6 +53,10 @@ class DevicePlan:
         self.devices = devices
         self._lock = threading.Lock()
         self._assigned: Dict[int, int] = {}  # partition id → device index
+        # sharded-state partitions occupy a SPAN of devices (their tables
+        # block-shard over the span's mesh axis); the primary index also
+        # lives in _assigned so single-device queries keep working
+        self._spans: Dict[int, List[int]] = {}
         self._excluded: set = set()
         self._rr = 0  # round-robin tie-break cursor
         self._device_gauges: Dict[int, object] = {}  # cached metric handles
@@ -76,13 +80,33 @@ class DevicePlan:
         with self._lock:
             return dict(self._assigned)
 
-    def load(self) -> Dict[int, int]:
-        """Partitions per device index (all devices, excluded included)."""
+    def device_indices(self, partition_id: int) -> List[int]:
+        """Every device index a partition occupies: its span when sharded,
+        the single assignment otherwise, [] when unplaced."""
         with self._lock:
-            counts = {i: 0 for i in range(len(self.devices))}
-            for idx in self._assigned.values():
-                counts[idx] += 1
-            return counts
+            sp = self._spans.get(partition_id)
+            if sp is not None:
+                return list(sp)
+            idx = self._assigned.get(partition_id, -1)
+            return [idx] if idx >= 0 else []
+
+    def devices_for(self, partition_id: int) -> List:
+        return [self.devices[i] for i in self.device_indices(partition_id)]
+
+    def load(self) -> Dict[int, int]:
+        """Partitions per device index (all devices, excluded included).
+        A sharded partition counts on EVERY device of its span."""
+        with self._lock:
+            return self._load_locked(range(len(self.devices)))
+
+    def _load_locked(self, indices) -> Dict[int, int]:
+        counts = {i: 0 for i in indices}
+        for pid, idx in self._assigned.items():
+            sp = self._spans.get(pid)
+            for i in (sp if sp is not None else (idx,)):
+                if i in counts:
+                    counts[i] += 1
+        return counts
 
     # -- placement ---------------------------------------------------------
     def assign(self, partition_id: int) -> int:
@@ -103,16 +127,47 @@ class DevicePlan:
         self._publish_load()
         return idx
 
+    def assign_span(self, partition_id: int, span: int) -> List[int]:
+        """Place a SHARDED-state partition across ``span`` devices — the
+        mesh span its tables block-shard over (engine ``state_shards``).
+        Sticky like :meth:`assign`; picks the least-loaded healthy
+        devices (index tie-break) and returns their indices in mesh
+        order. The first is the primary that ``device_index`` reports."""
+        if span <= 1:
+            return [self.assign(partition_id)]
+        with self._lock:
+            got = self._spans.get(partition_id)
+            if got is not None and not (set(got) & self._excluded):
+                return list(got)
+            chosen = self._pick_span_locked(span)
+            self._spans[partition_id] = chosen
+            self._assigned[partition_id] = chosen[0]
+        count_event(
+            "mesh_span_assigns",
+            "Sharded-state partitions placed across a mesh device span",
+        )
+        self._publish_load()
+        return list(chosen)
+
+    def _pick_span_locked(self, span: int) -> List[int]:
+        healthy = [
+            i for i in range(len(self.devices)) if i not in self._excluded
+        ]
+        if len(healthy) < span:
+            raise RuntimeError(
+                f"DevicePlan: sharded span {span} exceeds the "
+                f"{len(healthy)} healthy devices"
+            )
+        counts = self._load_locked(healthy)
+        return sorted(sorted(healthy, key=lambda i: (counts[i], i))[:span])
+
     def _pick_locked(self) -> int:
         healthy = [
             i for i in range(len(self.devices)) if i not in self._excluded
         ]
         if not healthy:
             raise RuntimeError("DevicePlan: every device is excluded")
-        counts = {i: 0 for i in healthy}
-        for idx in self._assigned.values():
-            if idx in counts:
-                counts[idx] += 1
+        counts = self._load_locked(healthy)
         low = min(counts.values())
         # rotate the tie-break start so equal-load devices fill in order
         n = len(healthy)
@@ -128,6 +183,7 @@ class DevicePlan:
         install (here or elsewhere) rebalances onto the emptiest device."""
         with self._lock:
             removed = self._assigned.pop(partition_id, None)
+            self._spans.pop(partition_id, None)
         if removed is not None:
             count_event(
                 "mesh_partition_releases",
@@ -147,12 +203,27 @@ class DevicePlan:
             victims = [
                 pid for pid, idx in self._assigned.items()
                 if idx == device_index
+                or device_index in self._spans.get(pid, ())
             ]
+            spans = {
+                pid: len(self._spans[pid])
+                for pid in victims if pid in self._spans
+            }
             for pid in victims:
                 del self._assigned[pid]
+                self._spans.pop(pid, None)
             for pid in victims:
-                moves[pid] = self._pick_locked()
-                self._assigned[pid] = moves[pid]
+                if pid in spans:
+                    # a sharded partition re-spans over the survivors; the
+                    # caller rebuilds its engine on the new span (the
+                    # sharded engine is pinned — no live place_on)
+                    chosen = self._pick_span_locked(spans[pid])
+                    self._spans[pid] = chosen
+                    self._assigned[pid] = chosen[0]
+                    moves[pid] = chosen[0]
+                else:
+                    moves[pid] = self._pick_locked()
+                    self._assigned[pid] = moves[pid]
         if moves:
             count_event(
                 "mesh_rebalance_moves",

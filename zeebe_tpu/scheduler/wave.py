@@ -80,10 +80,15 @@ class PartitionFeed:
       on (scheduler/placement.DevicePlan index), -1 when unplaced. Used
       only for the per-device wave metrics; dispatch itself is routed by
       the ENGINE's committed state placement.
+    - ``device_indices`` — EVERY plan index the partition occupies: the
+      span of a mesh-sharded-state engine (its wave computes on all of
+      them at once), else just ``[device_index]``. Feeds may leave it
+      empty; the scheduler falls back to ``device_index``.
     """
 
     partition_id: int = -1
     device_index: int = -1
+    device_indices: tuple = ()
 
     def backlog(self) -> int:  # pragma: no cover - interface default
         return 0
@@ -397,10 +402,16 @@ class WaveScheduler:
             wave.total, self.wave_size, len(wave.segments),
             wave.host_seconds, wave.device_seconds,
         )
-        devices = {
-            getattr(seg.feed, "device_index", -1)
-            for seg in wave.segments if seg.count
-        }
+        devices = set()
+        for seg in wave.segments:
+            if not seg.count:
+                continue
+            span = getattr(seg.feed, "device_indices", None)
+            if span:
+                # a sharded-state segment computes on its WHOLE span
+                devices.update(span)
+            else:
+                devices.add(getattr(seg.feed, "device_index", -1))
         devices.discard(-1)
         if devices:
             # >1 here means this wave's compute overlapped across the mesh

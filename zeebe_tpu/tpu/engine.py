@@ -258,6 +258,9 @@ class TpuPartitionEngine:
         sub_capacity: int = 16,
         device=None,
         device_index: int = -1,
+        state_shards: int = 1,
+        shard_devices=None,
+        device_indices=None,
     ):
         self.partition_id = partition_id
         self.num_partitions = num_partitions
@@ -269,6 +272,46 @@ class TpuPartitionEngine:
         # used only as the per-device metrics label.
         self.device = device
         self.device_index = device_index
+        # sharded state mode (ROADMAP item 2, mesh-sharded partition
+        # state): with state_shards > 1 this ONE partition's row tables
+        # live block-sharded on dim 0 over a `shards` mesh axis spanning
+        # `shard_devices` (DevicePlan hands the span; defaults to the
+        # first N local devices). The step runs through
+        # shard.build_state_step — gather-for-compute, keep-local-on-write
+        # — and replays bit-identical to the single-device program by
+        # construction. Mutually exclusive with single-device placement.
+        self._state_shards = max(int(state_shards), 1)
+        self._mesh = None
+        self._state_step = None
+        self._shard_exchange_bytes = 0
+        self.sharded_waves = 0
+        self.device_indices = (
+            list(device_indices) if device_indices is not None else []
+        )
+        if self._state_shards > 1:
+            if device is not None:
+                raise ValueError(
+                    "state_shards > 1 shards over a mesh span; a single "
+                    "`device` placement cannot also be pinned"
+                )
+            from zeebe_tpu.tpu import shard as shard_mod
+
+            devs = (
+                list(shard_devices)
+                if shard_devices is not None
+                else list(jax.devices())[: self._state_shards]
+            )
+            if len(devs) < self._state_shards:
+                raise ValueError(
+                    f"state_shards={self._state_shards} needs that many "
+                    f"devices; have {len(devs)}"
+                )
+            self._mesh = shard_mod.Mesh(
+                np.asarray(devs[: self._state_shards]),
+                (shard_mod.STATE_AXIS,),
+            )
+            if not self.device_indices:
+                self.device_indices = list(range(self._state_shards))
         self.repository = repository if repository is not None else WorkflowRepository()
         self.clock = clock or (lambda: 0)
         # pallas-vs-XLA dispatch is BUILD-dependent (PERF_NOTES round 4):
@@ -299,6 +342,15 @@ class TpuPartitionEngine:
                 capacity=capacity, num_vars=num_vars, sub_capacity=sub_capacity
             )
         )
+        if self._mesh is not None:
+            from zeebe_tpu.tpu import shard as shard_mod
+
+            self._state_step = shard_mod.build_state_step(
+                self._mesh, self.state
+            )
+            self._shard_exchange_bytes = shard_mod.state_exchange_bytes(
+                self.state, self._state_shards
+            )
         # key watermark of the last rebuild_lookup_state run: the direct-
         # mapped indexes are collision-free only within a window of index-
         # capacity consecutive keys, so the serving path re-derives the
@@ -347,6 +399,21 @@ class TpuPartitionEngine:
         the default single-device engine). Committed placement is what
         makes the jit programs EXECUTE there; uncommitted companions
         (clock scalars, migration rows) follow the committed operands."""
+        if self._mesh is not None:
+            # sharded mode: state tables commit block-sharded over the
+            # mesh span (dim 0), everything else replicated across it —
+            # both are NamedShardings, so the step program executes on
+            # the whole span without per-call resharding
+            from jax.sharding import NamedSharding, PartitionSpec
+            from zeebe_tpu.tpu import shard as shard_mod
+
+            if isinstance(tree, state_mod.EngineState):
+                return jax.device_put(
+                    tree, shard_mod.state_shardings(self._mesh, tree)
+                )
+            return jax.device_put(
+                tree, NamedSharding(self._mesh, PartitionSpec())
+            )
         if self.device is None:
             return tree
         return jax.device_put(tree, self.device)
@@ -358,6 +425,11 @@ class TpuPartitionEngine:
         untouched — and the next dispatched wave compiles/executes on the
         new device. Call between waves (the brokers do: placement changes
         happen on the broker actor, serialized with the drain)."""
+        if self._mesh is not None:
+            raise RuntimeError(
+                "sharded-state engine is pinned to its mesh span; rebuild "
+                "the engine (snapshot → restore) to move it"
+            )
         self.device = device
         self.device_index = device_index
         if device is not None:
@@ -1438,6 +1510,16 @@ class TpuPartitionEngine:
         # fallback maps must cover every restored live instance
         st = state_mod.rebuild_lookup_state(st)
         self.state = self._place(st)
+        if self._mesh is not None:
+            # the restored capacity may differ from the ctor template's,
+            # which changes the spec tree (divisibility) and the program's
+            # traced shapes — rebuild both (register_jit: latest wins)
+            from zeebe_tpu.tpu import shard as shard_mod
+
+            self._state_step = shard_mod.build_state_step(self._mesh, st)
+            self._shard_exchange_bytes = shard_mod.state_exchange_bytes(
+                st, self._state_shards
+            )
         self._keys_at_rebuild = 0
         self.capacity = st.capacity
         self.num_vars = st.num_vars
@@ -1974,12 +2056,37 @@ class TpuPartitionEngine:
         bools = np.empty((size, len(self._BOOL_COLS)), bool)
         for j, name in enumerate(self._BOOL_COLS):
             bools[:, j] = cols[name]
+        # sharded-state routing accounting: every staged wave reports its
+        # key-hash row split across the shard span (hot-shard balance
+        # gauge) and the wave's cross-shard table-gather volume. Advisory
+        # in this mode — physical residency is the block sharding, the
+        # hash is the stable owner the correlation plane already uses —
+        # but the split is what capacity planning reads.
+        if self._mesh is not None:
+            from zeebe_tpu.runtime import metrics as metrics_mod
+            from zeebe_tpu.tpu import shard as shard_mod
+
+            metrics_mod.observe_sharded_wave(
+                shard_mod.shard_row_counts_host(
+                    cols["key"], cols["valid"], self._state_shards
+                ),
+                self._shard_exchange_bytes,
+            )
+            self.sharded_waves += 1
         # staged columns commit to THIS engine's mesh device (placement is
-        # what routes the step program to it); default device otherwise
-        put = (
-            jnp.asarray if self.device is None
-            else (lambda a: jax.device_put(a, self.device))
-        )
+        # what routes the step program to it); sharded mode replicates
+        # them over the span via _place-style NamedSharding; default
+        # device otherwise
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            _repl = NamedSharding(self._mesh, PartitionSpec())
+            put = lambda a: jax.device_put(a, _repl)  # noqa: E731
+        else:
+            put = (
+                jnp.asarray if self.device is None
+                else (lambda a: jax.device_put(a, self.device))
+            )
         i64_dev = put(i64)
         i32_dev = put(i32)
         bool_dev = put(bools)
@@ -2011,11 +2118,27 @@ class TpuPartitionEngine:
         for n in sizes:
             batch = self._stage([], pad_to=n)
             # zero valid rows: a semantic no-op step that only compiles
-            self.state, _out, _stats = kernel.step_jit(
-                self.graph, self.state, batch, now,
-                partition_id=jnp.asarray(self.partition_id, jnp.int32),
-            )
+            _out, _stats = self._run_step(batch, now)
         jax.block_until_ready(self.state.ei_i32)
+
+    def _run_step(self, batch: RecordBatch, now) -> tuple:
+        """Launch ONE wave through the active step program — the sharded
+        program (shard.state_step through the jit registry) when this
+        engine runs in sharded-state mode, kernel.step_jit otherwise —
+        rebinding ``self.state`` and returning ``(out, stats)``. The two
+        programs are bit-identical by construction (the sharded one
+        gathers the full tables and runs the same kernel), so callers
+        never branch on the mode."""
+        pid = jnp.asarray(self.partition_id, jnp.int32)
+        if self._state_step is not None:
+            self.state, out, stats = self._state_step(
+                self.graph, self.state, batch, now, pid
+            )
+        else:
+            self.state, out, stats = kernel.step_jit(
+                self.graph, self.state, batch, now, partition_id=pid
+            )
+        return out, stats
 
     def _stage_row(self, cols, i, record: Record) -> None:
         md = record.metadata
@@ -2257,10 +2380,7 @@ class TpuPartitionEngine:
             self.state = state_mod.rebuild_lookup_state(self.state)
             self._keys_at_rebuild = 0
         self._mark_device_dirty()  # a kernel step may write any table
-        self.state, out, stats = kernel.step_jit(
-            self.graph, self.state, batch, now,
-            partition_id=jnp.asarray(self.partition_id, jnp.int32),
-        )
+        out, stats = self._run_step(batch, now)
         seg.out = out
         seg.stats = stats
         return seg

@@ -126,8 +126,18 @@ def _first_true_indices(avail, k):
 
 def _last_writer(slots, mask, size):
     """True for the highest-batch-rank writer per target slot (deterministic
-    conflict resolution for duplicate scatters)."""
+    conflict resolution for duplicate scatters). Small batches (the serving
+    wave) use an O(B²) comparison triangle — no gather/scatter pair per
+    call site; large drive-loop batches keep the scatter-max + read-back
+    form (same split as ``_first_per_key``)."""
     n = slots.shape[0]
+    if n <= 2048:
+        later_same = (
+            (slots[:, None] == slots[None, :])
+            & mask[None, :]
+            & jnp.triu(jnp.ones((n, n), bool), 1)
+        )
+        return mask & ~jnp.any(later_same, axis=1)
     rank = jnp.arange(n, dtype=jnp.int32)
     tgt = jnp.where(mask, slots, size)
     best = jnp.full((size + 1,), -1, jnp.int32).at[tgt].max(
@@ -303,8 +313,38 @@ def step_kernel(
     rt, vt_, it = batch.rtype, batch.vtype, batch.intent
     wf_c = jnp.clip(batch.wf, 0, graph.elem_type.shape[0] - 1)
     el_c = jnp.clip(batch.elem, 0, graph.elem_type.shape[1] - 1)
-    # hot-path per-element scalars: ONE [B, EM_COLS] row gather
-    emeta = graph.elem_meta[wf_c, el_c]
+    # hot-path per-element graph reads (round 9a): the meta scalar row,
+    # the step table, the conditioned/parallel flow fans and the timer
+    # duration all index by the same (workflow, element) pair — flattened
+    # to one [W*E, K] i32 table (timer_dur rides as two bitcast planes)
+    # they collapse into ONE row gather instead of five
+    n_elems = graph.elem_type.shape[1]
+    n_intents = graph.step_table.shape[2]
+    cond_fan = graph.cond_flows.shape[2]
+    fork_fan = graph.out_flows.shape[2]
+    em_cols = graph.elem_meta.shape[2]
+    with jax.named_scope("zb_gather"):
+        g_flat = jnp.concatenate(
+            [
+                graph.elem_meta.reshape(-1, em_cols),
+                graph.step_table.reshape(-1, n_intents),
+                graph.cond_flows.reshape(-1, cond_fan),
+                graph.cond_prog.reshape(-1, cond_fan),
+                graph.out_flows.reshape(-1, fork_fan),
+                pops.vec64_to_planes(graph.timer_dur.reshape(-1)),
+            ],
+            axis=1,
+        )
+        (g_row,) = pops.fused_gather_rows(
+            [g_flat], [pops.GatherOp(0, wf_c * n_elems + el_c)]
+        )
+    _go = 0
+    emeta = g_row[:, _go : _go + em_cols]; _go += em_cols
+    step_row = g_row[:, _go : _go + n_intents]; _go += n_intents
+    cflow = g_row[:, _go : _go + cond_fan]; _go += cond_fan
+    cprog = g_row[:, _go : _go + cond_fan]; _go += cond_fan
+    fork_flows = g_row[:, _go : _go + fork_fan]; _go += fork_fan
+    timer_dur_rec = pops.planes_to_i64(g_row[:, _go : _go + 2])[:, 0]
 
     # ---------------- A. lookups ----------------
     is_wi = valid & (vt_ == VT_WI)
@@ -487,9 +527,19 @@ def step_kernel(
     )
     shall = ei_found | sc_found
     stepped = wi_ev & ~m_created_ev & shall & guard & (batch.wf >= 0) & (batch.elem >= 0)
+    # per-row intent select from the gathered step row: a one-hot
+    # multiply-sum over the (small, static) intent axis — no second gather
     step_id = jnp.where(
         stepped,
-        graph.step_table[wf_c, el_c, jnp.clip(it, 0, graph.step_table.shape[2] - 1)],
+        jnp.sum(
+            jnp.where(
+                jnp.arange(n_intents, dtype=jnp.int32)[None, :]
+                == jnp.clip(it, 0, n_intents - 1)[:, None],
+                step_row,
+                0,
+            ),
+            axis=1,
+        ),
         int(BS.NONE),
     )
 
@@ -722,9 +772,8 @@ def step_kernel(
 
     # ---------------- C. per-step compute ----------------
     # exclusive split: evaluate conditioned flows in order
-    fan = graph.cond_flows.shape[2]
-    cflow = graph.cond_flows[wf_c, el_c]          # [B, F]
-    cprog = graph.cond_prog[wf_c, el_c]           # [B, F]
+    fan = cond_fan
+    # cflow / cprog [B, F] rows ride the phase-A fused graph gather
     has_cond = cprog >= 0
     if graph.has_conditions:
         tri = eval_programs(
@@ -747,9 +796,18 @@ def step_kernel(
     first_err = jnp.min(jnp.where(is_err, fidx, fan), axis=1)
     cond_errored = first_err < first_true
     default_f = emeta[:, graph_mod.EM_DEFAULT_FLOW]
+    # select the first-true flow by one-hot multiply-sum over the (small,
+    # static) fan axis instead of a per-row gather
     taken_flow = jnp.where(
         first_true < fan,
-        cflow[rows, jnp.clip(first_true, 0, fan - 1)],
+        jnp.sum(
+            jnp.where(
+                fidx[None, :] == jnp.clip(first_true, 0, fan - 1)[:, None],
+                cflow,
+                0,
+            ),
+            axis=1,
+        ),
         default_f,
     )
     xs_ok = m_xsplit & ~cond_errored & (taken_flow >= 0)
@@ -939,9 +997,18 @@ def step_kernel(
         activated = activated | act_s
         sub_credits = sub_credits.at[s].add(-jnp.sum(act_s, dtype=jnp.int32))
     cand_c = jnp.clip(cand, 0, s_cap - 1)
-    act_deadline = now + state.sub_timeout[cand_c]
-    act_worker = state.sub_worker[cand_c]
-    act_stream = state.sub_key[cand_c].astype(jnp.int32)
+    # the sub tables are tiny ([S] with S = sub_capacity): read the
+    # candidate's columns by one-hot multiply-sum instead of three gathers
+    cand_oh = jnp.arange(s_cap, dtype=jnp.int32)[None, :] == cand_c[:, None]
+    act_deadline = now + jnp.sum(
+        jnp.where(cand_oh, state.sub_timeout[None, :], 0), axis=1
+    )
+    act_worker = jnp.sum(
+        jnp.where(cand_oh, state.sub_worker[None, :], 0), axis=1
+    )
+    act_stream = jnp.sum(
+        jnp.where(cand_oh, state.sub_key[None, :], 0), axis=1
+    ).astype(jnp.int32)
     # credit return on activate rejection
     ret_idx = jnp.argmax(
         state.sub_key[None, :] == batch.req_stream[:, None].astype(jnp.int64), axis=1
@@ -1034,7 +1101,32 @@ def step_kernel(
     tokens_after = jnp.zeros((n_cap,), jnp.int32).at[
         jnp.where(m_consume, sc_clip, n_cap)
     ].add(-1, mode="drop") + state.ei_tokens
-    consume_done = m_consume & (tokens_after[sc_clip] <= 0)
+    # round-9a fused read pass: the remaining 1D i32 state reads — the
+    # post-consume token count per scope, the parallel-join fan-in, and
+    # the two free-slot ring pops (whose index math is pure, so the
+    # phase-E pops hoist here) — share ONE gather
+    ins_replay = m_created_ev & ~ei_found
+    ins = m_create | m_startst | ins_replay
+    ins_rank = _excl_cumsum(ins.astype(jnp.int32))
+    ei_pop_idx = state.free_ei_pop + ins_rank.astype(jnp.int64)
+    ei_ring_ok = ei_pop_idx < state.free_ei_push
+    job_ins = m_jcreate
+    j_rank = _excl_cumsum(job_ins.astype(jnp.int32))
+    job_pop_idx = state.free_job_pop + j_rank.astype(jnp.int64)
+    job_ring_ok = job_pop_idx < state.free_job_push
+    with jax.named_scope("zb_gather"):
+        tok_after_sc, nin_rec, ei_pop_slot, job_pop_slot = (
+            pops.fused_gather_rows(
+                [tokens_after, join_nin_arr, state.free_ei, state.free_job],
+                [
+                    pops.GatherOp(0, sc_clip),
+                    pops.GatherOp(1, arr_slot),
+                    pops.GatherOp(2, (ei_pop_idx % n_cap).astype(jnp.int32)),
+                    pops.GatherOp(3, (job_pop_idx % m_cap).astype(jnp.int32)),
+                ],
+            )
+        )
+    consume_done = m_consume & (tok_after_sc <= 0)
     consume_completer = _last_writer(sc_clip, consume_done, n_cap)
     e0 = put(
         e0, consume_completer,
@@ -1139,7 +1231,7 @@ def step_kernel(
         e0, m_timer_step,
         valid=True, rtype=RT_CMD, vtype=VT_TIMER, intent=int(TI.CREATE),
         key=jnp.int64(-1), elem=batch.elem, aux_key=batch.key,
-        deadline=now + graph.timer_dur[wf_c, el_c],
+        deadline=now + timer_dur_rec,
     )
 
     # --- slot 0: job command results
@@ -1724,7 +1816,7 @@ def step_kernel(
         ]
         em[name] = jnp.stack(parts, axis=1)  # [B, E] or [B, E, V]
 
-    fork_flows = graph.out_flows[wf_c, el_c]  # [B, F<=E]
+    # fork_flows [B, F<=E] rows rode the phase-A fused graph gather
     fan_out = fork_flows.shape[1]
     for f in range(min(fan_out, e_w)):
         mask_f = m_psplit & (f < out_count)
@@ -1832,7 +1924,7 @@ def step_kernel(
     # token counters: one select-by-kind accumulate on the scope row (a
     # record is exactly one of consume / parallel-split / join-complete,
     # so the old per-kind accumulate chain merges into one commutative op)
-    nin_rec = join_nin_arr[arr_slot]
+    # nin_rec (join fan-in per record) rode the round-9a fused read pass
     tok_m = m_consume | m_psplit | completer
     tok_v = jnp.where(
         m_consume, jnp.int32(-1),
@@ -1978,7 +2070,6 @@ def step_kernel(
     # CREATED events whose instance is missing)
     ins_root = m_create
     ins_child = m_startst
-    ins_replay = m_created_ev & ~ei_found
     ins = ins_root | ins_child | ins_replay
     ins_key = jnp.where(ins_root, key0, jnp.where(ins_child, key0, batch.key))
     ins_elem = jnp.where(ins_root, 0, jnp.where(ins_child, ftarget, batch.elem))
@@ -1986,14 +2077,10 @@ def step_kernel(
     ins_ikey = jnp.where(ins_root, key0, batch.instance_key)
     # free-slot ring pop (replaces the full-table free scan): slots freed
     # this round enter at push and are never re-allocated in the same
-    # round (matches the old scan, which read round-start state)
-    ins_rank = _excl_cumsum(ins.astype(jnp.int32))
-    ei_pop_idx = state.free_ei_pop + ins_rank.astype(jnp.int64)
-    ei_ring_ok = ei_pop_idx < state.free_ei_push
+    # round (matches the old scan, which read round-start state). The
+    # ring read itself rode the round-9a fused read pass (ei_pop_slot).
     ins_slot = jnp.where(
-        ins & ei_ring_ok,
-        state.free_ei[(ei_pop_idx % n_cap).astype(jnp.int32)],
-        n_cap,
+        ins & ei_ring_ok, ei_pop_slot, n_cap
     ).astype(jnp.int32)
     ei_overflow = jnp.any(ins & ~ei_ring_ok)
     free_ei_pop_new = state.free_ei_pop + jnp.sum(ins, dtype=jnp.int64)
@@ -2055,14 +2142,10 @@ def step_kernel(
     job_i64_pl = pops.i64_to_planes(state.job_i64)
     job_k32 = state.job_i32.shape[1]
     job_ops = []
-    job_ins = m_jcreate
-    j_rank = _excl_cumsum(job_ins.astype(jnp.int32))
-    job_pop_idx = state.free_job_pop + j_rank.astype(jnp.int64)
-    job_ring_ok = job_pop_idx < state.free_job_push
+    # job ring pop indices + the ring read hoisted into the round-9a
+    # fused read pass (job_pop_slot)
     j_slot = jnp.where(
-        job_ins & job_ring_ok,
-        state.free_job[(job_pop_idx % m_cap).astype(jnp.int32)],
-        m_cap,
+        job_ins & job_ring_ok, job_pop_slot, m_cap
     ).astype(jnp.int32)
     job_overflow = jnp.any(job_ins & ~job_ring_ok)
     free_job_pop_new = state.free_job_pop + jnp.sum(job_ins, dtype=jnp.int64)
