@@ -1187,13 +1187,15 @@ def run_mesh_ab(smoke=False, partitions=8, devices=8, resident=0,
     }
 
 
-def _sharded_state_parity(shards):
+def _sharded_state_parity(shards, routing="gathered", engine_box=None):
     """Deterministic sharded-STATE leg (the smoke's non-timing asserts):
     the same single-partition workload drained once with the engine's
     tables block-sharded over ``shards`` devices and once on the default
     single device must produce BIT-IDENTICAL frames AND raw on-disk
     segment bytes — and the sharded drain must stamp the routing metrics
-    (per-shard row split, cross-shard gather bytes, sharded wave count)."""
+    (per-shard row split, cross-shard gather bytes, sharded wave count).
+    ``routing`` selects the sharded leg's step family: v1 ``gathered``
+    or v2 ``resident`` (residency-routed staging)."""
     import itertools
     import tempfile
 
@@ -1214,10 +1216,14 @@ def _sharded_state_parity(shards):
         repo = WorkflowRepository()
 
         def factory(pid):
-            return TpuPartitionEngine(
+            engine = TpuPartitionEngine(
                 pid, 1, repository=repo, clock=clock, capacity=1024,
                 state_shards=state_shards,
+                routing=routing if state_shards > 1 else "gathered",
             )
+            if engine_box is not None and state_shards > 1:
+                engine_box.append(engine)
+            return engine
 
         broker = Broker(
             num_partitions=1, data_dir=data_dir, clock=clock,
@@ -1256,6 +1262,8 @@ def _sharded_state_parity(shards):
                     raw.append(f.read())
         return frames, raw
 
+    if engine_box is None and routing == "resident":
+        engine_box = []
     c = GLOBAL_REGISTRY.counter
     waves0 = c("serving_sharded_waves_total").value
     bytes0 = c("mesh_shard_exchange_bytes_total").value
@@ -1277,30 +1285,60 @@ def _sharded_state_parity(shards):
         int(GLOBAL_REGISTRY.gauge("mesh_shard_rows", device=str(d)).value)
         for d in range(shards)
     ]
-    return {
+    result = {
         "shards": shards,
+        "routing": routing,
         "records": len(frames_sh),
         "sharded_waves": sharded_waves,
         "shard_exchange_bytes": exchange_bytes,
+        "exchanged_bytes_per_wave": round(exchange_bytes / sharded_waves),
         "last_wave_shard_rows": shard_rows,
         "bit_identical": True,
     }
+    if routing == "resident" and engine_box:
+        engine = engine_box[0]
+        result["routed_waves"] = int(engine.routed_waves)
+        result["fallback_waves"] = int(engine.fallback_waves)
+        result["routed_overflows"] = int(engine.routed_overflows)
+        assert engine.routed_waves > 0, (
+            "resident routing never took the routed lane program"
+        )
+    return result
 
 
 def run_sharded_state_ab(smoke=False, shards=8, partitions=2, clients=8,
-                         instances_per_client=8, resident=0):
+                         instances_per_client=8, resident=0, routed=False):
     """Sharded-STATE A/B (ISSUE 19): partitions whose tables block-shard
     over a span of devices vs single-device placement at EQUAL offered
     load (same scheduler, same traffic), plus the deterministic
     in-process bit-identity leg. ``--smoke`` keeps the non-timing asserts
-    at CI scale."""
+    at CI scale. ``--routed`` (ISSUE 20) adds the residency-routed v2
+    leg: the SAME workload drained under ``resident`` routing must stay
+    bit-identical AND move strictly fewer collective bytes per wave than
+    the v1 gathered leg."""
     devices = _ensure_mesh_devices(shards)
     if devices < 2:
         raise RuntimeError(
             f"sharded-state bench needs >= 2 devices, have {devices}"
         )
     shards = min(shards, devices)
-    parity = _sharded_state_parity(4 if smoke else shards)
+    n = 4 if smoke else shards
+    parity = _sharded_state_parity(n)
+    if routed:
+        rparity = _sharded_state_parity(n, routing="resident")
+        g_bpw = parity["exchanged_bytes_per_wave"]
+        r_bpw = rparity["exchanged_bytes_per_wave"]
+        assert r_bpw < g_bpw, (
+            f"routed leg moved {r_bpw} B/wave, gathered {g_bpw} — "
+            "residency routing failed to shed collective volume"
+        )
+        parity = {
+            "gathered": parity,
+            "resident": rparity,
+            "bytes_per_wave_ratio_gathered_over_routed": round(
+                g_bpw / max(r_bpw, 1), 2
+            ),
+        }
     if smoke:
         kw = dict(partitions=2, devices=devices, clients=4,
                   instances_per_client=3, duration_sec=60)
@@ -2111,6 +2149,7 @@ def main():
             clients=_arg("--clients", 8),
             instances_per_client=_arg("--instances", 8),
             resident=_arg("--resident", 0),
+            routed="--routed" in sys.argv,
         )
         print(json.dumps(result, indent=2))
         return

@@ -82,6 +82,12 @@ echo "   single-device engine, sharded waves observed, zero sheds) =="
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
   python bench.py --sharded-state --smoke > /dev/null
 
+echo "== sharded-state v2 routed smoke (residency-routed staging: routed"
+echo "   leg bit-identical AND strictly fewer collective bytes per wave"
+echo "   than the gathered leg; overflow waves fall back losslessly) =="
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python bench.py --sharded-state --routed --smoke > /dev/null
+
 echo "== full test suite (tier-1; run './ci.sh slow' for the slow tier) =="
 python -m pytest tests/ -x -q -m "not slow" --ignore=tests/test_chaos.py --ignore=tests/test_exporters.py
 

@@ -286,10 +286,11 @@ class TestShardedEngineGuards:
 # ---------------------------------------------------------------------------
 
 
-def _sharded_workload(data_dir, state_shards, engine_box=None):
+def _sharded_workload(data_dir, state_shards, engine_box=None, **engine_kw):
     """Single-partition device-engine workload (service task + timer —
     instance, job AND timer tables all see traffic); returns
-    (frames, raw segment bytes)."""
+    (frames, raw segment bytes). ``engine_kw`` forwards to the engine
+    ctor (``routing="resident"``, ``routed_lane_slots=...``)."""
     from zeebe_tpu.engine.interpreter import WorkflowRepository
     from zeebe_tpu.gateway import JobWorker, ZeebeClient
     from zeebe_tpu.gateway import workers as workers_mod
@@ -307,7 +308,7 @@ def _sharded_workload(data_dir, state_shards, engine_box=None):
     def factory(pid):
         engine = TpuPartitionEngine(
             pid, 1, repository=repo, clock=clock, capacity=1 << 10,
-            state_shards=state_shards,
+            state_shards=state_shards, **engine_kw,
         )
         if engine_box is not None:
             engine_box.append(engine)
@@ -354,8 +355,19 @@ def _sharded_workload(data_dir, state_shards, engine_box=None):
     return frames, blobs
 
 
+@pytest.fixture(scope="module")
+def single_device_baseline(tmp_path_factory):
+    """The single-device drain of THE workload, run once per module: the
+    deterministic oracle every parity test compares against (same seeds,
+    same clock schedule — bit-identical across runs by construction, so
+    sharing it is sound and saves three full drains of tier-1 wall)."""
+    return _sharded_workload(str(tmp_path_factory.mktemp("un")), 1)
+
+
 class TestShardedServingParity:
-    def test_sharded_vs_single_device_logs_bit_identical(self, tmp_path):
+    def test_sharded_vs_single_device_logs_bit_identical(
+        self, tmp_path, single_device_baseline
+    ):
         """THE parity pin (acceptance): frames AND raw on-disk segment
         bytes identical with the tables sharded over all 8 devices — and
         the waves actually rode the sharded step (metrics prove it)."""
@@ -372,7 +384,7 @@ class TestShardedServingParity:
             GLOBAL_REGISTRY.counter("mesh_shard_exchange_bytes_total").value
             - bytes0
         )
-        frames_un, raw_un = _sharded_workload(str(tmp_path / "un"), 1)
+        frames_un, raw_un = single_device_baseline
         assert len(frames_sh) > 100
         assert frames_sh == frames_un, "frames diverged under sharding"
         assert raw_sh and raw_sh == raw_un, "raw segment bytes diverged"
@@ -388,6 +400,220 @@ class TestShardedServingParity:
                 GLOBAL_REGISTRY.gauge("mesh_shard_rows", device=str(d)).value
                 >= 0
             )
+
+
+# ---------------------------------------------------------------------------
+# sharded-state v2 (ISSUE 20): residency-routed staging
+# ---------------------------------------------------------------------------
+
+
+class TestRoutedServingParity:
+    """Resident routing is a HOW change, never a WHAT change: the routed
+    lane program, the overflow fallback, and the v1 gathered step must
+    all drain the same workload to bit-identical logs."""
+
+    def _routed_run(self, data_dir, shards, **kw):
+        box = []
+        frames, raw = _sharded_workload(
+            data_dir, shards, engine_box=box, routing="resident", **kw
+        )
+        return frames, raw, box[0]
+
+    def test_routed_vs_single_device_logs_bit_identical(
+        self, tmp_path, monkeypatch, single_device_baseline
+    ):
+        """THE v2 parity pin (acceptance): 8-shard resident routing vs
+        the single-device engine, frames AND raw segment bytes — the
+        routed lane program actually carried waves, every routed wave's
+        staged split landed on ONE lane (flagged single-lane for the
+        skew gauge), and every residency entry sits on the
+        host/device-agreed hash shard of its instance key (shard_of_key
+        parity ON the routed staging plane)."""
+        from zeebe_tpu.runtime import metrics as metrics_mod
+
+        observed = []
+        real = metrics_mod.observe_sharded_wave
+
+        def spy(split, xb, single_lane=False):
+            observed.append((list(int(x) for x in split), single_lane))
+            real(split, xb, single_lane=single_lane)
+
+        monkeypatch.setattr(metrics_mod, "observe_sharded_wave", spy)
+        frames_rt, raw_rt, engine = self._routed_run(
+            str(tmp_path / "rt"), 8
+        )
+        resident = dict(engine._resident)
+        frames_un, raw_un = single_device_baseline
+        assert len(frames_rt) > 100
+        assert frames_rt == frames_un, "frames diverged under routing"
+        assert raw_rt and raw_rt == raw_un, "raw segment bytes diverged"
+        assert engine.routing == "resident"
+        assert engine.routed_waves > 0, "no wave took the routed program"
+        assert engine.routed_overflows == 0, (
+            "default lanes overflowed on a 32-instance workload"
+        )
+        # completed instances demote; re-learned entries may remain from
+        # in-flight timers — either way the invariant holds for all
+        for ik, owner in resident.items():
+            assert owner == shard.shard_of_key_host(ik, 8), ik
+        if resident:
+            keys = np.fromiter(resident, dtype=np.int64)
+            np.testing.assert_array_equal(
+                np.asarray(shard.shard_of_key(jnp.asarray(keys), 8)),
+                np.asarray([resident[int(k)] for k in keys]),
+            )
+        routed = [s for s, single in observed if single and sum(s)]
+        assert len(routed) == engine.routed_waves > 0
+        for fill in routed:
+            assert len(fill) == 8
+            assert sum(1 for v in fill if v) == 1, fill
+
+    @pytest.mark.slow
+    def test_routed_vs_gathered_bit_identity_small_spans(self, tmp_path):
+        """Routed-vs-gathered across the remaining shard counts (8 is
+        pinned above against single-device, which gathered parity
+        already equals; slow tier with the other heavy parity legs)."""
+        for shards in (2, 4):
+            frames_rt, raw_rt, engine = self._routed_run(
+                str(tmp_path / f"rt{shards}"), shards
+            )
+            frames_g, raw_g = _sharded_workload(
+                str(tmp_path / f"g{shards}"), shards
+            )
+            assert engine.routed_waves > 0
+            assert frames_rt == frames_g, f"{shards}-shard logs diverged"
+            assert raw_rt == raw_g, f"{shards}-shard raw bytes diverged"
+
+    def test_undersized_lanes_overflow_to_fallback_losslessly(
+        self, tmp_path, monkeypatch, single_device_baseline
+    ):
+        """Overflow-fallback parity: 2-slot lanes force every multi-row
+        wave through the gathered fallback — counted, demoted from
+        residency, and STILL bit-identical. Any wave that DOES route
+        lands on exactly one lane; fallback waves keep the advisory
+        key-hash split (never flagged single-lane)."""
+        from zeebe_tpu.runtime import metrics as metrics_mod
+
+        observed = []
+        real = metrics_mod.observe_sharded_wave
+
+        def spy(split, xb, single_lane=False):
+            observed.append((list(int(x) for x in split), single_lane))
+            real(split, xb, single_lane=single_lane)
+
+        monkeypatch.setattr(metrics_mod, "observe_sharded_wave", spy)
+        frames_rt, raw_rt, engine = self._routed_run(
+            str(tmp_path / "rt"), 4, routed_lane_slots=2
+        )
+        frames_un, raw_un = single_device_baseline
+        assert frames_rt == frames_un, "overflow fallback diverged"
+        assert raw_rt == raw_un
+        assert engine.routed_overflows > 0, "lanes never overflowed"
+        assert engine.fallback_waves > 0, "overflow never took fallback"
+        routed = [s for s, single in observed if single and sum(s)]
+        assert len(routed) == engine.routed_waves
+        for fill in routed:
+            assert sum(1 for v in fill if v) == 1, fill
+        fallbacks = [s for s, single in observed if not single and sum(s)]
+        assert len(fallbacks) == engine.fallback_waves > 0
+
+    def test_message_graphs_refuse_routing(self, tmp_path):
+        """Message-correlation state is cross-instance by nature; a
+        resident engine serving a message graph routes NOTHING (all
+        waves fall back) and stays bit-identical — pinned by the slow
+        correlation suite; here we pin the guard itself."""
+        from zeebe_tpu.tpu import TpuPartitionEngine
+
+        engine = TpuPartitionEngine(
+            0, 1, capacity=256, state_shards=2, routing="resident"
+        )
+        assert engine._routing_active() is False  # no graph yet
+
+    def test_unknown_routing_mode_raises(self):
+        from zeebe_tpu.tpu import TpuPartitionEngine
+
+        with pytest.raises(ValueError, match="routing"):
+            TpuPartitionEngine(
+                0, 1, capacity=256, state_shards=2, routing="telepathic"
+            )
+
+
+class TestRoutedLoweringCensus:
+    def test_routed_lowers_without_all_gather_fallback_keeps_it(self):
+        """THE op-census acceptance pin: the routed program's lowering
+        contains ZERO all_gathers — its only collectives are the
+        boundary psums (all_reduce) — while the fallback's lowering
+        keeps the row-table gathers (also proving the census string
+        actually detects the prim)."""
+        import dataclasses as dc
+
+        import bench
+        from jax.sharding import Mesh
+        from zeebe_tpu.tpu import batch as rb
+        from zeebe_tpu.tpu import state as state_mod
+
+        graph, _meta = bench.build_graph()
+        nv = max(graph.num_vars, 8)
+        graph = dc.replace(graph, num_vars=nv)
+        mesh = Mesh(np.asarray(jax.devices()), (shard.STATE_AXIS,))
+        state_sds = jax.eval_shape(
+            lambda: state_mod.make_state(
+                capacity=256, num_vars=nv, job_capacity=256, sub_capacity=8
+            )
+        )
+        now = jax.ShapeDtypeStruct((), jnp.int64)
+        pid = jax.ShapeDtypeStruct((), jnp.int32)
+        batch_sds = jax.eval_shape(lambda: rb.empty(16, nv))
+        lanes_sds = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct((8,) + tuple(a.shape), a.dtype),
+            batch_sds,
+        )
+        routed = shard.build_state_step_routed(mesh, state_sds)
+        text = routed.lower(graph, state_sds, lanes_sds, now, pid).as_text()
+        assert "all_gather" not in text, "routed lowering gained a gather"
+        assert "all_reduce" in text, "boundary psums missing"
+        fallback = shard.build_state_step_fallback(mesh, state_sds)
+        ftext = fallback.lower(
+            graph, state_sds, batch_sds, now, pid
+        ).as_text()
+        assert "all_gather" in ftext, "census string detects nothing"
+
+
+class TestShardSkewGauge:
+    def test_skew_ratio_and_warn_counter(self):
+        from zeebe_tpu.runtime import metrics as metrics_mod
+
+        g = GLOBAL_REGISTRY.gauge("mesh_shard_skew_ratio")
+        skewed0 = GLOBAL_REGISTRY.counter(
+            "mesh_shard_skewed_waves_total"
+        ).value
+        # balanced wave: ratio 1.0, no warn
+        metrics_mod.observe_sharded_wave(np.array([8, 8, 8, 8]), 0)
+        assert g.value == pytest.approx(1.0)
+        # one shard takes everything at meaningful fill: ratio = nshards
+        metrics_mod.observe_sharded_wave(np.array([32, 0, 0, 0]), 0)
+        assert g.value == pytest.approx(4.0)
+        # 4x is the warn threshold boundary (strictly-above fires)
+        metrics_mod.observe_sharded_wave(np.array([33, 0, 0, 0, 0]), 0)
+        assert g.value > 4.0
+        assert GLOBAL_REGISTRY.counter(
+            "mesh_shard_skewed_waves_total"
+        ).value > skewed0
+        # empty waves leave the gauge untouched
+        before = g.value
+        metrics_mod.observe_sharded_wave(np.array([0, 0, 0, 0]), 0)
+        assert g.value == before
+        # resident-ROUTED waves are one-lane BY DESIGN: no skew score
+        skewed1 = GLOBAL_REGISTRY.counter(
+            "mesh_shard_skewed_waves_total"
+        ).value
+        metrics_mod.observe_sharded_wave(
+            np.array([0, 40, 0, 0, 0]), 0, single_lane=True
+        )
+        assert g.value == before
+        assert GLOBAL_REGISTRY.counter(
+            "mesh_shard_skewed_waves_total"
+        ).value == skewed1
 
 
 # ---------------------------------------------------------------------------
@@ -535,7 +761,7 @@ class TestShardedSnapshotRestore:
 # ---------------------------------------------------------------------------
 
 
-def _chaos_run(data_dir, state_shards, crash):
+def _chaos_run(data_dir, state_shards, crash, routing="gathered"):
     """Seeded two-burst workload with an optional crash-stop between the
     bursts (close + reopen from the same log dir: replay rebuilds the
     sharded tables). Returns the final frame list."""
@@ -560,6 +786,7 @@ def _chaos_run(data_dir, state_shards, crash):
             engine_factory=lambda pid: TpuPartitionEngine(
                 pid, 1, repository=repo, clock=clock, capacity=1 << 10,
                 state_shards=state_shards,
+                routing=routing if state_shards > 1 else "gathered",
             ),
         )
         broker.wave_size = 128
@@ -615,6 +842,20 @@ class TestShardedChaos:
         frames_single = _chaos_run(str(tmp_path / "u"), 1, crash=True)
         assert len(frames_sharded) > 100
         assert frames_sharded == frames_single
+
+    def test_fixed_seed_crash_stop_replays_identically_routed(
+        self, tmp_path
+    ):
+        """Same chaos leg under resident routing: the crash drops the
+        host residency dict with everything else; replay re-learns it
+        (or falls back) and the frames stay byte-identical to the
+        single-device run under the same seeded schedule."""
+        frames_routed = _chaos_run(
+            str(tmp_path / "r"), 4, crash=True, routing="resident"
+        )
+        frames_single = _chaos_run(str(tmp_path / "u"), 1, crash=True)
+        assert len(frames_routed) > 100
+        assert frames_routed == frames_single
 
 
 @pytest.mark.slow

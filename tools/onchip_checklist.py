@@ -166,6 +166,12 @@ def main() -> int:
         # here; the CPU run only models them)
         ("sharded_state_bench",
          [py, "bench.py", "--sharded-state"] + smoke, 7200),
+        # ISSUE 20: sharded-state v2 — residency-routed staging; the
+        # routed leg must stay bit-identical on real chips AND move
+        # strictly fewer collective bytes per wave than the gathered leg
+        # (on chip the psum boundary traffic rides real ICI links)
+        ("sharded_state_routed_bench",
+         [py, "bench.py", "--sharded-state", "--routed"] + smoke, 7200),
         # PR 10 (kernel round 8): the mega-gather/emit families — the
         # autotune step above already tables their A/B and the
         # pallas_ops_check step pins their parity; these two legs run the

@@ -33,6 +33,8 @@ DRIVER_NAMES = (
     "shard.frame_exchange",
     "shard.sharded_drive",
     "shard.state_step",
+    "shard.state_step_routed",
+    "shard.state_step_fallback",
 )
 AUTOTUNE_PREFIX = "autotune."
 
@@ -144,7 +146,8 @@ def build_entries(
             )
 
     shard_names = ("shard.sharded_step", "shard.frame_exchange",
-                   "shard.sharded_drive", "shard.state_step")
+                   "shard.sharded_drive", "shard.state_step",
+                   "shard.state_step_routed", "shard.state_step_fallback")
     if any(wanted(n) for n in shard_names) and len(jax.devices()) >= 2:
         mesh = Mesh(np.asarray(jax.devices()), ("partitions",))
         nparts = mesh.devices.shape[0]
@@ -235,6 +238,47 @@ def build_entries(
                 graph, state_sds, batch_sds, now_sds, pid_sds,
                 config={**census_cfg, "state_shards": nparts},
             )
+        routed_names = ("shard.state_step_routed",
+                        "shard.state_step_fallback")
+        if any(wanted(n) for n in routed_names):
+            # sharded-state v2 (resident routing): the routed program
+            # steps each shard on its own rows + its routed batch lane
+            # ([nparts, shard_wave] lanes sharded over the mesh axis) —
+            # its collective budget is the acceptance gate proving the
+            # per-wave volume is boundary traffic (psum of emissions),
+            # not table gathers; the op census proves NO all_gather in
+            # the lowering. The fallback keeps v1's gathered shape but
+            # rebuilds the lookup structures in-program, shedding their
+            # gather volume — budgeted separately.
+            smesh = Mesh(np.asarray(jax.devices()), (shard.STATE_AXIS,))
+            pid_sds = jax.ShapeDtypeStruct((), jnp.int32)
+            routed_cfg = {
+                **census_cfg, "state_shards": nparts, "wave": shard_wave,
+                "routing": "resident",
+            }
+            if wanted("shard.state_step_routed"):
+                rstep = shard.build_state_step_routed(smesh, state_sds)
+                lanes_sds = jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(
+                        (nparts,) + tuple(a.shape), a.dtype
+                    ),
+                    jax.eval_shape(lambda: rb.empty(shard_wave, num_vars)),
+                )
+                add(
+                    "shard.state_step_routed", rstep,
+                    graph, state_sds, lanes_sds, now_sds, pid_sds,
+                    config=routed_cfg,
+                )
+            if wanted("shard.state_step_fallback"):
+                fstep = shard.build_state_step_fallback(smesh, state_sds)
+                fbatch_sds = jax.eval_shape(
+                    lambda: rb.empty(shard_wave, num_vars)
+                )
+                add(
+                    "shard.state_step_fallback", fstep,
+                    graph, state_sds, fbatch_sds, now_sds, pid_sds,
+                    config=routed_cfg,
+                )
 
     if names is None or any(n.startswith(AUTOTUNE_PREFIX) for n in names):
         for family, fn in autotune.audit_candidates().items():

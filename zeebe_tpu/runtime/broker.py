@@ -160,6 +160,14 @@ class _BrokerFeed:
             getattr(self.partition.engine, "device_indices", ()) or ()
         )
 
+    @property
+    def shard_fill(self):
+        """Per-shard staged-row counts of the engine's last dispatched
+        wave (sharded-state v2 fill accounting); empty otherwise."""
+        return tuple(
+            getattr(self.partition.engine, "last_shard_fill", ()) or ()
+        )
+
     def backlog(self) -> int:
         p = self.partition
         return max(0, p.log.commit_position - p.next_read_position + 1)

@@ -494,6 +494,14 @@ class PartitionServer:
             return ()
         return tuple(getattr(self.engine, "device_indices", ()) or ())
 
+    @property
+    def shard_fill(self):
+        """Per-shard staged-row counts of the engine's last dispatched
+        wave (sharded-state v2 fill accounting); empty otherwise."""
+        if self.engine is None:
+            return ()
+        return tuple(getattr(self.engine, "last_shard_fill", ()) or ())
+
     def backlog(self) -> int:
         if not self.is_leader:
             return 0

@@ -151,6 +151,13 @@ class MeshCfg:
     # 0/1 = single-device placement (the default); replays are
     # bit-identical either way (tests/test_sharded_state.py pins it)
     sharded_partitions: int = 0
+    # sharded-state ROUTING mode (v2): "gathered" = every wave gathers
+    # the sharded tables (v1 — compute does not divide by the span);
+    # "resident" = residency-routed staging — single-owner waves stage
+    # into the owner shard's batch lane and step only its local rows (no
+    # per-wave table gather; unknown-residency/overflow waves fall back
+    # to a gathered step). Logs are bit-identical in every mode.
+    routing: str = "gathered"
 
 
 @dataclasses.dataclass
@@ -298,6 +305,7 @@ _ENV_OVERRIDES = {
     ),
     "ZEEBE_MESH_DEVICES": ("mesh", "devices", int),
     "ZEEBE_MESH_SHARDED_PARTITIONS": ("mesh", "sharded_partitions", int),
+    "ZEEBE_MESH_ROUTING": ("mesh", "routing", str),
     "ZEEBE_TRACING_ENABLED": (
         "tracing",
         "enabled",

@@ -81,6 +81,7 @@ def engine_factory_from_config(
                 state_shards=state_shards,
                 shard_devices=shard_devices,
                 device_indices=device_indices,
+                routing=getattr(cfg.mesh, "routing", "gathered"),
             )
             import jax as _jax
 

@@ -469,13 +469,30 @@ def observe_mesh_wave(devices_active: int) -> None:
 
 _SHARDED_WAVE_HANDLES: Dict[str, Metric] = {}
 _SHARD_ROW_HANDLES: Dict[str, Metric] = {}
+_SHARD_FILL_HANDLES: Dict[str, Metric] = {}
+# edge-trigger for the skew warn log: one line per skew EPISODE, re-armed
+# by the next balanced wave (flooding the log at wave rate would bury the
+# signal the warn exists to surface)
+_SKEW_WARNED = [False]
+
+# waves skewed beyond this (max/mean routed rows) warn: one shard is
+# doing >4x its fair share — the residency router's load-balance signal
+SHARD_SKEW_WARN_RATIO = 4.0
 
 
-def observe_sharded_wave(shard_rows, exchange_bytes: int) -> None:
+def observe_sharded_wave(
+    shard_rows, exchange_bytes: int, single_lane: bool = False
+) -> None:
     """Record one wave dispatched through a SHARDED-state partition:
-    ``shard_rows`` is the per-shard row count of the staged batch under
-    key-hash routing (the balance signal operators watch for hot shards),
-    ``exchange_bytes`` the wave's cross-shard table-gather volume."""
+    ``shard_rows`` is the per-shard row count of the staged batch (owner
+    lane fill under resident routing, advisory key-hash split under
+    gathered — the balance signal operators watch for hot shards),
+    ``exchange_bytes`` the wave's ACTUAL cross-shard volume (0 for waves
+    that dispatched no records — idle/warm steps move nothing worth
+    accounting). ``single_lane`` marks a RESIDENT-ROUTED wave: one lane
+    holds everything BY DESIGN, so the skew gauge/warn skip it (the
+    ratio would read num_shards on every healthy routed wave — skew is a
+    key-hash-split signal, scored on gathered and fallback waves)."""
     h = _SHARDED_WAVE_HANDLES
     if not h:
         g = GLOBAL_REGISTRY
@@ -487,13 +504,30 @@ def observe_sharded_wave(shard_rows, exchange_bytes: int) -> None:
             exchange=g.counter(
                 "mesh_shard_exchange_bytes_total",
                 "Cross-shard collective bytes moved by sharded-state waves "
-                "(table gathers over the mesh axis)",
+                "(table gathers over the mesh axis, or boundary psum "
+                "volume under resident routing)",
+            ),
+            skew=g.gauge(
+                "mesh_shard_skew_ratio",
+                "max/mean routed rows across the shard span for the most "
+                "recent non-empty sharded wave (1.0 = perfectly balanced, "
+                "num_shards = one shard takes everything)",
+            ),
+            skew_waves=g.counter(
+                "mesh_shard_skewed_waves_total",
+                "Sharded waves whose routed-row skew exceeded the 4x "
+                "warn threshold (at meaningful fill)",
             ),
         )
     h["waves"].inc()
     if exchange_bytes > 0:
         h["exchange"].inc(exchange_bytes)
+    total = 0
+    peak = 0
     for i, rows in enumerate(shard_rows):
+        rows = int(rows)
+        total += rows
+        peak = max(peak, rows)
         key = str(i)
         m = _SHARD_ROW_HANDLES.get(key)
         if m is None:
@@ -504,6 +538,44 @@ def observe_sharded_wave(shard_rows, exchange_bytes: int) -> None:
                 device=key,
             )
             _SHARD_ROW_HANDLES[key] = m
+        m.set(rows)
+    nshards = max(len(shard_rows), 1)
+    if total > 0 and not single_lane:
+        ratio = peak * nshards / total  # max over mean
+        h["skew"].set(ratio)
+        # the warn gates on meaningful fill (>= 4 rows/shard on average):
+        # a 3-record wave on 8 shards is ALWAYS "skewed" and means nothing
+        if ratio > SHARD_SKEW_WARN_RATIO and total >= 4 * nshards:
+            h["skew_waves"].inc()
+            if not _SKEW_WARNED[0]:
+                _SKEW_WARNED[0] = True
+                logging.getLogger(__name__).warning(
+                    "sharded wave skew %.1fx across %d shards (%d rows, "
+                    "peak %d): one shard is doing >%gx its fair share — "
+                    "resident routing is only as parallel as the "
+                    "instance spread",
+                    ratio, nshards, total, peak, SHARD_SKEW_WARN_RATIO,
+                )
+        else:
+            _SKEW_WARNED[0] = False
+
+
+def observe_shard_fill(plan_indices, fill) -> None:
+    """Per-shard staged-row fill of one collected sharded-state segment,
+    keyed by the PLAN device index each shard occupies (the scheduler's
+    view — ``mesh_shard_rows`` above is keyed by shard ordinal, which
+    every sharded partition shares)."""
+    for d, rows in zip(plan_indices, fill):
+        key = str(int(d))
+        m = _SHARD_FILL_HANDLES.get(key)
+        if m is None:
+            m = GLOBAL_REGISTRY.gauge(
+                "mesh_shard_wave_fill",
+                "Staged rows the most recent collected sharded segment "
+                "routed to this plan device",
+                device=key,
+            )
+            _SHARD_FILL_HANDLES[key] = m
         m.set(int(rows))
 
 

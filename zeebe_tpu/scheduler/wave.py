@@ -44,6 +44,7 @@ from zeebe_tpu.runtime.metrics import (
     count_event,
     observe_device_wave,
     observe_mesh_wave,
+    observe_shard_fill,
     observe_shared_wave,
 )
 from zeebe_tpu.tracing.recorder import FLIGHT, record_event
@@ -410,6 +411,13 @@ class WaveScheduler:
             if span:
                 # a sharded-state segment computes on its WHOLE span
                 devices.update(span)
+                # per-shard fill accounting (sharded-state v2): what each
+                # plan device actually staged for this segment — under
+                # resident routing a routed wave fills ONE lane, and this
+                # is where that concentration becomes visible per device
+                fill = getattr(seg.feed, "shard_fill", None)
+                if fill:
+                    observe_shard_fill(span, fill)
             else:
                 devices.add(getattr(seg.feed, "device_index", -1))
         devices.discard(-1)

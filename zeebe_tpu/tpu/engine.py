@@ -219,6 +219,7 @@ class _PendingSegment:
     rows: List[int] = dataclasses.field(default_factory=list)
     out: Optional[RecordBatch] = None   # device emission batch (unfetched)
     stats: Optional[dict] = None        # device stats (unfetched)
+    route_owner: Optional[int] = None   # routed wave's owner shard (v2)
 
 
 @dataclasses.dataclass
@@ -261,6 +262,8 @@ class TpuPartitionEngine:
         state_shards: int = 1,
         shard_devices=None,
         device_indices=None,
+        routing: str = "gathered",
+        routed_lane_slots: int = 512,
     ):
         self.partition_id = partition_id
         self.num_partitions = num_partitions
@@ -285,6 +288,32 @@ class TpuPartitionEngine:
         self._state_step = None
         self._shard_exchange_bytes = 0
         self.sharded_waves = 0
+        # sharded-state v2 (ROADMAP item 2, second half): routing mode.
+        # "gathered" = v1 gather-for-compute every wave; "resident" =
+        # residency-routed staging — single-owner waves stage into the
+        # owner shard's batch lane and step ONLY local rows (no table
+        # gather), everything else takes the gathered fallback program.
+        # Both modes replay bit-identical to the single-device engine.
+        if routing not in ("gathered", "resident"):
+            raise ValueError(f"unknown mesh routing mode: {routing!r}")
+        self.routing = routing if self._state_shards > 1 else "gathered"
+        self._routed_lane_slots = max(int(routed_lane_slots), 1)
+        self._state_step_routed = None
+        self._state_step_fallback = None
+        self._fallback_exchange_bytes = 0
+        # residency map: workflow_instance_key → shard whose row block
+        # holds the ENTIRE instance (learned from routed-segment
+        # emissions; popped on fallback dispatch / demotion / completion)
+        self._resident: Dict[int, int] = {}
+        self.routed_waves = 0
+        self.fallback_waves = 0
+        self.routed_overflows = 0
+        # per-shard staged-row counts of the last dispatched wave (owner
+        # lane fill in resident mode, advisory hash split otherwise) —
+        # read by the broker feed for scheduler/wave fill accounting
+        self.last_shard_fill: tuple = ()
+        self._last_stage_split = None
+        self._last_stage_valid = 0
         self.device_indices = (
             list(device_indices) if device_indices is not None else []
         )
@@ -345,9 +374,32 @@ class TpuPartitionEngine:
         if self._mesh is not None:
             from zeebe_tpu.tpu import shard as shard_mod
 
-            self._state_step = shard_mod.build_state_step(
-                self._mesh, self.state
-            )
+            if self.routing == "resident":
+                bad = shard_mod.unshardable_state_leaves(
+                    self.state, self._state_shards
+                )
+                if bad:
+                    raise ValueError(
+                        "resident routing needs every shardable table "
+                        "divisible by the span; replicated-fallback "
+                        f"leaves: {bad} (use routing='gathered' or a "
+                        "divisible capacity)"
+                    )
+                self._state_step_routed = shard_mod.build_state_step_routed(
+                    self._mesh, self.state
+                )
+                self._state_step_fallback = (
+                    shard_mod.build_state_step_fallback(self._mesh, self.state)
+                )
+                self._fallback_exchange_bytes = (
+                    shard_mod.state_exchange_bytes(
+                        self.state, self._state_shards, include_lookup=False
+                    )
+                )
+            else:
+                self._state_step = shard_mod.build_state_step(
+                    self._mesh, self.state
+                )
             self._shard_exchange_bytes = shard_mod.state_exchange_bytes(
                 self.state, self._state_shards
             )
@@ -716,6 +768,9 @@ class TpuPartitionEngine:
         # straight into the oracle's maps (outside any record dispatch)
         self._mark_device_dirty()
         self._host.snapshot_mark_dirty(None)
+        # a demoted instance leaves the device tables — it is no longer
+        # block-resident anywhere (resident routing, sharded-state v2)
+        self._resident.pop(int(root_key), None)
         s = self.state
         ei_i32 = np.asarray(s.ei_i32)
         ei_i64 = np.asarray(s.ei_i64)
@@ -1626,6 +1681,12 @@ class TpuPartitionEngine:
         # depend on state a preceding device record writes, e.g. a job
         # COMPLETE followed by the instance's CANCEL)
         pending: List[int] = []
+        # resident routing: the pending segment carries ONE route class —
+        # ("create",) all-CREATE, ("ik", shard) proven-resident, ("fb",)
+        # unknown/mixed — and a record of a different class flushes first
+        # (single-owner waves are what make the routed program exact).
+        # None everywhere when routing is inactive: no split, no change.
+        pending_route: List = [None]
         # the two engines allocate from ONE keyspace; their counters sync
         # at segment boundaries so keys never collide across the
         # host/device split. Device→host pulls cost a device read and only
@@ -1669,6 +1730,7 @@ class TpuPartitionEngine:
                 [entries[i] for i in pending],
                 [positions[i] for i in pending],
                 [seg_meta(i) for i in pending],
+                route=pending_route[0],
             )
             seg.rows = list(pending)
             wave.segments.append(seg)
@@ -1704,6 +1766,10 @@ class TpuPartitionEngine:
                 # readback batch with current workflow slots: the row
                 # stages from columns; no Record materializes, and the
                 # log-backed position cache covers any later re-read
+                rc = self._wave_route_class(entry, True, vt, rt, intent)
+                if pending and rc != pending_route[0]:
+                    flush()
+                pending_route[0] = rc
                 pending.append(i)
                 continue
             if lazy:
@@ -1727,6 +1793,10 @@ class TpuPartitionEngine:
                 if bad is not None:
                     per_record[i] = self._reject_payload(record, bad)
                     continue
+                rc = self._wave_route_class(record, False, vt, rt, intent)
+                if pending and rc != pending_route[0]:
+                    flush()
+                pending_route[0] = rc
                 pending.append(i)
             else:
                 flush()  # earlier device rows execute BEFORE this record
@@ -1836,6 +1906,107 @@ class TpuPartitionEngine:
         if vt == int(ValueType.JOB):
             return key not in self._host.jobs
         return key not in instances
+
+    # -- resident routing policy (sharded-state v2) ------------------------
+    @property
+    def _resident_mode(self) -> bool:
+        return self.routing == "resident" and self._mesh is not None
+
+    def _routing_active(self) -> bool:
+        """Resident routing applies per wave: message-correlation graphs
+        probe subscription tables across the whole keyspace, which the
+        single-owner contract cannot cover — such partitions run every
+        wave through the gathered fallback (still correct, still
+        bit-identical; the routed win simply does not apply)."""
+        return (
+            self._resident_mode
+            and self.graph is not None
+            and not self.graph.has_messages
+        )
+
+    def _instance_key_of(self, entry, lazy: bool, vt: int):
+        """The workflow_instance_key a device record belongs to — the
+        residency-map key (the ROOT instance key, shared by every row of
+        the instance's scope tree). None = not provable from the entry."""
+        if lazy:
+            ref = entry[0].device_ref(entry[1])
+            if ref is None:
+                return None
+            src, j = ref
+            _o, scols, _epoch = src.device_source
+            return int(scols["instance_key"][j])
+        value = getattr(entry, "value", None)
+        if value is None:
+            return None
+        if vt == int(ValueType.JOB):
+            headers = getattr(value, "headers", None)
+            ik = getattr(headers, "workflow_instance_key", None)
+        else:
+            ik = getattr(value, "workflow_instance_key", None)
+        return int(ik) if ik is not None else None
+
+    def _wave_route_class(self, entry, lazy: bool, vt, rt, intent):
+        """Route class of one device-eligible record: ``("create",)``
+        (WI CREATE commands — the root key is the NEXT counter value, so
+        the whole instance births in one predictable block),
+        ``("ik", shard)`` (instance proven block-resident), ``("fb",)``
+        (unknown residency → gathered fallback). None = routing inactive."""
+        if not self._routing_active():
+            return None
+        if (
+            vt == int(ValueType.WORKFLOW_INSTANCE)
+            and rt == int(RecordType.COMMAND)
+            and intent == int(WI.CREATE)
+        ):
+            return ("create",)
+        ik = self._instance_key_of(entry, lazy, vt)
+        if ik is None or ik < 0:
+            return ("fb",)
+        s = self._resident.get(int(ik))
+        return ("ik", s) if s is not None else ("fb",)
+
+    def _routed_lane_cap(self) -> int:
+        """Max rows a routed wave may carry. Beyond the lane size, the
+        binding constraint is the shard-local direct-mapped index window:
+        rows born in one wave resolve through ei_index/job_index until the
+        next rebuild (wave start), and the direct maps are collision-free
+        only across a window of local-capacity consecutive keys — the
+        same invariant `_keys_at_rebuild` maintains globally, here per
+        wave with the v1 safety factor (4) because local capacity is
+        1/D of the global one."""
+        fanout = max(
+            1, self.graph.emit_width if self.graph is not None else 1
+        )
+        window = (
+            self.state.ei_index.shape[0] // self._state_shards
+        ) // (4 * fanout)
+        return max(1, min(self._routed_lane_slots, window))
+
+    def _note_residency(self, o, owner: int) -> None:
+        """Learn residency from a collected ROUTED segment's emissions:
+        every instance the wave touched has all its rows in ``owner``'s
+        block (single-owner staging + local allocation), and instances
+        whose root completed/terminated leave the map (their rows are
+        freed; a later reuse of the key would be a different instance)."""
+        valid = np.asarray(o.valid)
+        ik = np.asarray(o.instance_key)
+        live = valid & (ik >= 0)
+        for k in np.unique(ik[live]).tolist():
+            self._resident[int(k)] = owner
+        vt = np.asarray(o.vtype)
+        it = np.asarray(o.intent)
+        key = np.asarray(o.key)
+        done = (
+            live
+            & (vt == int(ValueType.WORKFLOW_INSTANCE))
+            & (key == ik)
+            & (
+                (it == int(WI.ELEMENT_COMPLETED))
+                | (it == int(WI.ELEMENT_TERMINATED))
+            )
+        )
+        for k in np.unique(ik[done]).tolist():
+            self._resident.pop(int(k), None)
 
     def collect_wave(self, wave: PendingWave) -> List[ProcessingResult]:
         """Materialize a dispatched wave: one bulk device fetch per
@@ -1964,13 +2135,19 @@ class TpuPartitionEngine:
         "src": -1, "resp": False, "push": False, "rej": 0,
     }
 
-    def _stage(self, records: List[Record], pad_to: int = 0) -> RecordBatch:
+    def _stage(
+        self, records: List[Record], pad_to: int = 0, lane_owner=None
+    ) -> RecordBatch:
         n = len(records)
         # on TPU every batch pads to ONE canonical shape: invalid rows are
         # SIMD-masked and near-free, while each distinct pow2 bucket would
         # be its own multi-minute cold compile through the remote-compile
         # tunnel, serialized on the broker actor. CPU (tests) keeps tight
         # pow2 buckets — small batches there are latency-bound.
+        if lane_owner is not None:
+            # routed lanes stage at ONE fixed shape ([D, lane_slots] per
+            # column) — one compiled routed program regardless of fill
+            pad_to = max(pad_to, self._routed_lane_slots)
         if jax.default_backend() == "tpu":
             pad_to = max(pad_to, self._TPU_BATCH)
         size = max(_pow2(n), pad_to)
@@ -2000,7 +2177,7 @@ class TpuPartitionEngine:
                 self._stage_row(cols, i, record)
         if staged_lazy:
             _count_staged_columnar(staged_lazy)
-        return self._pack_batch(cols, size)
+        return self._pack_batch(cols, size, lane_owner=lane_owner)
 
     def _stage_from_emission(self, cols, i, src, j) -> None:
         """Stage one row by COPYING the backing emission batch's columns
@@ -2043,10 +2220,19 @@ class TpuPartitionEngine:
         cols["v_num"][i] = np.where(mask, o["v_num"][j], 0)
         cols["v_str"][i] = np.where(mask, o["v_str"][j], 0)
 
-    def _pack_batch(self, cols: Dict[str, object], size: int) -> RecordBatch:
+    def _pack_batch(
+        self, cols: Dict[str, object], size: int, lane_owner=None
+    ) -> RecordBatch:
         """Scalar columns → one matrix per dtype family → one device_put
         each; the batch's per-column views are device slices (safe: the
-        step program donates only the state argument, never the batch)."""
+        step program donates only the state argument, never the batch).
+
+        ``lane_owner`` (resident routing, sharded-state v2) packs the same
+        family matrices into a ``[num_shards, size]`` laned layout — the
+        owner shard's lane carries the staged rows, every other lane holds
+        the all-invalid staging defaults — and the put is lane-sharded
+        over the mesh axis, so each device receives ONLY its own routed
+        rows while the transfer count stays one per dtype family."""
         i64 = np.empty((size, len(self._I64_COLS)), np.int64)
         for j, name in enumerate(self._I64_COLS):
             i64[:, j] = cols[name]
@@ -2056,27 +2242,65 @@ class TpuPartitionEngine:
         bools = np.empty((size, len(self._BOOL_COLS)), bool)
         for j, name in enumerate(self._BOOL_COLS):
             bools[:, j] = cols[name]
-        # sharded-state routing accounting: every staged wave reports its
-        # key-hash row split across the shard span (hot-shard balance
-        # gauge) and the wave's cross-shard table-gather volume. Advisory
-        # in this mode — physical residency is the block sharding, the
-        # hash is the stable owner the correlation plane already uses —
-        # but the split is what capacity planning reads.
+        # sharded-state routing accounting: record the staged row split
+        # (residency basis: instance_key in resident mode, advisory key
+        # hash otherwise) and the valid count — _run_step observes them
+        # together with the wave's ACTUAL exchange volume, so idle waves
+        # that dispatch zero records no longer inflate the exchange
+        # counter (they still count as sharded waves).
         if self._mesh is not None:
-            from zeebe_tpu.runtime import metrics as metrics_mod
             from zeebe_tpu.tpu import shard as shard_mod
 
-            metrics_mod.observe_sharded_wave(
-                shard_mod.shard_row_counts_host(
-                    cols["key"], cols["valid"], self._state_shards
-                ),
-                self._shard_exchange_bytes,
+            basis = (
+                cols["instance_key"] if self._resident_mode else cols["key"]
             )
-            self.sharded_waves += 1
+            self._last_stage_split = shard_mod.shard_row_counts_host(
+                basis, cols["valid"], self._state_shards
+            )
+            self._last_stage_valid = int(
+                np.count_nonzero(np.asarray(cols["valid"], bool))
+            )
         # staged columns commit to THIS engine's mesh device (placement is
         # what routes the step program to it); sharded mode replicates
-        # them over the span via _place-style NamedSharding; default
-        # device otherwise
+        # them over the span via _place-style NamedSharding (lane-sharded
+        # in routed staging); default device otherwise
+        kw: Dict[str, jax.Array] = {}
+        if self._mesh is not None and lane_owner is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from zeebe_tpu.tpu import shard as shard_mod
+
+            D = self._state_shards
+            lane_spec = NamedSharding(
+                self._mesh, PartitionSpec(shard_mod.STATE_AXIS)
+            )
+            put = lambda a: jax.device_put(a, lane_spec)  # noqa: E731
+            i64_def = np.array(
+                [self._COL_DEFAULTS[n] for n in self._I64_COLS], np.int64
+            )
+            i32_def = np.array(
+                [self._COL_DEFAULTS[n] for n in self._I32_COLS], np.int32
+            )
+            i64_l = np.broadcast_to(i64_def, (D, size, i64_def.size)).copy()
+            i32_l = np.broadcast_to(i32_def, (D, size, i32_def.size)).copy()
+            bool_l = np.zeros((D, size, len(self._BOOL_COLS)), bool)
+            i64_l[lane_owner] = i64
+            i32_l[lane_owner] = i32
+            bool_l[lane_owner] = bools
+            i64_dev = put(i64_l)
+            i32_dev = put(i32_l)
+            bool_dev = put(bool_l)
+            for j, name in enumerate(self._I64_COLS):
+                kw[name] = i64_dev[:, :, j]
+            for j, name in enumerate(self._I32_COLS):
+                kw[name] = i32_dev[:, :, j]
+            for j, name in enumerate(self._BOOL_COLS):
+                kw[name] = bool_dev[:, :, j]
+            for name in ("v_vt", "v_num", "v_str"):
+                mat = cols[name]
+                lanes = np.zeros((D,) + mat.shape, mat.dtype)
+                lanes[lane_owner] = mat
+                kw[name] = put(lanes)
+            return RecordBatch(**kw)
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
@@ -2090,7 +2314,6 @@ class TpuPartitionEngine:
         i64_dev = put(i64)
         i32_dev = put(i32)
         bool_dev = put(bools)
-        kw: Dict[str, jax.Array] = {}
         for j, name in enumerate(self._I64_COLS):
             kw[name] = i64_dev[:, j]
         for j, name in enumerate(self._I32_COLS):
@@ -2119,18 +2342,33 @@ class TpuPartitionEngine:
             batch = self._stage([], pad_to=n)
             # zero valid rows: a semantic no-op step that only compiles
             _out, _stats = self._run_step(batch, now)
+        if self._resident_mode:
+            # resident mode serves through TWO programs: the fallback just
+            # warmed above (it takes the same flat batch shapes); warm the
+            # routed program at its one laned shape too
+            batch = self._stage([], lane_owner=0)
+            _out, _stats = self._run_step(batch, now, lane_owner=0)
         jax.block_until_ready(self.state.ei_i32)
 
-    def _run_step(self, batch: RecordBatch, now) -> tuple:
-        """Launch ONE wave through the active step program — the sharded
-        program (shard.state_step through the jit registry) when this
-        engine runs in sharded-state mode, kernel.step_jit otherwise —
-        rebinding ``self.state`` and returning ``(out, stats)``. The two
-        programs are bit-identical by construction (the sharded one
-        gathers the full tables and runs the same kernel), so callers
-        never branch on the mode."""
+    def _run_step(self, batch: RecordBatch, now, lane_owner=None) -> tuple:
+        """Launch ONE wave through the active step program — routed or
+        fallback in resident mode (``lane_owner`` picks; the choice is
+        host-side so the routed lowering never contains the fallback's
+        gather), the v1 gathered program in sharded mode, kernel.step_jit
+        otherwise — rebinding ``self.state`` and returning ``(out,
+        stats)``. All programs are bit-identical by construction, so
+        callers never branch on the mode."""
         pid = jnp.asarray(self.partition_id, jnp.int32)
-        if self._state_step is not None:
+        if self._resident_mode:
+            program = (
+                self._state_step_routed
+                if lane_owner is not None
+                else self._state_step_fallback
+            )
+            self.state, out, stats = program(
+                self.graph, self.state, batch, now, pid
+            )
+        elif self._state_step is not None:
             self.state, out, stats = self._state_step(
                 self.graph, self.state, batch, now, pid
             )
@@ -2138,6 +2376,39 @@ class TpuPartitionEngine:
             self.state, out, stats = kernel.step_jit(
                 self.graph, self.state, batch, now, partition_id=pid
             )
+        if self._mesh is not None:
+            from zeebe_tpu.runtime import metrics as metrics_mod
+            from zeebe_tpu.tpu import shard as shard_mod
+
+            n_valid = self._last_stage_valid
+            # exchange model per wave KIND — and zero when the wave
+            # dispatched zero records: an idle/warm step moves no table
+            # or boundary data worth accounting (satellite fix; the
+            # gathered program still lowers its gathers, but capacity
+            # planning reads demand, not compilation artifacts)
+            if not n_valid:
+                xb = 0
+            elif self._resident_mode and lane_owner is not None:
+                xb = shard_mod.routed_exchange_bytes(out, self._state_shards)
+            elif self._resident_mode:
+                xb = self._fallback_exchange_bytes
+            else:
+                xb = self._shard_exchange_bytes
+            split = self._last_stage_split
+            single_lane = self._resident_mode and lane_owner is not None
+            if single_lane:
+                split = np.zeros(self._state_shards, np.int64)
+                split[int(lane_owner)] = n_valid
+            metrics_mod.observe_sharded_wave(
+                split, xb, single_lane=single_lane
+            )
+            self.sharded_waves += 1
+            self.last_shard_fill = tuple(int(x) for x in split)
+            if self._resident_mode and n_valid:
+                if lane_owner is not None:
+                    self.routed_waves += 1
+                else:
+                    self.fallback_waves += 1
         return out, stats
 
     def _stage_row(self, cols, i, record: Record) -> None:
@@ -2280,6 +2551,7 @@ class TpuPartitionEngine:
     def _dispatch_device(
         self, records: List, positions: List[int],
         metas: "Optional[List[tuple]]" = None,
+        route=None,
     ) -> _PendingSegment:
         """Host pre-work + staging + kernel launch for one device segment;
         returns the pending segment WITHOUT synchronizing on the device
@@ -2366,21 +2638,61 @@ class TpuPartitionEngine:
         live = seg.live
         if not live:
             return seg
-        batch = self._stage([records[i] for i in live])
+        lane_owner = None
+        if self._routing_active():
+            if route is not None and route[0] == "ik":
+                lane_owner = route[1]
+            elif route is not None and route[0] == "create":
+                # all-CREATE segment: the first live CREATE allocates the
+                # NEXT counter value as its root key (the rejection scan
+                # above already advanced the counter for rejected rows),
+                # and every follow-on allocation of the segment lands in
+                # the same owner's block. One blocking scalar read — the
+                # cost of making CREATE waves routable without a mirror
+                # of the kernel's allocation arithmetic.
+                from zeebe_tpu.tpu import shard as shard_mod
+
+                key0 = int(np.asarray(self.state.next_wf_key))
+                lane_owner = int(
+                    shard_mod.shard_of_key_host(key0, self._state_shards)
+                )
+            if lane_owner is not None and len(live) > self._routed_lane_cap():
+                lane_owner = None
+                self.routed_overflows += 1
+            if lane_owner is None:
+                # gathered fallback allocates follow-up rows at GLOBAL
+                # free slots — the instances it steps can no longer be
+                # proven block-resident. Pop at dispatch (not collect):
+                # later segments of this wave must not route on them.
+                for i in live:
+                    ik = self._instance_key_of(
+                        records[i], type(records[i]) is tuple, metas[i][0]
+                    )
+                    if ik is not None and ik >= 0:
+                        self._resident.pop(int(ik), None)
+        seg.route_owner = lane_owner
+        batch = self._stage(
+            [records[i] for i in live], lane_owner=lane_owner
+        )
         now = jnp.asarray(self.clock(), jnp.int64)
         # re-derive the fallback maps before the key window can wrap past
         # the direct-mapped index capacity (see rebuild_lookup_state).
         # Conservative host-side bound — one record can allocate up to
         # emit_width keys (parallel split / multi-instance fan-out), each
         # advancing the counter by the stride (5) — so the serving path
-        # pays no device sync.
-        fanout = max(1, self.graph.emit_width if self.graph is not None else 1)
-        self._keys_at_rebuild += 5 * fanout * len(live)
-        if self._keys_at_rebuild > self.state.ei_index.shape[0] // 4:
-            self.state = state_mod.rebuild_lookup_state(self.state)
-            self._keys_at_rebuild = 0
+        # pays no device sync. Resident mode skips the cadence entirely:
+        # BOTH its step programs rebuild the lookup structures in-program
+        # every wave, so no at-rest window can go stale.
+        if not self._resident_mode:
+            fanout = max(
+                1, self.graph.emit_width if self.graph is not None else 1
+            )
+            self._keys_at_rebuild += 5 * fanout * len(live)
+            if self._keys_at_rebuild > self.state.ei_index.shape[0] // 4:
+                self.state = state_mod.rebuild_lookup_state(self.state)
+                self._keys_at_rebuild = 0
         self._mark_device_dirty()  # a kernel step may write any table
-        out, stats = self._run_step(batch, now)
+        out, stats = self._run_step(batch, now, lane_owner=lane_owner)
         seg.out = out
         seg.stats = stats
         return seg
@@ -2407,6 +2719,8 @@ class TpuPartitionEngine:
         seg.out = None
         seg.stats = None
         waited = _time.perf_counter() - t0
+        if seg.route_owner is not None:
+            self._note_residency(o, seg.route_owner)
         self._emit_records(
             o, [seg.positions[i] for i in seg.live], seg.results, seg.live,
             seg.suppress,

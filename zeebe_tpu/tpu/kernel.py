@@ -285,6 +285,47 @@ def _select_by_map(dst_from, vt, num, sid):
     )
 
 
+def scope_to_local(ei_i32, shard_index, local_rows):
+    """Translate the EI_SCOPE parent-slot column from the GLOBAL row space
+    into one shard's LOCAL row space (residency-routed sharded state).
+
+    At rest the sharded tables store parent slots globally so host readers
+    (``_demote_instance``'s scope-tree walk, snapshots) see one coherent
+    space. The routed step runs the kernel on a local row block, whose
+    slot arithmetic is local — so in-block parents shift down by the block
+    base, sentinels (< 0) pass through, and out-of-block parents become the
+    POISON slot ``local_rows`` (one past the last local row: never equal to
+    any real slot, clipped gathers read row 0 harmlessly and the value is
+    restored by :func:`scope_to_global`). The routing policy only ever
+    routes rows of instances wholly resident in the block, so poisoned
+    parents belong to instances the wave does not step."""
+    base = shard_index * local_rows
+    g = ei_i32[:, EI_SCOPE]
+    local = jnp.where(
+        g < 0,
+        g,
+        jnp.where(
+            (g >= base) & (g < base + local_rows), g - base, local_rows
+        ),
+    )
+    return ei_i32.at[:, EI_SCOPE].set(local.astype(ei_i32.dtype))
+
+
+def scope_to_global(ei_i32, prev_global_scope, shard_index, local_rows):
+    """Inverse of :func:`scope_to_local` after the kernel ran on the local
+    block: local slots shift up by the block base, sentinels pass through,
+    and rows still carrying the POISON slot were untouched by the wave —
+    their original global parent (``prev_global_scope``) is restored."""
+    base = shard_index * local_rows
+    loc = ei_i32[:, EI_SCOPE]
+    back = jnp.where(
+        loc < 0,
+        loc,
+        jnp.where(loc == local_rows, prev_global_scope, loc + base),
+    )
+    return ei_i32.at[:, EI_SCOPE].set(back.astype(ei_i32.dtype))
+
+
 def step_kernel(
     graph: DeviceGraph, state: EngineState, batch: RecordBatch, now,
     synthetic_workers: bool = False, partition_id=0,

@@ -621,7 +621,10 @@ def shard_row_counts_host(keys, valid, num_shards: int) -> np.ndarray:
 
 
 def state_exchange_bytes(
-    state: EngineState, num_shards: int, axis: str = STATE_AXIS
+    state: EngineState,
+    num_shards: int,
+    axis: str = STATE_AXIS,
+    include_lookup: bool = True,
 ) -> int:
     """Aggregate cross-shard bytes ONE wave's table gathers move: each of
     the D devices receives the (D-1)/D fraction of every sharded table it
@@ -629,16 +632,21 @@ def state_exchange_bytes(
     per wave. Pure shape arithmetic (no tracing) — the engine stamps it
     on the ``mesh_shard_exchange_bytes_total`` counter per wave, and the
     zbaudit collective pass independently measures the same gathers at
-    the jaxpr level."""
+    the jaxpr level. ``include_lookup=False`` models resident mode's
+    fallback leg, which rebuilds the lookup structures in-program instead
+    of gathering them (only the row tables cross the interconnect)."""
     specs = state_partition_specs(state, num_shards, axis)
-    leaves = jax.tree_util.tree_leaves(state)
+    leaves, _ = jax.tree_util.tree_flatten_with_path(state)
     spec_leaves = jax.tree_util.tree_leaves(
         specs, is_leaf=lambda x: isinstance(x, P)
     )
     total = 0
-    for a, s in zip(leaves, spec_leaves):
-        if tuple(s) == (axis,):
-            total += int(np.dtype(a.dtype).itemsize) * int(np.prod(a.shape))
+    for (path, a), s in zip(leaves, spec_leaves):
+        if tuple(s) != (axis,):
+            continue
+        if not include_lookup and is_lookup_leaf(_path_str(path)):
+            continue
+        total += int(np.dtype(a.dtype).itemsize) * int(np.prod(a.shape))
     return total * (num_shards - 1)
 
 
@@ -715,4 +723,293 @@ def build_state_step(mesh: Mesh, state_template: EngineState):
         "donated sharded blocks is layout-dependent under shard_map, so "
         "the alias materialization check is waived — donation itself "
         "stays asserted",
+    )
+
+
+# ---------------------------------------------------------------------------
+# sharded-state v2: residency-routed staging (ROADMAP item 2, second half)
+# ---------------------------------------------------------------------------
+# ``build_state_step`` above is gather-for-compute: resident HBM divides by
+# the span but every wave gathers every sharded table, so neither the
+# compute term nor the per-wave collective volume divides. The routed
+# programs below make the key-hash routing plane PHYSICAL: the engine
+# stages each wave into per-shard batch lanes (``_pack_batch``'s laned
+# path), every shard rebuilds its lookup structures from its OWN row block
+# in-program (``rebuild_lookup_state`` — pow2 capacities stay pow2 under
+# the block split) and steps the unmodified kernel on local rows + its
+# routed batch lane. No per-wave table ``all_gather`` exists in the routed
+# lowering; the only collectives are ``psum`` reductions of the (single-
+# owner, hence exact) emissions, stats, and replicated-leaf deltas — the
+# boundary traffic, scaling with the BATCH, not the tables.
+#
+# Residency contract (enforced by the engine's routing policy, not here):
+# a routed wave is SINGLE-OWNER — all rows belong to instances wholly
+# resident in one shard's row block — so key allocation from the
+# replicated counters happens on exactly one lane (no cross-lane key
+# collisions) and parent-slot references never leave the block. Waves the
+# policy cannot prove single-owner (unknown residency, lane overflow,
+# message-correlation graphs) run ``build_state_step_fallback``: the v1
+# gathered shape but with the lookup structures rebuilt GLOBALLY in-program
+# from the gathered rows — in resident mode the lookup leaves are per-wave
+# derived scratch in BOTH legs, which is what lets the two interleave
+# freely on the same sharded tables. Both legs replay bit-identical to the
+# single-device engine: emissions depend on keys and batch-row order, never
+# on which table slot a row occupies.
+
+# state leaves DERIVED from live rows (direct-mapped indexes, fallback
+# hashmaps, free-slot rings + their cursors): in resident mode these are
+# per-wave scratch — rebuilt inside the step programs — never gathered,
+# never trusted across waves.
+LOOKUP_LEAF_PATTERNS = (
+    r"ei_map\.", r"job_map\.", r"join_map\.", r"timer_map\.",
+    r"msub_map\.", r"msg_map\.",
+    r"ei_index$", r"job_index$",
+    r"free_(ei|job)$", r"free_(ei|job)_(pop|push)$",
+)
+
+_CURSOR_RE = re.compile(r"free_(ei|job)_(pop|push)$")
+
+
+def is_lookup_leaf(name: str) -> bool:
+    """True when a dotted state-leaf path names a row-derived lookup
+    structure (rebuilt per wave by the resident-mode step programs)."""
+    return any(re.search(p, name) for p in LOOKUP_LEAF_PATTERNS)
+
+
+def unshardable_state_leaves(state: EngineState, num_shards: int) -> list:
+    """Leaf paths the partition rules WANT sharded but whose leading dim
+    is not divisible by ``num_shards`` (they silently replicate in v1).
+    Resident mode refuses such a configuration outright: a replicated row
+    table would put its slots in the global space while sharded tables use
+    block-local spaces, and the owner lane's writes to it would diverge
+    from the other lanes' no-ops."""
+    leaves, _ = jax.tree_util.tree_flatten_with_path(state)
+    bad = []
+    for path, leaf in leaves:
+        name = _path_str(path)
+        for pat, want in STATE_PARTITION_RULES:
+            if re.search(pat, name):
+                if want:
+                    shape = getattr(leaf, "shape", ())
+                    if not (
+                        len(shape) >= 1
+                        and shape[0] > 0
+                        and shape[0] % num_shards == 0
+                    ):
+                        bad.append(name)
+                break
+    return bad
+
+
+def routed_exchange_bytes(out_tree, num_shards: int) -> int:
+    """Cross-shard bytes ONE routed wave moves: the emission batch (and
+    stats/replicated-leaf deltas, which it dominates) reduces over the mesh
+    axis via ``psum``, so the interconnect carries ``reduced_bytes *
+    (D-1)`` — the same receive-volume convention as
+    :func:`state_exchange_bytes`, now a function of the BATCH instead of
+    the tables. bool/int8 leaves reduce in i32 (4 B/element)."""
+    total = 0
+    for a in jax.tree_util.tree_leaves(out_tree):
+        dt = np.dtype(a.dtype)
+        item = 4 if dt in (np.dtype(bool), np.dtype(np.int8)) else dt.itemsize
+        total += item * int(np.prod(a.shape))
+    return total * (num_shards - 1)
+
+
+def _psum_masked(leaf, mine, axis):
+    """Exact single-owner reduction of a per-lane value: non-owner lanes
+    contribute zeros, so the sum IS the owner's value (f32 included — one
+    nonzero term). bool/int8 reduce in i32."""
+    if leaf.dtype == jnp.bool_:
+        z = jnp.where(mine, leaf, False).astype(jnp.int32)
+        return jax.lax.psum(z, axis) != 0
+    if leaf.dtype == jnp.int8:
+        z = jnp.where(mine, leaf, jnp.zeros_like(leaf)).astype(jnp.int32)
+        return jax.lax.psum(z, axis).astype(jnp.int8)
+    z = jnp.where(mine, leaf, jnp.zeros_like(leaf))
+    return jax.lax.psum(z, axis)
+
+
+def _delta_psum(new, old, mine, axis):
+    """Replicated-leaf reconciliation: every lane holds the same ``old``;
+    only the owner lane's kernel produced a real ``new`` — apply exactly
+    its delta on all lanes (bools via i32 space)."""
+    if new.dtype == jnp.bool_:
+        o = old.astype(jnp.int32)
+        d = jnp.where(mine, new.astype(jnp.int32) - o, 0)
+        return (o + jax.lax.psum(d, axis)) != 0
+    d = jnp.where(mine, new - old, jnp.zeros_like(new))
+    return old + jax.lax.psum(d, axis)
+
+
+def build_state_step_routed(mesh: Mesh, state_template: EngineState):
+    """The residency-routed sharded-state step program:
+
+      (graph, state, lanes, now, partition_id) → (state', out, stats)
+
+    ``state`` arrives sharded per ``state_partition_specs`` exactly like
+    ``shard.state_step``; ``lanes`` is a RecordBatch with a leading
+    ``[num_shards]`` lane dim, sharded over the mesh axis, so each device
+    receives ONLY its own routed rows (one host→device put per dtype
+    family covers all lanes). Each shard translates the parent-slot column
+    into its local row space, rebuilds the lookup structures from its own
+    block, and steps the UNMODIFIED kernel on local rows + local lane —
+    no table gather anywhere in the lowering. Emissions, stats, and the
+    deltas of replicated leaves (key counters, worker-subscription
+    tables) reduce with ``psum``; single-owner waves make every reduction
+    exact, so outputs are replicated and bit-identical to the
+    single-device program. Registered as ``shard.state_step_routed`` with
+    its own zbaudit collective budget (boundary traffic only)."""
+    axis = mesh.axis_names[0]
+    nshards = int(mesh.devices.size)
+    specs_tree = state_partition_specs(state_template, nshards, axis)
+    spec_leaves = jax.tree_util.tree_leaves(
+        specs_tree, is_leaf=lambda x: isinstance(x, P)
+    )
+
+    def _sharded(spec) -> bool:
+        return tuple(spec) == (axis,)
+
+    def shard_fn(graph, state, lanes, now, partition_id):
+        from zeebe_tpu.tpu.kernel import scope_to_global, scope_to_local
+
+        idx = jax.lax.axis_index(axis)
+        batch = _squeeze(lanes)
+        mine = jnp.any(batch.valid)
+        lrows = state.ei_i32.shape[0]
+        prev_scope = state.ei_i32[:, state_mod.EI_SCOPE]
+        local = dataclasses.replace(
+            state, ei_i32=scope_to_local(state.ei_i32, idx, lrows)
+        )
+        # lookup structures are per-wave derived scratch: rebuild them
+        # from THIS block's rows (local capacities — pow2/D stays pow2)
+        local = state_mod.rebuild_lookup_state(local)
+        new_state, out, stats = step_kernel(
+            graph, local, batch, now, partition_id=partition_id
+        )
+        new_state = dataclasses.replace(
+            new_state,
+            ei_i32=scope_to_global(
+                new_state.ei_i32, prev_scope, idx, lrows
+            ),
+        )
+        new_leaves, treedef = jax.tree_util.tree_flatten_with_path(new_state)
+        old_leaves = jax.tree_util.tree_leaves(state)
+        rec = []
+        for (path, nl), ol, sp in zip(new_leaves, old_leaves, spec_leaves):
+            if _sharded(sp):
+                rec.append(nl)  # local block stays local
+            elif _CURSOR_RE.search(_path_str(path)):
+                # free-ring cursors are lane-local rebuild scratch: pass
+                # the replicated input through (next rebuild resets them)
+                rec.append(ol)
+            else:
+                rec.append(_delta_psum(nl, ol, mine, axis))
+        new_state = jax.tree_util.tree_unflatten(treedef, rec)
+        out = jax.tree.map(lambda a: _psum_masked(a, mine, axis), out)
+        stats = {
+            k: _psum_masked(v, mine, axis) for k, v in stats.items()
+        }
+        return new_state, out, stats
+
+    fn = _shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), specs_tree, P(axis), P(), P()),
+        out_specs=(specs_tree, P(), P()),
+        check_vma=False,
+    )
+    return jit_registry.register_jit(
+        "shard.state_step_routed",
+        fn,
+        state_args=(1,),
+        donate_argnums=(1,),
+        collective=True,
+        max_signatures=2,
+        suppress=("boundary-alias",),
+        notes="residency-routed sharded state: local rows + routed batch "
+        "lane per shard, lookup structures rebuilt in-program, psum-only "
+        "boundary exchange (no table all_gather in the lowering); alias "
+        "materialization waived as for shard.state_step",
+    )
+
+
+def build_state_step_fallback(mesh: Mesh, state_template: EngineState):
+    """Resident mode's gathered fallback step (same signature as
+    ``shard.state_step``): waves the routing policy cannot prove
+    single-owner (unknown residency, lane overflow, message graphs) gather
+    the ROW tables and step the replicated global view like v1 — but the
+    lookup structures are NOT gathered: they are per-wave scratch in
+    resident mode, so this leg substitutes global-shaped placeholders and
+    rebuilds them in-program from the gathered rows (strictly fresher than
+    v1's cadence invariant, and it sheds the map/index/ring gather volume
+    from the wave). Sharded lookup leaves return the local slice of the
+    rebuilt global scratch so at-rest shapes stay identical to v1."""
+    axis = mesh.axis_names[0]
+    nshards = int(mesh.devices.size)
+    specs_tree = state_partition_specs(state_template, nshards, axis)
+    spec_leaves = jax.tree_util.tree_leaves(
+        specs_tree, is_leaf=lambda x: isinstance(x, P)
+    )
+    template_leaves = [
+        leaf
+        for _, leaf in jax.tree_util.tree_flatten_with_path(state_template)[0]
+    ]
+
+    def _sharded(spec) -> bool:
+        return tuple(spec) == (axis,)
+
+    def shard_fn(graph, state, batch, now, partition_id):
+        idx = jax.lax.axis_index(axis)
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
+        full_leaves = []
+        for (path, a), t, sp in zip(leaves, template_leaves, spec_leaves):
+            name = _path_str(path)
+            if is_lookup_leaf(name):
+                if _sharded(sp):
+                    # global-shaped scratch; rebuild overwrites it below
+                    full_leaves.append(
+                        jnp.zeros(tuple(t.shape), dtype=t.dtype)
+                    )
+                else:
+                    full_leaves.append(a)
+            elif _sharded(sp):
+                full_leaves.append(
+                    jax.lax.all_gather(a, axis, axis=0, tiled=True)
+                )
+            else:
+                full_leaves.append(a)
+        full = jax.tree_util.tree_unflatten(treedef, full_leaves)
+        full = state_mod.rebuild_lookup_state(full)
+        new_state, out, stats = step_kernel(
+            graph, full, batch, now, partition_id=partition_id
+        )
+
+        def keep(a, s):
+            if not _sharded(s):
+                return a
+            rows = a.shape[0] // nshards
+            return jax.lax.dynamic_slice_in_dim(a, idx * rows, rows, axis=0)
+
+        return _zip_specs(keep, new_state, specs_tree), out, stats
+
+    fn = _shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), specs_tree, P(), P(), P()),
+        out_specs=(specs_tree, P(), P()),
+        check_vma=False,
+    )
+    return jit_registry.register_jit(
+        "shard.state_step_fallback",
+        fn,
+        state_args=(1,),
+        donate_argnums=(1,),
+        collective=True,
+        max_signatures=4,
+        suppress=("boundary-alias",),
+        notes="resident mode's gathered fallback: row tables gather, "
+        "lookup structures rebuild in-program (sheds the map/index/ring "
+        "gather volume vs shard.state_step); overflow waves add pow2 "
+        "batch buckets, hence the wider signature allowance",
     )
