@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from zeebe_tpu.protocol.enums import RecordType, ValueType
+from zeebe_tpu.protocol.intents import WorkflowInstanceIntent as WI
 from zeebe_tpu.runtime.metrics import GLOBAL_REGISTRY, event_count
 from zeebe_tpu.scheduler import PartitionFeed, WaveScheduler
 from zeebe_tpu.scheduler.placement import DevicePlan
@@ -536,6 +538,91 @@ class TestRoutedServingParity:
             TpuPartitionEngine(
                 0, 1, capacity=256, state_shards=2, routing="telepathic"
             )
+
+
+def _emission_stub(instance_keys, vtypes=None, intents=None, keys=None):
+    """Minimal emission-batch stand-in for residency bookkeeping tests:
+    just the columns _note_residency / _pop_residency_fallback read."""
+    import types
+
+    n = len(instance_keys)
+    return types.SimpleNamespace(
+        valid=np.ones(n, bool),
+        instance_key=np.asarray(instance_keys, np.int64),
+        vtype=np.asarray(vtypes if vtypes is not None else [0] * n, np.int32),
+        intent=np.asarray(
+            intents if intents is not None else [0] * n, np.int32
+        ),
+        key=np.asarray(keys if keys is not None else [-1] * n, np.int64),
+    )
+
+
+class TestResidencyInvalidation:
+    """The residency map must never trust stale knowledge. A gathered
+    fallback allocates at GLOBAL free slots, so (a) its collect retires
+    every instance its EMISSIONS name — including the ones whose key the
+    host could not prove at dispatch, exactly the rows that forced the
+    fallback — (b) a routed segment dispatched BEFORE the pop cannot
+    note such a key back in when its pipelined collect runs later, and
+    (c) while a fallback with host-unprovable rows is in flight, routing
+    holds off entirely (any entry might be stale until the emissions
+    resolve the keys)."""
+
+    def _engine(self):
+        import types
+
+        from zeebe_tpu.tpu import TpuPartitionEngine
+
+        engine = TpuPartitionEngine(
+            0, 1, capacity=256, state_shards=2, routing="resident"
+        )
+        engine.graph = types.SimpleNamespace(has_messages=False)
+        assert engine._routing_active()
+        return engine
+
+    def test_fallback_collect_retires_emission_instances(self):
+        engine = self._engine()
+        engine._resident = {11: 1, 22: 0}
+        engine._pop_residency_fallback(_emission_stub([11, 11, -1]), seq=7)
+        assert engine._resident == {22: 0}
+        assert engine._residency_invalid[11] == 7
+
+    def test_stale_note_cannot_reinstate_popped_residency(self):
+        engine = self._engine()
+        o = _emission_stub([33], vtypes=[int(ValueType.JOB)], keys=[99])
+        engine._residency_invalid = {33: 5}
+        # dispatched before the fallback that invalidated at seq 5:
+        # its collect arrives late (pipelining) and must be ignored
+        engine._note_residency(o, owner=1, seq=4)
+        assert 33 not in engine._resident
+        # a segment dispatched AFTER the invalidation carries newer
+        # knowledge and may note again
+        engine._note_residency(o, owner=1, seq=6)
+        assert engine._resident[33] == 1
+
+    def test_blind_fallback_inflight_gates_routing(self):
+        import types
+
+        engine = self._engine()
+        engine._resident = {44: 1}
+        entry = types.SimpleNamespace(
+            value=types.SimpleNamespace(
+                headers=types.SimpleNamespace(workflow_instance_key=44)
+            )
+        )
+        args = (entry, False, int(ValueType.JOB), int(RecordType.COMMAND), 0)
+        assert engine._wave_route_class(*args) == ("ik", 1)
+        engine._blind_fb_inflight = 1
+        assert engine._wave_route_class(*args) == ("fb",)
+        # CREATEs stay routable through the gate: their root key is
+        # freshly allocated, so no residency entry can be stale for them
+        create = (
+            None, False, int(ValueType.WORKFLOW_INSTANCE),
+            int(RecordType.COMMAND), int(WI.CREATE),
+        )
+        assert engine._wave_route_class(*create) == ("create",)
+        engine._blind_fb_inflight = 0
+        assert engine._wave_route_class(*args) == ("ik", 1)
 
 
 class TestRoutedLoweringCensus:

@@ -125,7 +125,8 @@ def _first_position(records) -> int:
 class WaveSegment:
     """One partition's contiguous slice of a shared wave."""
 
-    __slots__ = ("feed", "records", "pending", "count", "trace")
+    __slots__ = ("feed", "records", "pending", "count", "trace",
+                 "shard_fill")
 
     def __init__(self, feed: PartitionFeed, records):
         self.feed = feed
@@ -133,6 +134,7 @@ class WaveSegment:
         self.count = len(records)
         self.pending = None  # dispatched-but-uncollected engine wave
         self.trace = None  # wave-timeline segment entry (tracing on)
+        self.shard_fill = None  # per-shard staged rows, stamped at dispatch
 
 
 class SharedWave:
@@ -347,6 +349,10 @@ class WaveScheduler:
                 wave.total = sum(s.count for s in wave.segments)
                 raise
             seg.pending = pending
+            # snapshot the engine's per-shard fill NOW: the attribute is
+            # mutable "last dispatched" state, and by collect time a later
+            # segment's dispatch has overwritten it
+            seg.shard_fill = getattr(seg.feed, "shard_fill", None)
             wave.host_seconds += host_s
             wave.device_seconds += device_s
             if pending is None:
@@ -415,9 +421,9 @@ class WaveScheduler:
                 # plan device actually staged for this segment — under
                 # resident routing a routed wave fills ONE lane, and this
                 # is where that concentration becomes visible per device
-                fill = getattr(seg.feed, "shard_fill", None)
-                if fill:
-                    observe_shard_fill(span, fill)
+                # (the fill was snapshotted at THIS segment's dispatch)
+                if seg.shard_fill:
+                    observe_shard_fill(span, seg.shard_fill)
             else:
                 devices.add(getattr(seg.feed, "device_index", -1))
         devices.discard(-1)

@@ -220,6 +220,11 @@ class _PendingSegment:
     out: Optional[RecordBatch] = None   # device emission batch (unfetched)
     stats: Optional[dict] = None        # device stats (unfetched)
     route_owner: Optional[int] = None   # routed wave's owner shard (v2)
+    seq: int = -1                       # dispatch order (residency ordering)
+    fb_pop: bool = False                # gathered fallback under routing:
+                                        # collect pops residency from emissions
+    blind: bool = False                 # fallback carried rows whose instance
+                                        # key the host could not prove
 
 
 @dataclasses.dataclass
@@ -303,8 +308,21 @@ class TpuPartitionEngine:
         self._fallback_exchange_bytes = 0
         # residency map: workflow_instance_key → shard whose row block
         # holds the ENTIRE instance (learned from routed-segment
-        # emissions; popped on fallback dispatch / demotion / completion)
+        # emissions; popped on fallback dispatch/collect, demotion,
+        # completion)
         self._resident: Dict[int, int] = {}
+        # instance_key → dispatch seq whose fallback/demotion broke the
+        # single-owner proof. Collects run after LATER dispatches
+        # (pipelining), so an earlier-dispatched routed segment's
+        # _note_residency must not re-add a key a later fallback popped —
+        # the seq ordering decides which knowledge is newer.
+        self._residency_invalid: Dict[int, int] = {}
+        self._dispatch_seq = 0
+        # dispatched-but-uncollected fallback segments that stepped rows
+        # whose instance key the host could not prove: until their
+        # emissions name those instances (collect), ANY residency entry
+        # may be stale, so routing holds off
+        self._blind_fb_inflight = 0
         self.routed_waves = 0
         self.fallback_waves = 0
         self.routed_overflows = 0
@@ -769,8 +787,11 @@ class TpuPartitionEngine:
         self._mark_device_dirty()
         self._host.snapshot_mark_dirty(None)
         # a demoted instance leaves the device tables — it is no longer
-        # block-resident anywhere (resident routing, sharded-state v2)
+        # block-resident anywhere (resident routing, sharded-state v2).
+        # The invalidation also blocks in-flight collects (all dispatched
+        # before this point) from noting the key back in.
         self._resident.pop(int(root_key), None)
+        self._residency_invalid[int(root_key)] = self._dispatch_seq
         s = self.state
         ei_i32 = np.asarray(s.ei_i32)
         ei_i64 = np.asarray(s.ei_i64)
@@ -1962,6 +1983,14 @@ class TpuPartitionEngine:
         ik = self._instance_key_of(entry, lazy, vt)
         if ik is None or ik < 0:
             return ("fb",)
+        if self._blind_fb_inflight:
+            # an uncollected fallback segment stepped rows whose instance
+            # the host could not identify — possibly THIS one, and the
+            # gathered kernel may have allocated its rows outside the
+            # home block. Until that segment's emissions resolve the
+            # keys, no residency entry is trustworthy. (CREATEs above
+            # stay routable: their keys are freshly allocated.)
+            return ("fb",)
         s = self._resident.get(int(ik))
         return ("ik", s) if s is not None else ("fb",)
 
@@ -1982,16 +2011,37 @@ class TpuPartitionEngine:
         ) // (4 * fanout)
         return max(1, min(self._routed_lane_slots, window))
 
-    def _note_residency(self, o, owner: int) -> None:
+    def _pop_residency_fallback(self, o, seq: int) -> None:
+        """Retire residency for every instance a collected FALLBACK
+        segment's emissions name: the gathered step allocates at GLOBAL
+        free slots, so each touched instance may now own rows outside
+        its home block. This is the collect-time complement of the
+        dispatch-time pop — it covers the rows whose instance key the
+        host could not prove (the kernel's emissions resolve them)."""
+        valid = np.asarray(o.valid)
+        ik = np.asarray(o.instance_key)
+        for k in np.unique(ik[valid & (ik >= 0)]).tolist():
+            self._resident.pop(int(k), None)
+            self._residency_invalid[int(k)] = seq
+
+    def _note_residency(self, o, owner: int, seq: int) -> None:
         """Learn residency from a collected ROUTED segment's emissions:
         every instance the wave touched has all its rows in ``owner``'s
         block (single-owner staging + local allocation), and instances
         whose root completed/terminated leave the map (their rows are
-        freed; a later reuse of the key would be a different instance)."""
+        freed; a later reuse of the key would be a different instance).
+
+        ``seq`` is the segment's dispatch order: a key invalidated by a
+        LATER-dispatched fallback (or a demotion) is skipped — this
+        collect reflects older device state and must not reinstate an
+        entry that newer knowledge already retired."""
         valid = np.asarray(o.valid)
         ik = np.asarray(o.instance_key)
         live = valid & (ik >= 0)
+        inv = self._residency_invalid
         for k in np.unique(ik[live]).tolist():
+            if inv.get(int(k), -1) >= seq:
+                continue
             self._resident[int(k)] = owner
         vt = np.asarray(o.vtype)
         it = np.asarray(o.intent)
@@ -2638,6 +2688,8 @@ class TpuPartitionEngine:
         live = seg.live
         if not live:
             return seg
+        seg.seq = self._dispatch_seq
+        self._dispatch_seq += 1
         lane_owner = None
         if self._routing_active():
             if route is not None and route[0] == "ik":
@@ -2662,14 +2714,32 @@ class TpuPartitionEngine:
             if lane_owner is None:
                 # gathered fallback allocates follow-up rows at GLOBAL
                 # free slots — the instances it steps can no longer be
-                # proven block-resident. Pop at dispatch (not collect):
-                # later segments of this wave must not route on them.
+                # proven block-resident. Host-provable keys pop at
+                # dispatch so later segments never route on them; rows
+                # whose key the host CANNOT prove (e.g. client job
+                # commands with default headers — exactly what forced
+                # the fallback) resolve at collect, when the kernel's
+                # emissions name them (seg.fb_pop), and routing holds
+                # off until then (seg.blind). CREATE rows are exempt
+                # from blindness: their keys are freshly allocated, so
+                # no pre-existing residency entry can go stale.
+                seg.fb_pop = True
                 for i in live:
+                    vt_i, rt_i, it_i = metas[i]
                     ik = self._instance_key_of(
-                        records[i], type(records[i]) is tuple, metas[i][0]
+                        records[i], type(records[i]) is tuple, vt_i
                     )
                     if ik is not None and ik >= 0:
                         self._resident.pop(int(ik), None)
+                        self._residency_invalid[int(ik)] = seg.seq
+                    elif not (
+                        vt_i == int(ValueType.WORKFLOW_INSTANCE)
+                        and rt_i == int(RecordType.COMMAND)
+                        and it_i == int(WI.CREATE)
+                    ):
+                        seg.blind = True
+                if seg.blind:
+                    self._blind_fb_inflight += 1
         seg.route_owner = lane_owner
         batch = self._stage(
             [records[i] for i in live], lane_owner=lane_owner
@@ -2720,7 +2790,19 @@ class TpuPartitionEngine:
         seg.stats = None
         waited = _time.perf_counter() - t0
         if seg.route_owner is not None:
-            self._note_residency(o, seg.route_owner)
+            self._note_residency(o, seg.route_owner, seg.seq)
+        elif seg.fb_pop:
+            self._pop_residency_fallback(o, seg.seq)
+            if seg.blind:
+                self._blind_fb_inflight -= 1
+        if self._residency_invalid:
+            # collects run in dispatch order: an invalidation at/before
+            # this seq can no longer suppress any future note
+            self._residency_invalid = {
+                k: s
+                for k, s in self._residency_invalid.items()
+                if s > seg.seq
+            }
         self._emit_records(
             o, [seg.positions[i] for i in seg.live], seg.results, seg.live,
             seg.suppress,

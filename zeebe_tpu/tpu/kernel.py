@@ -294,11 +294,15 @@ def scope_to_local(ei_i32, shard_index, local_rows):
     space. The routed step runs the kernel on a local row block, whose
     slot arithmetic is local — so in-block parents shift down by the block
     base, sentinels (< 0) pass through, and out-of-block parents become the
-    POISON slot ``local_rows`` (one past the last local row: never equal to
-    any real slot, clipped gathers read row 0 harmlessly and the value is
-    restored by :func:`scope_to_global`). The routing policy only ever
-    routes rows of instances wholly resident in the block, so poisoned
-    parents belong to instances the wave does not step."""
+    POISON slot ``local_rows`` (one past the last local row: never equal
+    to any real slot, so parent-slot comparisons can't alias). A gather
+    through the POISON slot clamps to the LAST local row — JAX clamps
+    out-of-range indices to the valid edge, not to row 0 — so the read
+    itself returns real (wrong-parent) data; it is harmless only because
+    the routing policy routes exclusively instances wholly resident in
+    the block: poisoned parents belong to instances the wave does not
+    step, their lanes stay masked, and :func:`scope_to_global` restores
+    the original global slot afterwards."""
     base = shard_index * local_rows
     g = ei_i32[:, EI_SCOPE]
     local = jnp.where(
